@@ -1,0 +1,27 @@
+"""onix — "ONI on XLA": a TPU-native network-security analytics framework.
+
+A from-scratch re-design of Open Network Insight (ONI; reference umbrella at
+/root/reference, see README.md:30-48 for the four product pillars) for
+JAX/XLA/Pallas on TPU device meshes:
+
+- **ingest**  — parallel telemetry ingestion (netflow / DNS / proxy) into a
+  partitioned Parquet store (replaces oni-ingest + Kafka + Hive, reference
+  README.md:35-38).
+- **pipelines** — vectorized word creation per datatype (replaces oni-ml's
+  Spark word-creation jobs, reference README.md:41-43).
+- **models**  — LDA topic-model engines: batched collapsed Gibbs and online
+  variational Bayes, pure JAX (replaces the oni-lda-c C/MPI engine,
+  reference README.md:84).
+- **parallel** — doc-sharded multi-chip inference with topic-sufficient-
+  statistics psum over ICI (replaces MPI_Reduce/Bcast in oni-lda-c).
+- **oa**      — operational-analytics batch engine: enrichment + per-date
+  results for analyst dashboards (replaces oni-oa, reference README.md:45-48).
+
+Unlike the reference — a constellation of Scala/Spark, C/MPI, Python 2 and
+Bash glued together by files and ssh — onix is one package with one config
+system, one storage substrate, and one compiled compute path.
+"""
+
+__version__ = "0.1.0"
+
+from onix.config import OnixConfig, LDAConfig, load_config  # noqa: F401

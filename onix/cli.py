@@ -1,0 +1,95 @@
+"""onix command-line interface.
+
+Mirrors the reference's operator surface (SURVEY.md §3.1, §7.1.8):
+`ml_ops.sh <YYYYMMDD> <flow|dns|proxy> [TOL] [MAXRESULTS]` becomes
+`onix score <date> <type> [--tol] [--max-results]`, plus `ingest` and
+`oa` subcommands for the other two pillars (reference README.md:35-48).
+
+Subcommands are registered lazily so `onix config` works before the
+heavier pipeline modules import JAX.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from onix.config import load_config
+
+
+def _add_common(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--config", "-c", default=None,
+                   help="YAML/JSON config file")
+    p.add_argument("--set", "-s", action="append", default=[],
+                   metavar="KEY.PATH=VALUE", dest="overrides",
+                   help="config override (repeatable)")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="onix",
+        description="TPU-native network-security analytics (ONI on XLA)")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p_cfg = sub.add_parser("config", help="print the resolved configuration")
+    _add_common(p_cfg)
+
+    p_score = sub.add_parser(
+        "score", help="run the suspicious-connects scoring pipeline for one "
+                      "day of one datatype (the ml_ops.sh equivalent)")
+    _add_common(p_score)
+    p_score.add_argument("date", help="day to score, YYYY-MM-DD")
+    p_score.add_argument("datatype", choices=("flow", "dns", "proxy"))
+    p_score.add_argument("--tol", type=float, default=None)
+    p_score.add_argument("--max-results", type=int, default=None)
+    p_score.add_argument("--engine", choices=("gibbs", "svi"), default="gibbs")
+
+    p_ingest = sub.add_parser(
+        "ingest", help="decode and load raw telemetry into the store")
+    _add_common(p_ingest)
+    p_ingest.add_argument("datatype", choices=("flow", "dns", "proxy"))
+    p_ingest.add_argument("paths", nargs="+", help="raw capture/log files")
+
+    p_oa = sub.add_parser(
+        "oa", help="operational analytics: enrich scored results for the UI")
+    _add_common(p_oa)
+    p_oa.add_argument("date", help="day to process, YYYY-MM-DD")
+    p_oa.add_argument("datatype", choices=("flow", "dns", "proxy"))
+
+    return parser
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    cfg = load_config(args.config, args.overrides)
+
+    if args.command == "config":
+        print(cfg.to_json())
+        return 0
+
+    if args.command == "score":
+        cfg.pipeline.date = args.date
+        cfg.pipeline.datatype = args.datatype
+        if args.tol is not None:
+            cfg.pipeline.tol = args.tol
+        if args.max_results is not None:
+            cfg.pipeline.max_results = args.max_results
+        from onix.pipelines.run import run_scoring
+        return run_scoring(cfg, engine=args.engine)
+
+    if args.command == "ingest":
+        from onix.ingest.run import run_ingest
+        return run_ingest(cfg, args.datatype, args.paths)
+
+    if args.command == "oa":
+        from onix.oa.engine import run_oa
+        return run_oa(cfg, args.date, args.datatype)
+
+    return 2
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:     # e.g. `onix config | head`
+        sys.exit(0)

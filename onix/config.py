@@ -1,0 +1,227 @@
+"""Typed configuration for onix.
+
+The reference shares one untyped key-value file across every layer
+(`/etc/duxbay.conf`-style, sourced by Bash, parsed by Python and Scala;
+see SURVEY.md §5.6 — keys like DBNAME, NODES, TOL, TOPIC_COUNT, DUPFACTOR
+are structurally required by the ml_ops.sh call stack, reference
+README.md:41-43). onix replaces that with schema-validated dataclasses,
+YAML/JSON loading, dotted-path CLI overrides, and an archived resolved
+config per run.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import hashlib
+import pathlib
+from dataclasses import dataclass, field
+from typing import Any
+
+DATATYPES = ("flow", "dns", "proxy")
+
+
+@dataclass
+class LDAConfig:
+    """Topic-model hyperparameters.
+
+    Mirrors the knobs of the reference LDA engine (oni-lda-c settings +
+    the TOPIC_COUNT central-config key): K topics, Dirichlet priors, and
+    iteration counts, plus TPU-batching knobs the reference has no analog
+    for (block_size controls the token-block width of the batched
+    collapsed-Gibbs sweep).
+    """
+
+    n_topics: int = 20
+    alpha: float = 1.2          # doc-topic Dirichlet prior (lda-c style: ~50/K)
+    eta: float = 0.01           # topic-word Dirichlet prior ("beta" in lda-c)
+    n_sweeps: int = 60          # Gibbs sweeps / VB epochs
+    burn_in: int = 20           # sweeps before averaging posterior estimates
+    block_size: int = 65536     # tokens sampled per scatter round inside a sweep
+    seed: int = 0
+    # Online-VB (SVI) schedule: rho_t = (tau0 + t)^(-kappa)
+    svi_tau0: float = 64.0
+    svi_kappa: float = 0.7
+    svi_batch_size: int = 4096  # documents per SVI minibatch
+    svi_local_iters: int = 30   # local E-step fixed-point iterations
+
+    def validate(self) -> None:
+        if self.n_topics < 2:
+            raise ValueError(f"n_topics must be >=2, got {self.n_topics}")
+        if self.alpha <= 0 or self.eta <= 0:
+            raise ValueError("alpha and eta must be positive")
+        if self.block_size < 1:
+            raise ValueError("block_size must be >=1")
+        if not (0.5 < self.svi_kappa <= 1.0):
+            raise ValueError("svi_kappa must be in (0.5, 1] for convergence")
+
+
+@dataclass
+class MeshConfig:
+    """Device-mesh layout for multi-chip runs.
+
+    The reference parallelizes with MPI ranks over a machinefile of NODES
+    (SURVEY.md §2.3). onix uses a jax.sharding.Mesh with a data axis ("dp",
+    documents/tokens sharded) and a model axis ("mp", vocabulary sharded
+    when K×V outgrows one chip's HBM — SURVEY.md §5.7).
+    """
+
+    dp: int = 1                 # data-parallel axis size (documents/tokens)
+    mp: int = 1                 # model-parallel axis size (vocabulary shards)
+
+    def validate(self) -> None:
+        if self.dp < 1 or self.mp < 1:
+            raise ValueError("mesh axis sizes must be >=1")
+
+    @property
+    def n_devices(self) -> int:
+        return self.dp * self.mp
+
+
+@dataclass
+class PipelineConfig:
+    """One scoring run: a day of one datatype.
+
+    Mirrors `ml_ops.sh <YYYYMMDD> <flow|dns|proxy> [TOL] [MAXRESULTS]`
+    (SURVEY.md §3.1) plus the feedback DUPFACTOR of the OA noise-filter
+    loop (reference README.md:48).
+    """
+
+    datatype: str = "flow"
+    date: str = "2016-07-08"
+    tol: float = 1.1            # score threshold: events with score < tol survive
+    max_results: int = 2000     # top-N ascending by score emitted for OA
+    dupfactor: int = 1000       # analyst-labeled rows duplicated x this in corpus
+
+    def validate(self) -> None:
+        if self.datatype not in DATATYPES:
+            raise ValueError(f"datatype must be one of {DATATYPES}")
+        if self.max_results < 1:
+            raise ValueError("max_results must be >=1")
+        if self.dupfactor < 1:
+            raise ValueError("dupfactor must be >=1")
+
+
+@dataclass
+class StoreConfig:
+    """Storage substrate: partitioned Parquet in place of HDFS+Hive.
+
+    The reference stores telemetry in Hive tables flow/dns/proxy
+    partitioned by y/m/d(/h) (SURVEY.md §2.1 #3). onix keeps the same
+    logical layout as Parquet datasets under `root`.
+    """
+
+    root: str = "data/onix"
+    feedback_dir: str = "data/onix/feedback"
+    results_dir: str = "data/onix/results"
+    checkpoint_dir: str = "data/onix/checkpoints"
+
+
+@dataclass
+class OnixConfig:
+    lda: LDAConfig = field(default_factory=LDAConfig)
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+    pipeline: PipelineConfig = field(default_factory=PipelineConfig)
+    store: StoreConfig = field(default_factory=StoreConfig)
+
+    def validate(self) -> "OnixConfig":
+        self.lda.validate()
+        self.mesh.validate()
+        self.pipeline.validate()
+        return self
+
+    # -- serialization ----------------------------------------------------
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), indent=2, sort_keys=True)
+
+    @property
+    def config_hash(self) -> str:
+        """Stable hash identifying a resolved config (run manifests, §5.5)."""
+        return hashlib.sha256(self.to_json().encode()).hexdigest()[:16]
+
+    def archive(self, path: str | pathlib.Path) -> None:
+        """Write the resolved config next to the run outputs."""
+        p = pathlib.Path(path)
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(self.to_json())
+
+
+def _coerce(value: Any, target: type) -> Any:
+    """Coerce a raw (possibly string, from a CLI override) value to the
+    field's declared type — `pipeline.date=20160708` must stay a string."""
+    if target is str:
+        return str(value)
+    if isinstance(value, str):
+        if target is bool:
+            if value.lower() in ("true", "false"):
+                return value.lower() == "true"
+            raise ValueError(f"expected bool, got {value!r}")
+        if target in (int, float):
+            return target(value)
+    if target is float and isinstance(value, int):
+        return float(value)
+    if not isinstance(value, target):
+        raise TypeError(f"expected {target.__name__}, got {type(value).__name__}")
+    return value
+
+
+def _build(cls, data: dict[str, Any]):
+    """Recursively build a dataclass from a dict, rejecting unknown keys."""
+    import typing
+    fields = {f.name: f for f in dataclasses.fields(cls)}
+    unknown = set(data) - set(fields)
+    if unknown:
+        raise KeyError(f"unknown config keys for {cls.__name__}: {sorted(unknown)}")
+    hints = typing.get_type_hints(cls)
+    kwargs = {}
+    for name, value in data.items():
+        sub = _NESTED.get((cls, name))
+        if sub is not None:
+            kwargs[name] = _build(sub, value or {})
+        else:
+            kwargs[name] = _coerce(value, hints[name])
+    return cls(**kwargs)
+
+
+_NESTED = {
+    (OnixConfig, "lda"): LDAConfig,
+    (OnixConfig, "mesh"): MeshConfig,
+    (OnixConfig, "pipeline"): PipelineConfig,
+    (OnixConfig, "store"): StoreConfig,
+}
+
+
+def from_dict(data: dict[str, Any]) -> OnixConfig:
+    return _build(OnixConfig, data).validate()
+
+
+def load_config(path: str | pathlib.Path | None = None,
+                overrides: list[str] | None = None) -> OnixConfig:
+    """Load config from a YAML/JSON file with `a.b.c=value` CLI overrides."""
+    data: dict[str, Any] = {}
+    if path is not None:
+        text = pathlib.Path(path).read_text()
+        if str(path).endswith((".yaml", ".yml")):
+            import yaml
+            data = yaml.safe_load(text) or {}
+        else:
+            data = json.loads(text)
+    for ov in overrides or []:
+        if "=" not in ov:
+            raise ValueError(f"override must be key.path=value, got {ov!r}")
+        key, _, raw = ov.partition("=")
+        node = data
+        parts = key.split(".")
+        for part in parts[:-1]:
+            nxt = node.get(part)
+            if not isinstance(nxt, dict):   # missing, or a bare YAML null
+                nxt = {}
+                node[part] = nxt
+            node = nxt
+        # Raw string; _coerce converts it against the field's declared type.
+        node[parts[-1]] = raw
+    return from_dict(data)

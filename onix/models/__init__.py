@@ -1,0 +1,3 @@
+from onix.models.lda_gibbs import GibbsLDA, GibbsState  # noqa: F401
+from onix.models.lda_svi import SVILda, SVIState  # noqa: F401
+from onix.models.scoring import score_events, top_suspicious  # noqa: F401

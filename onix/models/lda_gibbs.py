@@ -1,0 +1,219 @@
+"""Batched collapsed-Gibbs LDA in pure JAX — the TPU replacement for
+oni-lda-c (reference README.md:84, .gitmodules absent; SURVEY.md §2.1 #10).
+
+The reference engine is a C/MPI program: documents sharded across ranks,
+a sequential per-token sampler per rank, topic-word sufficient statistics
+MPI-reduced each iteration. A token-sequential sampler cannot use a TPU,
+so onix uses the standard SIMD compromise (SURVEY.md §7.3.1, PAPERS.md
+"Sparse Partially Collapsed MCMC"): tokens are sampled in blocks of
+`block_size`; within a block every token sees counts that exclude its own
+assignment but are stale w.r.t. its block-mates; counts are exactly
+updated between blocks via scatter-add. As block_size → 1 this is exact
+collapsed Gibbs; at practical sizes the stationary distribution is close
+enough that topic recovery and the top-k overlap metric survive (tested
+in tests/test_gibbs.py).
+
+Shapes: K topics, V vocabulary, D documents, N tokens.
+State counts: n_dk [D,K], n_wk [V,K], n_k [K] (int32, exact — deltas are
+scattered as int32, never round-tripped through float32, so counts stay
+exact past 2^24 at the billion-event scale of README.md:42).
+Padding tokens carry the sentinel assignment z == K: `jax.nn.one_hot`
+maps out-of-range indices to all-zero rows, so padding contributes
+nothing to any count without a mask multiply.
+A sweep is `lax.scan` over N/block_size blocks — one fused XLA program,
+no host round-trips, no Python control flow inside jit.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from onix.config import LDAConfig
+from onix.corpus import Corpus
+
+
+class GibbsState(NamedTuple):
+    z: jax.Array          # int32 [n_blocks, B] topic per token (K = padding)
+    n_dk: jax.Array       # int32 [D, K] doc-topic counts
+    n_wk: jax.Array       # int32 [V, K] word-topic counts
+    n_k: jax.Array        # int32 [K]    topic totals
+    key: jax.Array        # PRNG key
+    # Posterior-mean accumulators (populated after burn-in; improves the
+    # rank stability needed for the judged top-k overlap, SURVEY.md §7.3.2).
+    acc_ndk: jax.Array    # float32 [D, K]
+    acc_nwk: jax.Array    # float32 [V, K]
+    n_acc: jax.Array      # int32 [] number of accumulated sweeps
+
+
+def _one_hot(z: jax.Array, k: int) -> jax.Array:
+    """int32 one-hot; out-of-range z (the padding sentinel K) -> zero row."""
+    return jax.nn.one_hot(z, k, dtype=jnp.int32)
+
+
+def init_state(
+    doc_blocks: jax.Array,
+    word_blocks: jax.Array,
+    mask_blocks: jax.Array,
+    n_docs: int,
+    n_vocab: int,
+    n_topics: int,
+    seed: int,
+) -> GibbsState:
+    """Random topic init + exact count build via one scatter pass."""
+    key = jax.random.PRNGKey(seed)
+    key, zkey = jax.random.split(key)
+    shape = doc_blocks.shape
+    z = jax.random.randint(zkey, shape, 0, n_topics, dtype=jnp.int32)
+    z = jnp.where(mask_blocks > 0, z, n_topics)   # sentinel for padding
+    flat_oh = _one_hot(z, n_topics).reshape(-1, n_topics)
+    n_dk = jnp.zeros((n_docs, n_topics), jnp.int32).at[
+        doc_blocks.reshape(-1)].add(flat_oh)
+    n_wk = jnp.zeros((n_vocab, n_topics), jnp.int32).at[
+        word_blocks.reshape(-1)].add(flat_oh)
+    n_k = flat_oh.sum(axis=0, dtype=jnp.int32)
+    return GibbsState(
+        z=z, n_dk=n_dk, n_wk=n_wk, n_k=n_k, key=key,
+        acc_ndk=jnp.zeros((n_docs, n_topics), jnp.float32),
+        acc_nwk=jnp.zeros((n_vocab, n_topics), jnp.float32),
+        n_acc=jnp.zeros((), jnp.int32),
+    )
+
+
+def sweep(
+    state: GibbsState,
+    doc_blocks: jax.Array,   # int32 [n_blocks, B]
+    word_blocks: jax.Array,  # int32 [n_blocks, B]
+    mask_blocks: jax.Array,  # float32 [n_blocks, B]
+    *,
+    alpha: float,
+    eta: float,
+    n_vocab: int,
+    accumulate: bool,
+) -> GibbsState:
+    """One full Gibbs sweep over all token blocks (jit-friendly)."""
+    k_topics = state.n_dk.shape[1]
+    v_eta = n_vocab * eta
+
+    def block_step(carry, xs):
+        n_dk, n_wk, n_k, key = carry
+        d, w, m, z_old = xs
+        key, skey = jax.random.split(key)
+        oh_old = _one_hot(z_old, k_topics)          # zero row for padding
+        ohf = oh_old.astype(jnp.float32)
+        # Counts excluding each token's own current assignment.
+        ndk = n_dk[d].astype(jnp.float32) - ohf
+        nwk = n_wk[w].astype(jnp.float32) - ohf
+        nk = n_k.astype(jnp.float32)[None, :] - ohf
+        logp = (jnp.log(ndk + alpha)
+                + jnp.log(jnp.maximum(nwk + eta, 1e-10))
+                - jnp.log(nk + v_eta))
+        g = jax.random.gumbel(skey, logp.shape, dtype=jnp.float32)
+        z_new = jnp.argmax(logp + g, axis=-1).astype(jnp.int32)
+        z_new = jnp.where(m > 0, z_new, z_old)      # padding keeps sentinel
+        delta = _one_hot(z_new, k_topics) - oh_old  # int32-exact update
+        n_dk = n_dk.at[d].add(delta)
+        n_wk = n_wk.at[w].add(delta)
+        n_k = n_k + delta.sum(axis=0, dtype=jnp.int32)
+        return (n_dk, n_wk, n_k, key), z_new
+
+    (n_dk, n_wk, n_k, key), z = jax.lax.scan(
+        block_step,
+        (state.n_dk, state.n_wk, state.n_k, state.key),
+        (doc_blocks, word_blocks, mask_blocks, state.z),
+    )
+    do_acc = jnp.float32(accumulate)
+    return GibbsState(
+        z=z, n_dk=n_dk, n_wk=n_wk, n_k=n_k, key=key,
+        acc_ndk=state.acc_ndk + do_acc * n_dk.astype(jnp.float32),
+        acc_nwk=state.acc_nwk + do_acc * n_wk.astype(jnp.float32),
+        n_acc=state.n_acc + jnp.int32(accumulate),
+    )
+
+
+def posterior_estimates(
+    state: GibbsState, *, alpha: float, eta: float
+) -> tuple[jax.Array, jax.Array]:
+    """(theta [D,K], phi_wk [V,K]) from averaged (or instantaneous) counts."""
+    use_acc = state.n_acc > 0
+    denom = jnp.maximum(state.n_acc.astype(jnp.float32), 1.0)
+    ndk = jnp.where(use_acc, state.acc_ndk / denom, state.n_dk.astype(jnp.float32))
+    nwk = jnp.where(use_acc, state.acc_nwk / denom, state.n_wk.astype(jnp.float32))
+    theta = (ndk + alpha) / (ndk.sum(-1, keepdims=True) + ndk.shape[1] * alpha)
+    nk = nwk.sum(axis=0, keepdims=True)
+    phi_wk = (nwk + eta) / (nk + nwk.shape[0] * eta)
+    return theta, phi_wk
+
+
+def log_likelihood(
+    theta: jax.Array, phi_wk: jax.Array,
+    doc_blocks: jax.Array, word_blocks: jax.Array, mask_blocks: jax.Array,
+) -> jax.Array:
+    """Mean per-token log p(w|d) — the convergence series the reference
+    prints to likelihood.dat (SURVEY.md §5.4)."""
+    p = jnp.sum(theta[doc_blocks] * phi_wk[word_blocks], axis=-1)
+    lp = jnp.log(jnp.maximum(p, 1e-30)) * mask_blocks
+    return lp.sum() / jnp.maximum(mask_blocks.sum(), 1.0)
+
+
+class GibbsLDA:
+    """Host-side driver around the functional kernel.
+
+    Equivalent role to oni-lda-c's `lda estimate` entry point, but runs
+    in-process on the accelerator instead of via ssh + mpiexec
+    (SURVEY.md §3.1 hot loop #2).
+    """
+
+    def __init__(self, config: LDAConfig, n_docs: int, n_vocab: int):
+        config.validate()
+        self.config = config
+        self.n_docs = n_docs
+        self.n_vocab = n_vocab
+        self._sweep = jax.jit(functools.partial(
+            sweep, alpha=config.alpha, eta=config.eta, n_vocab=n_vocab,
+        ), static_argnames=("accumulate",))
+        self._estimates = jax.jit(functools.partial(
+            posterior_estimates, alpha=config.alpha, eta=config.eta))
+        self._ll = jax.jit(log_likelihood)
+
+    def prepare(self, corpus: Corpus, shuffle: bool = True):
+        if shuffle:
+            corpus = corpus.shuffled(self.config.seed)
+        block = min(self.config.block_size, max(corpus.n_tokens, 1))
+        padded, mask = corpus.padded(block)
+        nb = padded.n_tokens // block
+        return (
+            jnp.asarray(padded.doc_ids.reshape(nb, block)),
+            jnp.asarray(padded.word_ids.reshape(nb, block)),
+            jnp.asarray(mask.reshape(nb, block)),
+        )
+
+    def fit(self, corpus: Corpus, n_sweeps: int | None = None,
+            callback=None) -> dict:
+        cfg = self.config
+        n_sweeps = cfg.n_sweeps if n_sweeps is None else n_sweeps
+        docs, words, mask = self.prepare(corpus)
+        state = init_state(docs, words, mask, self.n_docs, self.n_vocab,
+                           cfg.n_topics, cfg.seed)
+        theta0, phi0 = self._estimates(state)
+        ll_history = [(-1, float(self._ll(theta0, phi0, docs, words, mask)))]
+        for s in range(n_sweeps):
+            state = self._sweep(state, docs, words, mask,
+                                accumulate=s >= cfg.burn_in)
+            if callback is not None or s == n_sweeps - 1 or s % 10 == 9:
+                theta, phi_wk = self._estimates(state)
+                ll = float(self._ll(theta, phi_wk, docs, words, mask))
+                ll_history.append((s, ll))
+                if callback is not None:
+                    callback(s, state, ll)
+        theta, phi_wk = self._estimates(state)
+        return {
+            "state": state,
+            "theta": np.asarray(theta),
+            "phi_wk": np.asarray(phi_wk),   # [V,K]; phi[k,v] = phi_wk[v,k]
+            "ll_history": ll_history,
+        }

@@ -1,0 +1,155 @@
+"""Online variational Bayes (SVI) LDA — the streaming engine.
+
+Covers BASELINE.json configs[4]: "streaming online-VB LDA over
+oni-ingest minibatches (incremental scoring)". The reference has no
+streaming ML at all — oni-lda-c re-fits from scratch each day
+(SURVEY.md §3.1); onix adds the stochastic variational inference of
+Hoffman et al. (per PAPERS.md "Stochastic Collapsed Variational Bayesian
+Inference for LDA"): each minibatch of ingested events performs a local
+E-step on its documents and a natural-gradient step on the global
+topic-word variational parameter lambda.
+
+Everything is fixed-shape and jit-compiled: the local E-step is a
+`lax.fori_loop` of dense [T,K] updates (K=20 rides the VPU), the global
+update a scatter-add into lambda [V,K].
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from onix.config import LDAConfig
+
+
+class SVIState(NamedTuple):
+    lam: jax.Array       # float32 [V, K] topic-word variational parameter
+    step: jax.Array      # int32 [] global update counter
+
+
+class MiniBatch(NamedTuple):
+    """A minibatch of token events, documents re-indexed densely [0, Bd).
+
+    Both the token axis and the document axis are padded to static sizes
+    so a stream of differently-shaped minibatches hits one compiled
+    svi_step (no per-batch retrace). `doc_map[i]` recovers the original
+    document (IP) id of local doc i (-1 for padding rows) — gamma rows
+    are meaningless without it.
+    """
+    doc_ids: jax.Array   # int32 [T] local-dense doc index per token
+    word_ids: jax.Array  # int32 [T]
+    mask: jax.Array      # float32 [T] 1.0 for real tokens
+    doc_map: jax.Array   # int32 [Bd] local doc -> original doc id (-1 pad)
+    n_docs: int          # Bd (padded) — static
+
+
+def make_minibatch(doc_ids: np.ndarray, word_ids: np.ndarray,
+                   pad_to: int | None = None,
+                   pad_docs: int | None = None) -> MiniBatch:
+    """Densify document ids; pad tokens to `pad_to` and docs to `pad_docs`."""
+    uniq, local = np.unique(np.asarray(doc_ids), return_inverse=True)
+    t = len(local)
+    pad_to = pad_to or t
+    if pad_to < t:
+        raise ValueError("pad_to smaller than batch")
+    n_docs = pad_docs if pad_docs is not None else len(uniq)
+    if n_docs < len(uniq):
+        raise ValueError("pad_docs smaller than distinct docs in batch")
+    rem = pad_to - t
+    doc_map = np.full(n_docs, -1, np.int32)
+    doc_map[: len(uniq)] = uniq
+    return MiniBatch(
+        doc_ids=jnp.asarray(np.concatenate([local.astype(np.int32),
+                                            np.zeros(rem, np.int32)])),
+        word_ids=jnp.asarray(np.concatenate([np.asarray(word_ids, np.int32),
+                                             np.zeros(rem, np.int32)])),
+        mask=jnp.asarray(np.concatenate([np.ones(t, np.float32),
+                                         np.zeros(rem, np.float32)])),
+        doc_map=jnp.asarray(doc_map),
+        n_docs=int(n_docs),
+    )
+
+
+def init_state(n_vocab: int, n_topics: int, seed: int = 0) -> SVIState:
+    key = jax.random.PRNGKey(seed)
+    lam = jax.random.gamma(key, 100.0, (n_vocab, n_topics)) * 0.01
+    return SVIState(lam=lam.astype(jnp.float32), step=jnp.zeros((), jnp.int32))
+
+
+def _e_log_dirichlet(x: jax.Array, axis: int) -> jax.Array:
+    return jax.scipy.special.digamma(x) - jax.scipy.special.digamma(
+        x.sum(axis=axis, keepdims=True))
+
+
+def svi_step(
+    state: SVIState,
+    batch: MiniBatch,
+    *,
+    alpha: float,
+    eta: float,
+    corpus_docs: float,      # D — total docs the stream represents
+    tau0: float,
+    kappa: float,
+    local_iters: int,
+    batch_docs: int,         # static Bd for gamma shape
+) -> tuple[SVIState, jax.Array]:
+    """One SVI update. Returns (new_state, gamma [Bd,K]) for scoring."""
+    k = state.lam.shape[1]
+    elog_beta = _e_log_dirichlet(state.lam, axis=0)      # [V,K]
+    elog_beta_t = elog_beta[batch.word_ids]              # [T,K]
+
+    def local_iter(_, gamma):
+        elog_theta = _e_log_dirichlet(gamma, axis=1)     # [Bd,K]
+        logp = elog_theta[batch.doc_ids] + elog_beta_t   # [T,K]
+        phi = jax.nn.softmax(logp, axis=-1) * batch.mask[:, None]
+        gamma = alpha + jnp.zeros_like(gamma).at[batch.doc_ids].add(phi)
+        return gamma
+
+    gamma0 = jnp.full((batch_docs, k), alpha + 1.0, jnp.float32)
+    gamma = jax.lax.fori_loop(0, local_iters, local_iter, gamma0)
+
+    # Final responsibilities under converged gamma.
+    elog_theta = _e_log_dirichlet(gamma, axis=1)
+    phi = jax.nn.softmax(elog_theta[batch.doc_ids] + elog_beta_t, axis=-1)
+    phi = phi * batch.mask[:, None]
+
+    # Natural-gradient step on lambda, scaled to the full corpus by the
+    # number of REAL documents in the batch (doc_map == -1 rows are padding).
+    n_real = (batch.doc_map >= 0).sum().astype(jnp.float32)
+    scale = corpus_docs / jnp.maximum(n_real, 1.0)
+    lam_hat = eta + scale * jnp.zeros_like(state.lam).at[batch.word_ids].add(phi)
+    rho = (tau0 + state.step.astype(jnp.float32)) ** (-kappa)
+    lam = (1.0 - rho) * state.lam + rho * lam_hat
+    return SVIState(lam=lam, step=state.step + 1), gamma
+
+
+def phi_estimate(state: SVIState) -> jax.Array:
+    """Posterior-mean topic-word distribution phi_wk [V,K]."""
+    return state.lam / state.lam.sum(axis=0, keepdims=True)
+
+
+class SVILda:
+    """Driver for streaming fits over ingest minibatches."""
+
+    def __init__(self, config: LDAConfig, n_vocab: int, corpus_docs: int):
+        config.validate()
+        self.config = config
+        self.n_vocab = n_vocab
+        self.corpus_docs = corpus_docs
+        self._step = jax.jit(functools.partial(
+            svi_step,
+            alpha=config.alpha, eta=config.eta,
+            corpus_docs=float(corpus_docs),
+            tau0=config.svi_tau0, kappa=config.svi_kappa,
+            local_iters=config.svi_local_iters,
+        ), static_argnames=("batch_docs",))
+
+    def init(self) -> SVIState:
+        return init_state(self.n_vocab, self.config.n_topics, self.config.seed)
+
+    def update(self, state: SVIState, batch: MiniBatch):
+        return self._step(state, batch, batch_docs=batch.n_docs)

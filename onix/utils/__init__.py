@@ -1,0 +1,8 @@
+from onix.utils.features import (  # noqa: F401
+    shannon_entropy,
+    entropy_array,
+    quantile_edges,
+    digitize,
+    subdomain_split,
+    VALID_TLDS,
+)

@@ -1,0 +1,82 @@
+"""Feature utilities shared by the word-creation pipelines.
+
+The reference computes these in Scala UDFs inside Spark jobs — string
+entropy and subdomain decomposition for DNS words, quantile binning for
+flow words (SURVEY.md §2.1 #5-#7). onix implements them vectorized over
+NumPy arrays so a day of telemetry is transformed without a JVM, and the
+bin edges become static metadata the TPU scoring path can reuse.
+"""
+
+from __future__ import annotations
+
+import math
+from collections import Counter
+
+import numpy as np
+
+# A practical set of real TLDs for the DNS "valid TLD" feature
+# (SURVEY.md §2.1 #6: "TLD validity"). The reference carried a
+# top-domains list file; a compact builtin set avoids a data dependency.
+VALID_TLDS = frozenset("""
+com org net edu gov mil int io co us uk de fr jp cn ru br in au ca it nl
+es se no fi dk pl ch at be cz pt gr hu ie ro sk bg hr lt lv ee si lu mt
+cy tr ua by kz mx ar cl pe ve uy py bo ec cr pa do gt hn sv ni cu jm tt
+za eg ma ng ke gh tz ug dz tn ly sn zm zw mz ao cm ci
+kr tw hk sg my th vn ph id pk bd lk np mm kh la mn
+il sa ae qa kw bh om jo lb sy iq ir ye af
+nz fj pg info biz name mobi aero asia cat coop jobs museum pro tel
+travel xxx arpa root local onion test example invalid localhost
+""".split())
+
+
+def shannon_entropy(s: str) -> float:
+    """Character-distribution Shannon entropy in bits (0.0 for empty)."""
+    if not s:
+        return 0.0
+    n = len(s)
+    return -sum(c / n * math.log2(c / n) for c in Counter(s).values())
+
+
+def entropy_array(strings) -> np.ndarray:
+    """Vectorized `shannon_entropy` over an iterable of strings."""
+    return np.asarray([shannon_entropy(s) for s in strings], dtype=np.float32)
+
+
+def quantile_edges(values: np.ndarray, n_bins: int) -> np.ndarray:
+    """Interior quantile cut points (n_bins - 1 edges) for equal-mass bins.
+
+    The flow word binning of the reference (SURVEY.md §2.1 #5:
+    "quantile-binned bytes, packets, and time-of-day").
+    """
+    if n_bins < 1:
+        raise ValueError("n_bins must be >= 1")
+    qs = np.linspace(0.0, 1.0, n_bins + 1)[1:-1]
+    values = np.asarray(values, dtype=np.float64)
+    if values.size == 0:
+        return np.zeros(n_bins - 1, dtype=np.float64)
+    return np.quantile(values, qs)
+
+
+def digitize(values: np.ndarray, edges: np.ndarray) -> np.ndarray:
+    """Bin index in [0, len(edges)] per value (right-open bins)."""
+    return np.searchsorted(np.asarray(edges), np.asarray(values),
+                           side="right").astype(np.int32)
+
+
+def subdomain_split(qname: str) -> tuple[str, str, int, bool]:
+    """Decompose a DNS query name.
+
+    Returns (subdomain, second_level_domain, n_labels, tld_is_valid).
+    `www.mail.example.com` -> ("www.mail", "example", 4, True).
+    """
+    name = qname.rstrip(".").lower()
+    if not name:
+        return "", "", 0, False
+    labels = name.split(".")
+    n = len(labels)
+    tld_valid = labels[-1] in VALID_TLDS
+    if n == 1:
+        return "", labels[0], 1, tld_valid
+    sld = labels[-2]
+    sub = ".".join(labels[:-2])
+    return sub, sld, n, tld_valid

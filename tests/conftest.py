@@ -1,0 +1,41 @@
+"""Test bootstrap: force an 8-device virtual CPU mesh before JAX imports.
+
+The reference had no way to test distributed behavior without a real
+cluster (SURVEY.md §4 "Multi-node without a cluster: not solved by the
+reference"). onix tests every sharded path on fake devices
+(SURVEY.md §4.3).
+"""
+
+import os
+
+# Force CPU: the ambient environment pins JAX_PLATFORMS=axon (the tunneled
+# TPU), which must never be touched from unit tests. The env var alone is
+# NOT enough — a sitecustomize module imports jax at interpreter startup,
+# before this conftest runs, so jax has already captured JAX_PLATFORMS.
+# Update both the env (for subprocesses) and the live jax config.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", False)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def eight_devices():
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs 8 virtual devices")
+    return devs[:8]
+
+
+@pytest.fixture()
+def rng():
+    return np.random.default_rng(0)

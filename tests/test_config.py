@@ -1,0 +1,53 @@
+import json
+
+import pytest
+
+from onix.config import (LDAConfig, OnixConfig, from_dict, load_config)
+
+
+def test_defaults_validate():
+    cfg = OnixConfig().validate()
+    assert cfg.lda.n_topics == 20
+    assert cfg.pipeline.datatype == "flow"
+
+
+def test_unknown_key_rejected():
+    with pytest.raises(KeyError):
+        from_dict({"lda": {"bogus": 1}})
+
+
+def test_invalid_values_rejected():
+    with pytest.raises(ValueError):
+        from_dict({"lda": {"n_topics": 1}})
+    with pytest.raises(ValueError):
+        from_dict({"pipeline": {"datatype": "netbios"}})
+
+
+def test_load_with_overrides(tmp_path):
+    p = tmp_path / "c.json"
+    p.write_text(json.dumps({"lda": {"n_topics": 10}}))
+    cfg = load_config(p, overrides=["lda.alpha=0.3", "pipeline.date=2016-07-08",
+                                    "mesh.dp=4"])
+    assert cfg.lda.n_topics == 10
+    assert cfg.lda.alpha == 0.3
+    assert cfg.mesh.dp == 4
+
+
+def test_yaml_roundtrip_and_hash(tmp_path):
+    import yaml
+    p = tmp_path / "c.yaml"
+    p.write_text(yaml.safe_dump({"lda": {"seed": 7}}))
+    cfg = load_config(p)
+    assert cfg.lda.seed == 7
+    h1 = cfg.config_hash
+    cfg2 = load_config(p)
+    assert h1 == cfg2.config_hash
+    cfg2.lda.seed = 8
+    assert cfg2.config_hash != h1
+
+
+def test_archive(tmp_path):
+    cfg = OnixConfig()
+    out = tmp_path / "runs" / "resolved.json"
+    cfg.archive(out)
+    assert json.loads(out.read_text())["lda"]["n_topics"] == 20

@@ -1,0 +1,61 @@
+import numpy as np
+
+from onix.corpus import Corpus, SparseCounts, anomaly_corpus, synthetic_lda_corpus
+
+
+def test_token_count_roundtrip():
+    c = Corpus(doc_ids=[0, 0, 1, 2, 2, 2], word_ids=[3, 3, 1, 0, 0, 2],
+               n_docs=3, n_vocab=4)
+    sc = c.to_doc_word_counts()
+    assert sc.n_tokens == c.n_tokens
+    back = sc.to_tokens()
+    # Same multiset of (doc, word) pairs.
+    a = sorted(zip(c.doc_ids.tolist(), c.word_ids.tolist()))
+    b = sorted(zip(back.doc_ids.tolist(), back.word_ids.tolist()))
+    assert a == b
+
+
+def test_ldac_format_roundtrip(tmp_path):
+    c, _, _ = synthetic_lda_corpus(20, 50, 3, mean_doc_len=30, seed=1)
+    sc = c.to_doc_word_counts()
+    p = tmp_path / "corpus.dat"
+    sc.write_ldac(p)
+    sc2 = SparseCounts.read_ldac(p, n_vocab=50)
+    assert sc2.n_docs == sc.n_docs
+    np.testing.assert_array_equal(
+        np.sort(sc.doc_ids * 50 + sc.word_ids),
+        np.sort(sc2.doc_ids * 50 + sc2.word_ids))
+    assert sc2.n_tokens == sc.n_tokens
+
+
+def test_padding_and_mask():
+    c, _, _ = synthetic_lda_corpus(5, 20, 2, mean_doc_len=10, seed=0)
+    padded, mask = c.padded(64)
+    assert padded.n_tokens % 64 == 0
+    assert int(mask.sum()) == c.n_tokens
+
+
+def test_synthetic_shapes_and_distributions():
+    c, theta, phi = synthetic_lda_corpus(100, 200, 4, mean_doc_len=50, seed=3)
+    assert theta.shape == (100, 4) and phi.shape == (4, 200)
+    np.testing.assert_allclose(theta.sum(1), 1.0, atol=1e-9)
+    np.testing.assert_allclose(phi.sum(1), 1.0, atol=1e-9)
+    assert c.word_ids.max() < 200 and c.doc_ids.max() < 100
+    # Empirical word marginal should correlate with the model marginal.
+    emp = np.bincount(c.word_ids, minlength=200) / c.n_tokens
+    model = (theta.mean(0) @ phi)
+    corr = np.corrcoef(emp, model)[0, 1]
+    assert corr > 0.8
+
+
+def test_anomaly_corpus_plants_rare_words():
+    c, idx = anomaly_corpus(seed=2)
+    assert len(idx) == 25
+    assert np.all(idx < c.n_tokens)
+
+
+def test_shuffle_preserves_content():
+    c, _, _ = synthetic_lda_corpus(10, 30, 2, seed=4)
+    s = c.shuffled(1)
+    assert sorted(zip(c.doc_ids.tolist(), c.word_ids.tolist())) == \
+        sorted(zip(s.doc_ids.tolist(), s.word_ids.tolist()))

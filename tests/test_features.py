@@ -1,0 +1,50 @@
+import math
+
+import numpy as np
+from hypothesis import given, strategies as st
+
+from onix.utils import (digitize, entropy_array, quantile_edges,
+                        shannon_entropy, subdomain_split)
+
+
+def test_entropy_known_values():
+    assert shannon_entropy("") == 0.0
+    assert shannon_entropy("aaaa") == 0.0
+    assert abs(shannon_entropy("ab") - 1.0) < 1e-12
+    assert abs(shannon_entropy("abcd") - 2.0) < 1e-12
+
+
+@given(st.text(min_size=0, max_size=64))
+def test_entropy_bounds(s):
+    h = shannon_entropy(s)
+    assert 0.0 <= h <= math.log2(max(len(set(s)), 1)) + 1e-9
+
+
+def test_entropy_array():
+    out = entropy_array(["ab", "aaaa"])
+    assert out.shape == (2,)
+    assert abs(out[0] - 1.0) < 1e-6 and out[1] == 0.0
+
+
+def test_quantile_binning_equal_mass():
+    v = np.arange(1000, dtype=np.float64)
+    edges = quantile_edges(v, 4)
+    bins = digitize(v, edges)
+    counts = np.bincount(bins, minlength=4)
+    assert counts.min() > 200  # roughly equal mass
+
+
+def test_digitize_edges():
+    edges = np.array([10.0, 20.0])
+    np.testing.assert_array_equal(
+        digitize(np.array([5, 10, 15, 20, 25]), edges), [0, 1, 1, 2, 2])
+
+
+def test_subdomain_split():
+    sub, sld, n, valid = subdomain_split("www.mail.example.com")
+    assert (sub, sld, n, valid) == ("www.mail", "example", 4, True)
+    sub, sld, n, valid = subdomain_split("example.zzz")
+    assert valid is False and sld == "example"
+    sub, sld, n, valid = subdomain_split("localhost")
+    assert n == 1 and sld == "localhost"
+    assert subdomain_split("")[2] == 0

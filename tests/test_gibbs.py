@@ -1,0 +1,76 @@
+"""Numerical tests for the batched collapsed-Gibbs engine (SURVEY.md §4.2)."""
+
+import numpy as np
+import pytest
+from scipy.optimize import linear_sum_assignment
+
+from onix.config import LDAConfig
+from onix.corpus import synthetic_lda_corpus
+from onix.models.lda_gibbs import GibbsLDA
+
+
+def _topic_alignment_similarity(phi_true, phi_est):
+    """Mean cosine similarity after Hungarian topic matching."""
+    k = phi_true.shape[0]
+    a = phi_true / np.linalg.norm(phi_true, axis=1, keepdims=True)
+    b = phi_est / np.linalg.norm(phi_est, axis=1, keepdims=True)
+    sim = a @ b.T
+    r, c = linear_sum_assignment(-sim)
+    return sim[r, c].mean()
+
+
+@pytest.fixture(scope="module")
+def small_fit():
+    corpus, theta, phi = synthetic_lda_corpus(
+        n_docs=150, n_vocab=120, n_topics=5, mean_doc_len=80,
+        alpha=0.2, eta=0.05, seed=0)
+    cfg = LDAConfig(n_topics=5, alpha=0.5, eta=0.05, n_sweeps=50,
+                    burn_in=25, block_size=2048, seed=0)
+    model = GibbsLDA(cfg, corpus.n_docs, corpus.n_vocab)
+    result = model.fit(corpus)
+    return corpus, theta, phi, cfg, result
+
+
+def test_count_invariants(small_fit):
+    corpus, _, _, _, result = small_fit
+    st = result["state"]
+    n = corpus.n_tokens
+    assert int(np.asarray(st.n_k).sum()) == n
+    assert int(np.asarray(st.n_dk).sum()) == n
+    assert int(np.asarray(st.n_wk).sum()) == n
+    assert np.asarray(st.n_dk).min() >= 0
+    assert np.asarray(st.n_wk).min() >= 0
+    # Per-doc counts must equal doc lengths exactly.
+    np.testing.assert_array_equal(
+        np.asarray(st.n_dk).sum(axis=1),
+        corpus.doc_lengths())
+
+
+def test_topic_recovery(small_fit):
+    _, _, phi_true, _, result = small_fit
+    phi_est = result["phi_wk"].T  # [K,V]
+    sim = _topic_alignment_similarity(phi_true, phi_est)
+    assert sim > 0.85, f"topic recovery too weak: {sim:.3f}"
+
+
+def test_likelihood_improves(small_fit):
+    _, _, _, _, result = small_fit
+    lls = [ll for _, ll in result["ll_history"]]
+    assert lls[-1] > lls[0] + 0.1, f"log-likelihood did not improve: {lls}"
+
+
+def test_estimates_are_distributions(small_fit):
+    _, _, _, _, result = small_fit
+    theta, phi_wk = result["theta"], result["phi_wk"]
+    np.testing.assert_allclose(theta.sum(1), 1.0, atol=1e-4)
+    np.testing.assert_allclose(phi_wk.sum(0), 1.0, atol=1e-4)
+
+
+def test_determinism():
+    corpus, _, _ = synthetic_lda_corpus(30, 40, 3, mean_doc_len=20, seed=1)
+    cfg = LDAConfig(n_topics=3, n_sweeps=5, burn_in=2, block_size=256, seed=9)
+    r1 = GibbsLDA(cfg, corpus.n_docs, corpus.n_vocab).fit(corpus)
+    r2 = GibbsLDA(cfg, corpus.n_docs, corpus.n_vocab).fit(corpus)
+    np.testing.assert_array_equal(np.asarray(r1["state"].z),
+                                  np.asarray(r2["state"].z))
+    np.testing.assert_allclose(r1["phi_wk"], r2["phi_wk"], rtol=1e-6)

@@ -1,0 +1,46 @@
+import numpy as np
+
+from onix.config import LDAConfig
+from onix.corpus import synthetic_lda_corpus
+from onix.models.lda_svi import SVILda, make_minibatch, phi_estimate
+from tests.test_gibbs import _topic_alignment_similarity
+
+
+def test_svi_recovers_topics_from_minibatches():
+    corpus, _, phi_true = synthetic_lda_corpus(
+        n_docs=300, n_vocab=100, n_topics=4, mean_doc_len=60,
+        alpha=0.2, eta=0.05, seed=0)
+    cfg = LDAConfig(n_topics=4, alpha=0.3, eta=0.05, svi_tau0=16.0,
+                    svi_kappa=0.7, svi_local_iters=25, seed=0)
+    model = SVILda(cfg, corpus.n_vocab, corpus_docs=corpus.n_docs)
+    state = model.init()
+    # Stream documents in batches of 30; 3 epochs.
+    order = np.argsort(corpus.doc_ids, kind="stable")
+    d, w = corpus.doc_ids[order], corpus.word_ids[order]
+    for _ in range(3):
+        for lo in range(0, corpus.n_docs, 30):
+            sel = (d >= lo) & (d < lo + 30)
+            batch = make_minibatch(d[sel], w[sel], pad_to=4096)
+            state, _ = model.update(state, batch)
+    phi_est = np.asarray(phi_estimate(state)).T
+    sim = _topic_alignment_similarity(phi_true, phi_est)
+    assert sim > 0.8, f"SVI topic recovery too weak: {sim:.3f}"
+
+
+def test_minibatch_padding_and_densify():
+    b = make_minibatch(np.array([7, 7, 9]), np.array([1, 2, 3]), pad_to=8)
+    assert b.n_docs == 2
+    assert b.doc_ids.shape == (8,)
+    assert float(b.mask.sum()) == 3.0
+    assert int(b.doc_ids[0]) == 0 and int(b.doc_ids[2]) == 1
+
+
+def test_gamma_shapes():
+    cfg = LDAConfig(n_topics=3)
+    model = SVILda(cfg, n_vocab=50, corpus_docs=100)
+    state = model.init()
+    b = make_minibatch(np.array([0, 1, 1]), np.array([4, 5, 6]), pad_to=16)
+    state2, gamma = model.update(state, b)
+    assert gamma.shape == (2, 3)
+    assert int(state2.step) == 1
+    assert np.all(np.isfinite(np.asarray(state2.lam)))
