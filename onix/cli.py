@@ -74,6 +74,7 @@ def main(argv: list[str] | None = None) -> int:
             cfg.pipeline.tol = args.tol
         if args.max_results is not None:
             cfg.pipeline.max_results = args.max_results
+        cfg.validate()          # re-check: flags bypass load_config's pass
         from onix.pipelines.run import run_scoring
         return run_scoring(cfg, engine=args.engine)
 

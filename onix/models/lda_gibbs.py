@@ -84,19 +84,14 @@ def init_state(
     )
 
 
-def sweep(
-    state: GibbsState,
-    doc_blocks: jax.Array,   # int32 [n_blocks, B]
-    word_blocks: jax.Array,  # int32 [n_blocks, B]
-    mask_blocks: jax.Array,  # float32 [n_blocks, B]
-    *,
-    alpha: float,
-    eta: float,
-    n_vocab: int,
-    accumulate: bool,
-) -> GibbsState:
-    """One full Gibbs sweep over all token blocks (jit-friendly)."""
-    k_topics = state.n_dk.shape[1]
+def make_block_step(*, alpha: float, eta: float, n_vocab: int,
+                    k_topics: int):
+    """The collapsed-Gibbs block sampler shared by the single-device and
+    sharded engines — one definition so the documented dp=1 equivalence
+    can never silently diverge.
+
+    carry = (n_dk, n_wk, n_k, key); xs = (docs, words, mask, z_old).
+    """
     v_eta = n_vocab * eta
 
     def block_step(carry, xs):
@@ -120,6 +115,25 @@ def sweep(
         n_wk = n_wk.at[w].add(delta)
         n_k = n_k + delta.sum(axis=0, dtype=jnp.int32)
         return (n_dk, n_wk, n_k, key), z_new
+
+    return block_step
+
+
+def sweep(
+    state: GibbsState,
+    doc_blocks: jax.Array,   # int32 [n_blocks, B]
+    word_blocks: jax.Array,  # int32 [n_blocks, B]
+    mask_blocks: jax.Array,  # float32 [n_blocks, B]
+    *,
+    alpha: float,
+    eta: float,
+    n_vocab: int,
+    accumulate: bool,
+) -> GibbsState:
+    """One full Gibbs sweep over all token blocks (jit-friendly)."""
+    k_topics = state.n_dk.shape[1]
+    block_step = make_block_step(alpha=alpha, eta=eta, n_vocab=n_vocab,
+                                 k_topics=k_topics)
 
     (n_dk, n_wk, n_k, key), z = jax.lax.scan(
         block_step,

@@ -53,7 +53,7 @@ def make_minibatch(doc_ids: np.ndarray, word_ids: np.ndarray,
     """Densify document ids; pad tokens to `pad_to` and docs to `pad_docs`."""
     uniq, local = np.unique(np.asarray(doc_ids), return_inverse=True)
     t = len(local)
-    pad_to = pad_to or t
+    pad_to = t if pad_to is None else pad_to
     if pad_to < t:
         raise ValueError("pad_to smaller than batch")
     n_docs = pad_docs if pad_docs is not None else len(uniq)

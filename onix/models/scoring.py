@@ -32,7 +32,8 @@ def score_events(theta: jax.Array, phi_wk: jax.Array,
 
 class TopK(NamedTuple):
     scores: jax.Array   # float32 [M] ascending-suspicious (smallest first)
-    indices: jax.Array  # int32 [M] global event index
+    indices: jax.Array  # int32 [M] global event index; -1 where fewer than
+    #                     M events qualified (score is +inf there)
 
 
 @functools.partial(jax.jit, static_argnames=("max_results", "chunk"))
@@ -83,7 +84,12 @@ def top_suspicious(
     (scores, indices), _ = jax.lax.scan(
         step, init, (d, w, m, jnp.arange(n_chunks, dtype=jnp.int32)))
     order = jnp.argsort(scores)
-    return TopK(scores=scores[order], indices=indices[order])
+    scores, indices = scores[order], indices[order]
+    # Unfilled slots (fewer than max_results qualifying events) carry +inf
+    # scores; force their indices to the -1 sentinel so a consumer can
+    # never gather a real event row through a padding slot.
+    indices = jnp.where(jnp.isfinite(scores), indices, -1)
+    return TopK(scores=scores, indices=indices)
 
 
 _score_events_jit = jax.jit(score_events)

@@ -1,0 +1,2 @@
+from onix.parallel.mesh import make_mesh, DP_AXIS, MP_AXIS  # noqa: F401
+from onix.parallel.sharded_gibbs import ShardedGibbsLDA  # noqa: F401

@@ -1,0 +1,66 @@
+"""Device-mesh construction and sharding helpers.
+
+The reference's distributed backend is MPI + ssh + a shared filesystem
+(SURVEY.md §2.3): ranks are launched by `mpiexec -machinefile NODES`,
+collectives are MPI_Reduce/MPI_Bcast of dense K×V float matrices. The
+TPU-native equivalent is a `jax.sharding.Mesh` over the pod slice with
+XLA collectives over ICI — `psum` replaces MPI_Reduce+Bcast, and there
+is no launcher because the TPU multi-host runtime (jax.distributed)
+owns process placement.
+
+Axes:
+- ``dp`` — data parallel: documents/tokens sharded (the reference's only
+  model-math parallelism, SURVEY.md §2.2).
+- ``mp`` — model parallel: vocabulary sharded, for K×V matrices that
+  outgrow one chip's HBM (SURVEY.md §5.7 — the honest "tensor" axis of
+  LDA).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+DP_AXIS = "dp"
+MP_AXIS = "mp"
+
+
+def make_mesh(dp: int | None = None, mp: int = 1,
+              devices: list | None = None) -> Mesh:
+    """Build a (dp, mp) mesh from available devices.
+
+    With `dp=None`, all remaining devices go to the data axis. On a real
+    slice the device order from `jax.devices()` follows the ICI torus, so
+    neighboring dp shards are ICI neighbors and the per-sweep psum of
+    topic sufficient statistics rides ICI (BASELINE.json north star:
+    "topic-sufficient-statistics allreduced over ICI").
+    """
+    devs = devices if devices is not None else jax.devices()
+    n = len(devs)
+    if dp is None:
+        if n % mp:
+            raise ValueError(f"{n} devices not divisible by mp={mp}")
+        dp = n // mp
+    need = dp * mp
+    if need > n:
+        raise ValueError(f"mesh {dp}x{mp} needs {need} devices, have {n}")
+    grid = np.asarray(devs[:need]).reshape(dp, mp)
+    return Mesh(grid, (DP_AXIS, MP_AXIS))
+
+
+def multihost_init() -> None:
+    """Initialize the multi-host runtime (no-op on a single host).
+
+    Replaces the reference's ssh + machinefile launch (SURVEY.md §3.1):
+    on a TPU pod each host calls this once and the runtime wires up
+    DCN/ICI; there is no external launcher to maintain.
+    """
+    if jax.process_count() > 1:
+        return  # already initialized by the launcher
+    try:
+        jax.distributed.initialize()
+    except Exception:
+        # Single-process (CPU tests, one-chip dev): nothing to do.
+        pass

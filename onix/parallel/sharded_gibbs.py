@@ -1,0 +1,270 @@
+"""Doc-sharded collapsed Gibbs over a device mesh.
+
+This is the TPU-native rendering of oni-lda-c's one true parallelism
+(SURVEY.md §2.2): MPI ranks each own a shard of documents, run the local
+sampler, and allreduce the K×V topic-word sufficient statistics every
+iteration. Here:
+
+- documents (and their tokens) are sharded over the ``dp`` mesh axis via
+  `shard_map`;
+- each shard sweeps its local token blocks against a local replica of
+  the topic-word counts (stale w.r.t. other shards within a sweep — the
+  same staleness the reference accepts between MPI reductions);
+- at sweep end the count *deltas* are `psum`'d over ICI and folded into
+  the replicated matrix, replacing MPI_Reduce + MPI_Bcast with one XLA
+  collective (BASELINE.json north star names this exact mapping).
+
+Equivalence: with dp=1 this is bit-identical in distribution to the
+single-device engine; tests assert count invariants and topic recovery
+on a virtual 8-device CPU mesh (SURVEY.md §4.3).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from onix.config import LDAConfig
+from onix.corpus import Corpus
+from onix.models import lda_gibbs
+from onix.parallel.mesh import DP_AXIS, make_mesh
+
+
+class ShardedCorpus(NamedTuple):
+    """Host-prepared, shard-major corpus layout.
+
+    Documents are partitioned into `n_shards` balanced groups; each
+    shard's tokens are padded to the same [n_blocks, block] shape and
+    its documents renumbered locally. `doc_map[p, i]` is the global doc
+    id of shard p's local doc i (-1 padding).
+    """
+
+    doc_blocks: np.ndarray    # int32 [P, nb, B] local doc ids
+    word_blocks: np.ndarray   # int32 [P, nb, B]
+    mask_blocks: np.ndarray   # float32 [P, nb, B]
+    doc_map: np.ndarray       # int32 [P, Dl]
+    n_docs_local: int         # Dl
+    n_vocab: int
+
+
+def shard_corpus(corpus: Corpus, n_shards: int, block_size: int,
+                 seed: int = 0) -> ShardedCorpus:
+    """Partition documents round-robin by size (greedy balance) and lay
+    out each shard's tokens in blocked form."""
+    n_docs = corpus.n_docs
+    lengths = corpus.doc_lengths()
+    # Snake round-robin over docs sorted by length (desc): near-optimal
+    # load balance, fully vectorized — no per-document Python loop (the
+    # partitioner must handle ~10^6 IP documents, SURVEY.md §7.3.4).
+    order = np.argsort(lengths, kind="stable")[::-1]
+    pos = np.arange(n_docs)
+    fwd = pos % n_shards
+    snake = np.where((pos // n_shards) % 2 == 0, fwd, n_shards - 1 - fwd)
+    shard_of_doc = np.empty(n_docs, np.int32)
+    shard_of_doc[order] = snake.astype(np.int32)
+
+    # Local doc numbering per shard (rank within shard, by global doc id).
+    sort_idx = np.argsort(shard_of_doc, kind="stable")
+    counts = np.bincount(shard_of_doc, minlength=n_shards)
+    starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
+    local_sorted = np.arange(n_docs) - np.repeat(starts, counts)
+    local_of_doc = np.empty(n_docs, np.int32)
+    local_of_doc[sort_idx] = local_sorted.astype(np.int32)
+    d_local = int(counts.max()) if n_docs else 1
+    doc_map = np.full((n_shards, d_local), -1, np.int32)
+    doc_map[shard_of_doc, local_of_doc] = np.arange(n_docs, dtype=np.int32)
+
+    # Per-shard token arrays, all padded to the max shard token count.
+    rng = np.random.default_rng(seed)
+    tok_shard = shard_of_doc[corpus.doc_ids]
+    max_tokens = int(np.bincount(tok_shard, minlength=n_shards).max()) if corpus.n_tokens else 1
+    block = min(block_size, max(max_tokens, 1))
+    padded_len = -(-max_tokens // block) * block
+    nb = padded_len // block
+
+    doc_blocks = np.zeros((n_shards, padded_len), np.int32)
+    word_blocks = np.zeros((n_shards, padded_len), np.int32)
+    mask_blocks = np.zeros((n_shards, padded_len), np.float32)
+    for p in range(n_shards):
+        sel = tok_shard == p
+        d = local_of_doc[corpus.doc_ids[sel]]
+        w = corpus.word_ids[sel]
+        perm = rng.permutation(d.shape[0])
+        d, w = d[perm], w[perm]
+        doc_blocks[p, : d.shape[0]] = d
+        word_blocks[p, : d.shape[0]] = w
+        mask_blocks[p, : d.shape[0]] = 1.0
+    return ShardedCorpus(
+        doc_blocks=doc_blocks.reshape(n_shards, nb, block),
+        word_blocks=word_blocks.reshape(n_shards, nb, block),
+        mask_blocks=mask_blocks.reshape(n_shards, nb, block),
+        doc_map=doc_map,
+        n_docs_local=d_local,
+        n_vocab=corpus.n_vocab,
+    )
+
+
+class ShardedGibbsState(NamedTuple):
+    z: jax.Array         # int32 [P, nb, B] (K sentinel = padding)
+    n_dk: jax.Array      # int32 [P, Dl, K] doc-topic counts, dp-sharded
+    n_wk: jax.Array      # int32 [V, K] topic-word counts, replicated
+    n_k: jax.Array       # int32 [K] replicated
+    keys: jax.Array      # [P, 2] uint32 per-shard PRNG keys
+    acc_ndk: jax.Array   # float32 [P, Dl, K]
+    acc_nwk: jax.Array   # float32 [V, K]
+    n_acc: jax.Array     # int32 []
+
+
+def _local_sweep(z, n_dk, n_wk, n_k, key, docs, words, mask, *,
+                 alpha, eta, n_vocab, k_topics):
+    """The per-shard sweep body — the single-device engine's block_step,
+    shared via lda_gibbs.make_block_step so the math stays identical."""
+    block_step = lda_gibbs.make_block_step(
+        alpha=alpha, eta=eta, n_vocab=n_vocab, k_topics=k_topics)
+    (n_dk, n_wk, n_k, key), z = jax.lax.scan(
+        block_step, (n_dk, n_wk, n_k, key), (docs, words, mask, z))
+    return z, n_dk, n_wk, n_k, key
+
+
+class ShardedGibbsLDA:
+    """Multi-chip Gibbs driver: docs on the dp axis, psum of topic stats.
+
+    Covers BASELINE.json configs[3]: "1B-row synthetic netflow, 20
+    topics, multi-chip doc-sharded Gibbs".
+    """
+
+    def __init__(self, config: LDAConfig, n_vocab: int, mesh=None):
+        config.validate()
+        self.config = config
+        self.n_vocab = n_vocab
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.n_shards = self.mesh.shape[DP_AXIS]
+        k = config.n_topics
+
+        def sweep_fn(state: ShardedGibbsState, docs, words, mask,
+                     accumulate: bool) -> ShardedGibbsState:
+            def shard_fn(z, n_dk, n_wk, n_k, keys, d, w, m):
+                # Replicated counts become device-varying once each shard
+                # starts updating its local replica — mark them so.
+                n_wk_v = jax.lax.pcast(n_wk, DP_AXIS, to="varying")
+                n_k_v = jax.lax.pcast(n_k, DP_AXIS, to="varying")
+                # Leading shard axis of size 1 inside shard_map blocks.
+                z, n_dk, n_wk_new, n_k_new, key = _local_sweep(
+                    z[0], n_dk[0], n_wk_v, n_k_v, keys[0], d[0], w[0], m[0],
+                    alpha=config.alpha, eta=config.eta,
+                    n_vocab=n_vocab, k_topics=k)
+                # The MPI_Reduce+Bcast of the reference, as one psum over
+                # ICI: every shard folds in everyone's deltas.
+                d_wk = jax.lax.psum(n_wk_new - n_wk_v, DP_AXIS)
+                d_k = jax.lax.psum(n_k_new - n_k_v, DP_AXIS)
+                return (z[None], n_dk[None], n_wk + d_wk, n_k + d_k,
+                        key[None])
+
+            z, n_dk, n_wk, n_k, keys = jax.shard_map(
+                shard_fn, mesh=self.mesh,
+                in_specs=(P(DP_AXIS), P(DP_AXIS), P(), P(), P(DP_AXIS),
+                          P(DP_AXIS), P(DP_AXIS), P(DP_AXIS)),
+                out_specs=(P(DP_AXIS), P(DP_AXIS), P(), P(), P(DP_AXIS)),
+            )(state.z, state.n_dk, state.n_wk, state.n_k, state.keys,
+              docs, words, mask)
+            do_acc = jnp.float32(accumulate)
+            return ShardedGibbsState(
+                z=z, n_dk=n_dk, n_wk=n_wk, n_k=n_k, keys=keys,
+                acc_ndk=state.acc_ndk + do_acc * n_dk.astype(jnp.float32),
+                acc_nwk=state.acc_nwk + do_acc * n_wk.astype(jnp.float32),
+                n_acc=state.n_acc + jnp.int32(accumulate),
+            )
+
+        self._sweep = jax.jit(sweep_fn, static_argnames=("accumulate",),
+                              donate_argnums=(0,))
+
+    # -- state construction ----------------------------------------------
+
+    def init_state(self, sc: ShardedCorpus) -> ShardedGibbsState:
+        cfg = self.config
+        k = cfg.n_topics
+        p, nb, b = sc.doc_blocks.shape
+        rng = np.random.default_rng(cfg.seed)
+        z = rng.integers(0, k, size=(p, nb, b)).astype(np.int32)
+        z = np.where(sc.mask_blocks > 0, z, k)
+        # Exact global counts built host-side once (init only).
+        n_dk = np.zeros((p, sc.n_docs_local, k), np.int32)
+        n_wk = np.zeros((sc.n_vocab, k), np.int32)
+        flat_z = z.reshape(p, -1)
+        flat_d = sc.doc_blocks.reshape(p, -1)
+        flat_w = sc.word_blocks.reshape(p, -1)
+        flat_m = sc.mask_blocks.reshape(p, -1) > 0
+        for q in range(p):
+            sel = flat_m[q]
+            np.add.at(n_dk[q], (flat_d[q][sel], flat_z[q][sel]), 1)
+            np.add.at(n_wk, (flat_w[q][sel], flat_z[q][sel]), 1)
+        n_k = n_wk.sum(axis=0).astype(np.int32)
+        # Independent per-shard streams: split, never adjacent raw seeds
+        # (seed and seed+1 would otherwise share p-1 of p streams).
+        keys = jax.random.split(jax.random.PRNGKey(cfg.seed), p)
+
+        shard = lambda spec: NamedSharding(self.mesh, spec)
+        dev = functools.partial(jax.device_put)
+        return ShardedGibbsState(
+            z=dev(jnp.asarray(z), shard(P(DP_AXIS))),
+            n_dk=dev(jnp.asarray(n_dk), shard(P(DP_AXIS))),
+            n_wk=dev(jnp.asarray(n_wk), shard(P())),
+            n_k=dev(jnp.asarray(n_k), shard(P())),
+            keys=dev(jnp.asarray(keys), shard(P(DP_AXIS))),
+            acc_ndk=dev(jnp.zeros((p, sc.n_docs_local, k), jnp.float32),
+                        shard(P(DP_AXIS))),
+            acc_nwk=dev(jnp.zeros((sc.n_vocab, k), jnp.float32), shard(P())),
+            n_acc=jnp.zeros((), jnp.int32),
+        )
+
+    def prepare(self, corpus: Corpus) -> ShardedCorpus:
+        return shard_corpus(corpus, self.n_shards, self.config.block_size,
+                            self.config.seed)
+
+    def device_corpus(self, sc: ShardedCorpus):
+        shard = NamedSharding(self.mesh, P(DP_AXIS))
+        return (jax.device_put(jnp.asarray(sc.doc_blocks), shard),
+                jax.device_put(jnp.asarray(sc.word_blocks), shard),
+                jax.device_put(jnp.asarray(sc.mask_blocks), shard))
+
+    # -- fit --------------------------------------------------------------
+
+    def fit(self, corpus: Corpus, n_sweeps: int | None = None,
+            callback=None) -> dict:
+        cfg = self.config
+        n_sweeps = cfg.n_sweeps if n_sweeps is None else n_sweeps
+        sc = self.prepare(corpus)
+        docs, words, mask = self.device_corpus(sc)
+        state = self.init_state(sc)
+        for s in range(n_sweeps):
+            state = self._sweep(state, docs, words, mask,
+                                accumulate=s >= cfg.burn_in)
+            if callback is not None:
+                callback(s, state)
+        theta, phi_wk = self.estimates(state, sc, corpus.n_docs)
+        return {"state": state, "sharded_corpus": sc,
+                "theta": theta, "phi_wk": phi_wk}
+
+    def estimates(self, state: ShardedGibbsState, sc: ShardedCorpus,
+                  n_docs: int) -> tuple[np.ndarray, np.ndarray]:
+        """Gather per-shard doc-topic counts back to global doc order."""
+        cfg = self.config
+        use_acc = int(state.n_acc) > 0
+        denom = max(float(state.n_acc), 1.0)
+        ndk_s = (np.asarray(state.acc_ndk) / denom if use_acc
+                 else np.asarray(state.n_dk, dtype=np.float64))
+        nwk = (np.asarray(state.acc_nwk) / denom if use_acc
+               else np.asarray(state.n_wk, dtype=np.float64))
+        ndk = np.zeros((n_docs, cfg.n_topics))
+        valid = sc.doc_map >= 0
+        ndk[sc.doc_map[valid]] = ndk_s[valid]
+        theta = (ndk + cfg.alpha) / (ndk.sum(-1, keepdims=True)
+                                     + cfg.n_topics * cfg.alpha)
+        phi_wk = (nwk + cfg.eta) / (nwk.sum(0, keepdims=True)
+                                    + self.n_vocab * cfg.eta)
+        return theta.astype(np.float32), phi_wk.astype(np.float32)

@@ -1,0 +1,91 @@
+"""Distributed tests without hardware (SURVEY.md §4.3): virtual 8-device
+CPU mesh, mesh-shape parametrization, equivalence to single device."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from onix.config import LDAConfig
+from onix.corpus import synthetic_lda_corpus
+from onix.parallel.mesh import make_mesh
+from onix.parallel.sharded_gibbs import ShardedGibbsLDA, shard_corpus
+from tests.test_gibbs import _topic_alignment_similarity
+
+
+@pytest.fixture(scope="module")
+def corpus_and_truth():
+    return synthetic_lda_corpus(n_docs=160, n_vocab=120, n_topics=5,
+                                mean_doc_len=80, alpha=0.2, eta=0.05, seed=0)
+
+
+def _cfg(**kw):
+    base = dict(n_topics=5, alpha=0.5, eta=0.05, n_sweeps=40, burn_in=20,
+                block_size=1024, seed=0)
+    base.update(kw)
+    return LDAConfig(**base)
+
+
+def test_shard_corpus_partition(corpus_and_truth):
+    corpus, _, _ = corpus_and_truth
+    sc = shard_corpus(corpus, 4, block_size=512)
+    assert sc.doc_blocks.shape[0] == 4
+    # Every token is preserved exactly once.
+    assert int(sc.mask_blocks.sum()) == corpus.n_tokens
+    # Every document appears in exactly one shard.
+    all_docs = sc.doc_map[sc.doc_map >= 0]
+    assert sorted(all_docs.tolist()) == list(range(corpus.n_docs))
+    # Balanced load: no shard holds more than half the tokens.
+    per_shard = sc.mask_blocks.sum(axis=(1, 2))
+    assert per_shard.max() < 0.5 * corpus.n_tokens
+
+
+@pytest.mark.parametrize("dp,mp", [(8, 1), (4, 2), (2, 4)])
+def test_mesh_shapes(eight_devices, dp, mp):
+    mesh = make_mesh(dp=dp, mp=mp)
+    assert mesh.shape == {"dp": dp, "mp": mp}
+
+
+def test_sharded_count_invariants(eight_devices, corpus_and_truth):
+    corpus, _, _ = corpus_and_truth
+    model = ShardedGibbsLDA(_cfg(n_sweeps=5, burn_in=3), corpus.n_vocab,
+                            mesh=make_mesh(dp=8, mp=1))
+    result = model.fit(corpus, n_sweeps=5)
+    st = result["state"]
+    n = corpus.n_tokens
+    assert int(np.asarray(st.n_k).sum()) == n
+    assert int(np.asarray(st.n_wk).sum()) == n
+    assert int(np.asarray(st.n_dk).sum()) == n
+    assert np.asarray(st.n_wk).min() >= 0
+    # Global doc-topic counts match doc lengths after unsharding.
+    sc = result["sharded_corpus"]
+    ndk = np.asarray(st.n_dk)
+    lengths = np.zeros(corpus.n_docs, np.int64)
+    valid = sc.doc_map >= 0
+    lengths[sc.doc_map[valid]] = ndk.sum(-1)[valid]
+    np.testing.assert_array_equal(lengths, corpus.doc_lengths())
+
+
+def test_sharded_topic_recovery_matches_single_device(eight_devices,
+                                                      corpus_and_truth):
+    corpus, _, phi_true = corpus_and_truth
+    model = ShardedGibbsLDA(_cfg(), corpus.n_vocab, mesh=make_mesh(dp=8, mp=1))
+    result = model.fit(corpus)
+    sim = _topic_alignment_similarity(phi_true, result["phi_wk"].T)
+    assert sim > 0.85, f"sharded topic recovery too weak: {sim:.3f}"
+    # theta rows are distributions over topics in global doc order.
+    np.testing.assert_allclose(result["theta"].sum(1), 1.0, atol=1e-4)
+
+
+def test_dp1_matches_dp4_statistically(eight_devices, corpus_and_truth):
+    """Different shardings are different samplers (different block
+    interleavings) but must agree on the learned model."""
+    corpus, _, _ = corpus_and_truth
+    r1 = ShardedGibbsLDA(_cfg(), corpus.n_vocab,
+                         mesh=make_mesh(dp=1, mp=1,
+                                        devices=jax.devices()[:1])).fit(corpus)
+    r4 = ShardedGibbsLDA(_cfg(), corpus.n_vocab,
+                         mesh=make_mesh(dp=4, mp=1,
+                                        devices=jax.devices()[:4])).fit(corpus)
+    sim = _topic_alignment_similarity(r1["phi_wk"].T, r4["phi_wk"].T)
+    assert sim > 0.9, f"dp=1 vs dp=4 model divergence: {sim:.3f}"
