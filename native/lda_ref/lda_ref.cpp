@@ -1,0 +1,600 @@
+// onix-lda-ref — C++ reference LDA engine (collapsed Gibbs + variational EM).
+//
+// Role (SURVEY.md §2.4.1): the correctness/performance oracle standing in for
+// the reference's oni-lda-c C/MPI engine (reference README.md:84,125 — the
+// binary itself is not in the mount), so the JAX/TPU engine has a faithful
+// same-corpus, same-hyperparameter baseline for the judged metric
+// "top-1k suspicious-connect overlap vs lda-c" (BASELINE.json `metric`).
+//
+// Two algorithms, matching both readings of the reference engine
+// (SURVEY.md §2.1 #10: BASELINE.json says "Gibbs sampler", the Blei lda-c
+// lineage is variational EM — so the oracle implements BOTH):
+//
+//   * collapsed Gibbs — token-sequential, exact; with n_threads > 1 it
+//     becomes AD-LDA style: documents sharded across threads, each thread
+//     sampling against a private copy of the word-topic counts, deltas
+//     merged after every sweep. This mirrors the reference's MPI pattern
+//     (docs sharded across ranks, topic-word sufficient statistics reduced
+//     each iteration — SURVEY.md §2.2).
+//
+//   * variational EM — Blei-style per-document E-step (gamma/phi fixed
+//     point with digamma), M-step re-estimating beta from sufficient
+//     statistics, optional symmetric-alpha Newton update
+//     (SURVEY.md §2.1 #10: "alpha Newton update").
+//
+// Exposed as a C ABI for ctypes (onix/oracle.py) and as a CLI writing the
+// reference's file contract: final.gamma (D x K), final.beta (K x V,
+// log-probs), likelihood.dat (SURVEY.md §3.1, §5.4).
+
+#include <algorithm>
+#include <atomic>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// Corpus: token-expanded view built from sparse (doc, word, count) triples.
+// ---------------------------------------------------------------------------
+
+struct Corpus {
+  std::vector<int32_t> doc;   // [n_tokens]
+  std::vector<int32_t> word;  // [n_tokens]
+  int32_t n_docs = 0;
+  int32_t n_vocab = 0;
+
+  int64_t n_tokens() const { return static_cast<int64_t>(doc.size()); }
+};
+
+Corpus expand(const int32_t* doc_ids, const int32_t* word_ids,
+              const int32_t* counts, int64_t nnz, int32_t n_docs,
+              int32_t n_vocab) {
+  Corpus c;
+  c.n_docs = n_docs;
+  c.n_vocab = n_vocab;
+  int64_t total = 0;
+  for (int64_t i = 0; i < nnz; ++i) total += counts[i];
+  c.doc.reserve(total);
+  c.word.reserve(total);
+  for (int64_t i = 0; i < nnz; ++i) {
+    for (int32_t r = 0; r < counts[i]; ++r) {
+      c.doc.push_back(doc_ids[i]);
+      c.word.push_back(word_ids[i]);
+    }
+  }
+  return c;
+}
+
+// Sort tokens by document so each thread owns a contiguous doc range.
+void sort_by_doc(Corpus& c) {
+  std::vector<int64_t> idx(c.doc.size());
+  for (int64_t i = 0; i < (int64_t)idx.size(); ++i) idx[i] = i;
+  std::stable_sort(idx.begin(), idx.end(), [&](int64_t a, int64_t b) {
+    return c.doc[a] < c.doc[b];
+  });
+  std::vector<int32_t> d(c.doc.size()), w(c.word.size());
+  for (int64_t i = 0; i < (int64_t)idx.size(); ++i) {
+    d[i] = c.doc[idx[i]];
+    w[i] = c.word[idx[i]];
+  }
+  c.doc.swap(d);
+  c.word.swap(w);
+}
+
+// Mean per-token log p(w|d) given current count-based estimates — the
+// convergence series the reference prints to likelihood.dat.
+double mean_loglik(const Corpus& c, const std::vector<double>& theta,
+                   const std::vector<double>& phi, int K) {
+  double total = 0.0;
+  const int64_t n = c.n_tokens();
+  for (int64_t i = 0; i < n; ++i) {
+    const double* th = &theta[(int64_t)c.doc[i] * K];
+    double p = 0.0;
+    for (int k = 0; k < K; ++k)
+      p += th[k] * phi[(int64_t)k * c.n_vocab + c.word[i]];
+    total += std::log(std::max(p, 1e-300));
+  }
+  return n ? total / (double)n : 0.0;
+}
+
+void counts_to_estimates(const std::vector<double>& ndk,
+                         const std::vector<double>& nwk, int32_t D, int32_t V,
+                         int K, double alpha, double eta,
+                         std::vector<double>* theta,
+                         std::vector<double>* phi) {
+  theta->assign((int64_t)D * K, 0.0);
+  phi->assign((int64_t)K * V, 0.0);
+  for (int32_t d = 0; d < D; ++d) {
+    double s = 0.0;
+    for (int k = 0; k < K; ++k) s += ndk[(int64_t)d * K + k];
+    const double denom = s + K * alpha;
+    for (int k = 0; k < K; ++k)
+      (*theta)[(int64_t)d * K + k] = (ndk[(int64_t)d * K + k] + alpha) / denom;
+  }
+  std::vector<double> nk(K, 0.0);
+  for (int32_t v = 0; v < V; ++v)
+    for (int k = 0; k < K; ++k) nk[k] += nwk[(int64_t)v * K + k];
+  for (int k = 0; k < K; ++k) {
+    const double denom = nk[k] + V * eta;
+    for (int32_t v = 0; v < V; ++v)
+      (*phi)[(int64_t)k * V + v] = (nwk[(int64_t)v * K + k] + eta) / denom;
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Collapsed Gibbs (exact when n_threads == 1; AD-LDA merge otherwise).
+// ---------------------------------------------------------------------------
+
+struct GibbsShard {
+  int64_t lo = 0, hi = 0;          // token range (doc-contiguous)
+  std::vector<int32_t> nwk;        // private copy of word-topic counts [V*K]
+  std::vector<int32_t> nk;         // private topic totals [K]
+  std::mt19937_64 rng;
+};
+
+void gibbs_run(const Corpus& c, int K, double alpha, double eta, int n_sweeps,
+               int burn_in, uint64_t seed, int n_threads, float* theta_out,
+               float* phi_out, double* ll_out) {
+  const int32_t D = c.n_docs, V = c.n_vocab;
+  const int64_t N = c.n_tokens();
+  const double veta = (double)V * eta;
+
+  std::vector<int32_t> z(N);
+  std::vector<int32_t> ndk((int64_t)D * K, 0);
+  std::vector<int32_t> nwk_global((int64_t)V * K, 0);
+  std::vector<int32_t> nk_global(K, 0);
+
+  std::mt19937_64 init_rng(seed);
+  for (int64_t i = 0; i < N; ++i) {
+    int32_t t = (int32_t)(init_rng() % (uint64_t)K);
+    z[i] = t;
+    ++ndk[(int64_t)c.doc[i] * K + t];
+    ++nwk_global[(int64_t)c.word[i] * K + t];
+    ++nk_global[t];
+  }
+
+  n_threads = std::max(1, n_threads);
+  std::vector<GibbsShard> shards(n_threads);
+  {
+    // Doc-contiguous token split so ndk rows are thread-private.
+    int64_t per = (N + n_threads - 1) / std::max(1, n_threads);
+    int64_t lo = 0;
+    for (int t = 0; t < n_threads; ++t) {
+      int64_t hi = std::min(N, lo + per);
+      // advance hi to a document boundary
+      while (hi < N && hi > 0 && c.doc[hi] == c.doc[hi - 1]) ++hi;
+      shards[t].lo = lo;
+      shards[t].hi = hi;
+      shards[t].rng.seed(seed ^ (0x9e3779b97f4a7c15ULL * (t + 1)));
+      lo = hi;
+    }
+  }
+
+  std::vector<double> acc_ndk((int64_t)D * K, 0.0);
+  std::vector<double> acc_nwk((int64_t)V * K, 0.0);
+  int n_acc = 0;
+
+  auto sweep_shard = [&](GibbsShard& sh) {
+    std::vector<double> probs(K);
+    std::uniform_real_distribution<double> unif(0.0, 1.0);
+    int32_t* nwk = sh.nwk.empty() ? nwk_global.data() : sh.nwk.data();
+    int32_t* nk = sh.nk.empty() ? nk_global.data() : sh.nk.data();
+    for (int64_t i = sh.lo; i < sh.hi; ++i) {
+      const int32_t d = c.doc[i], w = c.word[i], old = z[i];
+      int32_t* nd = &ndk[(int64_t)d * K];
+      int32_t* nw = &nwk[(int64_t)w * K];
+      --nd[old];
+      --nw[old];
+      --nk[old];
+      double total = 0.0;
+      for (int k = 0; k < K; ++k) {
+        const double p = (nd[k] + alpha) * (nw[k] + eta) / (nk[k] + veta);
+        total += p;
+        probs[k] = total;
+      }
+      const double u = unif(sh.rng) * total;
+      int t = 0;
+      while (t < K - 1 && probs[t] < u) ++t;
+      z[i] = t;
+      ++nd[t];
+      ++nw[t];
+      ++nk[t];
+    }
+  };
+
+  std::vector<double> theta, phi;
+  for (int s = 0; s < n_sweeps; ++s) {
+    if (n_threads == 1) {
+      sweep_shard(shards[0]);
+    } else {
+      // AD-LDA: each thread samples against a private snapshot of the
+      // word-topic counts; deltas merged after the sweep — the same
+      // stale-counts compromise as the reference's per-iteration MPI
+      // reduce and onix's per-sweep psum (SURVEY.md §2.2).
+      for (auto& sh : shards) {
+        sh.nwk = nwk_global;
+        sh.nk = nk_global;
+      }
+      std::vector<std::thread> threads;
+      for (auto& sh : shards)
+        threads.emplace_back([&sweep_shard, &sh] { sweep_shard(sh); });
+      for (auto& th : threads) th.join();
+      // allreduce: global += sum of per-shard deltas
+      std::vector<int64_t> sum_nwk((int64_t)V * K, 0);
+      std::vector<int64_t> sum_nk(K, 0);
+      for (auto& sh : shards) {
+        for (int64_t j = 0; j < (int64_t)V * K; ++j)
+          sum_nwk[j] += sh.nwk[j] - nwk_global[j];
+        for (int k = 0; k < K; ++k) sum_nk[k] += sh.nk[k] - nk_global[k];
+      }
+      for (int64_t j = 0; j < (int64_t)V * K; ++j)
+        nwk_global[j] += (int32_t)sum_nwk[j];
+      for (int k = 0; k < K; ++k) nk_global[k] += (int32_t)sum_nk[k];
+    }
+
+    if (s >= burn_in) {
+      for (int64_t j = 0; j < (int64_t)D * K; ++j) acc_ndk[j] += ndk[j];
+      for (int64_t j = 0; j < (int64_t)V * K; ++j)
+        acc_nwk[j] += nwk_global[j];
+      ++n_acc;
+    }
+    if (ll_out) {
+      std::vector<double> ndk_d(ndk.begin(), ndk.end());
+      std::vector<double> nwk_d(nwk_global.begin(), nwk_global.end());
+      counts_to_estimates(ndk_d, nwk_d, D, V, K, alpha, eta, &theta, &phi);
+      ll_out[s] = mean_loglik(c, theta, phi, K);
+    }
+  }
+
+  // Posterior-mean estimates from averaged counts (rank stability for the
+  // judged top-k metric — same trick as the JAX engine).
+  std::vector<double> ndk_f, nwk_f;
+  if (n_acc > 0) {
+    ndk_f.resize((int64_t)D * K);
+    nwk_f.resize((int64_t)V * K);
+    for (int64_t j = 0; j < (int64_t)D * K; ++j) ndk_f[j] = acc_ndk[j] / n_acc;
+    for (int64_t j = 0; j < (int64_t)V * K; ++j) nwk_f[j] = acc_nwk[j] / n_acc;
+  } else {
+    ndk_f.assign(ndk.begin(), ndk.end());
+    nwk_f.assign(nwk_global.begin(), nwk_global.end());
+  }
+  counts_to_estimates(ndk_f, nwk_f, D, V, K, alpha, eta, &theta, &phi);
+  for (int64_t j = 0; j < (int64_t)D * K; ++j) theta_out[j] = (float)theta[j];
+  for (int64_t j = 0; j < (int64_t)K * V; ++j) phi_out[j] = (float)phi[j];
+}
+
+// ---------------------------------------------------------------------------
+// Variational EM (Blei lda-c lineage).
+// ---------------------------------------------------------------------------
+
+double digamma_(double x) {
+  // Asymptotic expansion with recurrence shift (standard; accurate ~1e-12).
+  double result = 0.0;
+  while (x < 6.0) {
+    result -= 1.0 / x;
+    x += 1.0;
+  }
+  const double x1 = 1.0 / x, x2 = x1 * x1;
+  result += std::log(x) - 0.5 * x1 -
+            x2 * (1.0 / 12.0 - x2 * (1.0 / 120.0 - x2 / 252.0));
+  return result;
+}
+
+struct DocView {
+  int64_t lo = 0, hi = 0;  // CSR range into the sparse arrays
+};
+
+// Per-document E-step: iterate gamma/phi fixed point; accumulate
+// class-word sufficient statistics. Returns the doc's likelihood bound
+// contribution (up to constants independent of the variational params).
+double e_step_doc(const int32_t* words, const int32_t* counts, int64_t lo,
+                  int64_t hi, const std::vector<double>& log_beta, int K,
+                  int32_t V, double alpha, int var_max_iter, double var_conv,
+                  double* gamma_d, std::vector<double>& sstats_local) {
+  const int64_t n_terms = hi - lo;
+  double doc_total = 0.0;
+  for (int64_t j = lo; j < hi; ++j) doc_total += counts[j];
+
+  std::vector<double> phi((size_t)n_terms * K);
+  std::vector<double> dig(K);
+  for (int k = 0; k < K; ++k) {
+    gamma_d[k] = alpha + doc_total / K;
+    dig[k] = digamma_(gamma_d[k]);
+  }
+
+  double old_ll = 0.0;
+  for (int it = 0; it < var_max_iter; ++it) {
+    for (int k = 0; k < K; ++k) gamma_d[k] = alpha;
+    for (int64_t j = 0; j < n_terms; ++j) {
+      const int32_t w = words[lo + j];
+      double maxv = -1e300;
+      double* ph = &phi[(size_t)j * K];
+      for (int k = 0; k < K; ++k) {
+        ph[k] = dig[k] + log_beta[(int64_t)k * V + w];
+        maxv = std::max(maxv, ph[k]);
+      }
+      double norm = 0.0;
+      for (int k = 0; k < K; ++k) {
+        ph[k] = std::exp(ph[k] - maxv);
+        norm += ph[k];
+      }
+      for (int k = 0; k < K; ++k) {
+        ph[k] /= norm;
+        gamma_d[k] += counts[lo + j] * ph[k];
+      }
+    }
+    for (int k = 0; k < K; ++k) dig[k] = digamma_(gamma_d[k]);
+    // Convergence check on the phi-entropy-free partial bound.
+    double ll = 0.0;
+    for (int64_t j = 0; j < n_terms; ++j) {
+      const int32_t w = words[lo + j];
+      const double* ph = &phi[(size_t)j * K];
+      for (int k = 0; k < K; ++k)
+        if (ph[k] > 1e-12)
+          ll += counts[lo + j] * ph[k] *
+                (dig[k] + log_beta[(int64_t)k * V + w] - std::log(ph[k]));
+    }
+    if (it > 0 && std::fabs(ll - old_ll) < var_conv * std::fabs(old_ll)) {
+      old_ll = ll;
+      break;
+    }
+    old_ll = ll;
+  }
+  for (int64_t j = 0; j < n_terms; ++j) {
+    const int32_t w = words[lo + j];
+    const double* ph = &phi[(size_t)j * K];
+    for (int k = 0; k < K; ++k)
+      sstats_local[(int64_t)k * V + w] += counts[lo + j] * ph[k];
+  }
+  return old_ll;
+}
+
+void vem_run(const int32_t* doc_ids, const int32_t* word_ids,
+             const int32_t* counts, int64_t nnz, int32_t D, int32_t V, int K,
+             double alpha, double eta, int em_max_iter, double em_conv,
+             int var_max_iter, double var_conv, uint64_t seed, int n_threads,
+             float* theta_out, float* phi_out, double* ll_out) {
+  // CSR doc ranges (input triples must be grouped by doc; enforce by sort).
+  std::vector<int64_t> order(nnz);
+  for (int64_t i = 0; i < nnz; ++i) order[i] = i;
+  std::stable_sort(order.begin(), order.end(),
+                   [&](int64_t a, int64_t b) { return doc_ids[a] < doc_ids[b]; });
+  std::vector<int32_t> w_s(nnz), c_s(nnz), d_s(nnz);
+  for (int64_t i = 0; i < nnz; ++i) {
+    d_s[i] = doc_ids[order[i]];
+    w_s[i] = word_ids[order[i]];
+    c_s[i] = counts[order[i]];
+  }
+  std::vector<DocView> docs(D);
+  {
+    int64_t i = 0;
+    for (int32_t d = 0; d < D; ++d) {
+      docs[d].lo = i;
+      while (i < nnz && d_s[i] == d) ++i;
+      docs[d].hi = i;
+    }
+  }
+
+  // Seeded init: beta from smoothed random counts (lda-c "random" init).
+  std::mt19937_64 rng(seed);
+  std::vector<double> log_beta((int64_t)K * V);
+  {
+    std::uniform_real_distribution<double> unif(0.0, 1.0);
+    for (int k = 0; k < K; ++k) {
+      double norm = 0.0;
+      for (int32_t v = 0; v < V; ++v) {
+        const double x = unif(rng) + 1.0 / V;
+        log_beta[(int64_t)k * V + v] = x;
+        norm += x;
+      }
+      for (int32_t v = 0; v < V; ++v)
+        log_beta[(int64_t)k * V + v] =
+            std::log(log_beta[(int64_t)k * V + v] / norm);
+    }
+  }
+
+  std::vector<double> gamma((int64_t)D * K, 0.0);
+  n_threads = std::max(1, n_threads);
+
+  double old_ll = -1e300;
+  for (int iter = 0; iter < em_max_iter; ++iter) {
+    std::vector<std::vector<double>> sstats(
+        n_threads, std::vector<double>((int64_t)K * V, 0.0));
+    std::vector<double> lls(n_threads, 0.0);
+    std::atomic<int32_t> next_doc{0};
+    auto worker = [&](int t) {
+      for (;;) {
+        const int32_t d = next_doc.fetch_add(1);
+        if (d >= D) break;
+        lls[t] += e_step_doc(w_s.data(), c_s.data(), docs[d].lo, docs[d].hi,
+                             log_beta, K, V, alpha, var_max_iter, var_conv,
+                             &gamma[(int64_t)d * K], sstats[t]);
+      }
+    };
+    std::vector<std::thread> threads;
+    for (int t = 0; t < n_threads; ++t) threads.emplace_back(worker, t);
+    for (auto& th : threads) th.join();
+
+    // M-step: beta_kw ∝ sstats + eta (smoothed), reduced across threads —
+    // the shape of the reference's MPI_Reduce to rank 0 (SURVEY.md §3.1).
+    double ll = 0.0;
+    for (int t = 0; t < n_threads; ++t) ll += lls[t];
+    for (int t = 1; t < n_threads; ++t)
+      for (int64_t j = 0; j < (int64_t)K * V; ++j) sstats[0][j] += sstats[t][j];
+    for (int k = 0; k < K; ++k) {
+      double norm = 0.0;
+      for (int32_t v = 0; v < V; ++v) norm += sstats[0][(int64_t)k * V + v] + eta;
+      const double log_norm = std::log(norm);
+      for (int32_t v = 0; v < V; ++v)
+        log_beta[(int64_t)k * V + v] =
+            std::log(sstats[0][(int64_t)k * V + v] + eta) - log_norm;
+    }
+    if (ll_out) ll_out[iter] = ll;
+    if (iter > 0 && std::fabs(ll - old_ll) < em_conv * std::fabs(old_ll)) {
+      if (ll_out)
+        for (int j = iter + 1; j < em_max_iter; ++j) ll_out[j] = ll;
+      break;
+    }
+    old_ll = ll;
+  }
+
+  for (int32_t d = 0; d < D; ++d) {
+    double s = 0.0;
+    for (int k = 0; k < K; ++k) s += gamma[(int64_t)d * K + k];
+    for (int k = 0; k < K; ++k)
+      theta_out[(int64_t)d * K + k] = (float)(gamma[(int64_t)d * K + k] / s);
+  }
+  for (int64_t j = 0; j < (int64_t)K * V; ++j)
+    phi_out[j] = (float)std::exp(log_beta[j]);
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// C ABI (ctypes surface — onix/oracle.py)
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+int onix_lda_gibbs(const int32_t* doc_ids, const int32_t* word_ids,
+                   const int32_t* counts, int64_t nnz, int32_t n_docs,
+                   int32_t n_vocab, int32_t n_topics, double alpha, double eta,
+                   int32_t n_sweeps, int32_t burn_in, uint64_t seed,
+                   int32_t n_threads, float* theta_out, float* phi_out,
+                   double* ll_out) {
+  if (!doc_ids || !word_ids || !counts || !theta_out || !phi_out) return 1;
+  if (n_topics < 2 || n_docs < 1 || n_vocab < 1) return 2;
+  Corpus c = expand(doc_ids, word_ids, counts, nnz, n_docs, n_vocab);
+  sort_by_doc(c);
+  gibbs_run(c, n_topics, alpha, eta, n_sweeps, burn_in, seed, n_threads,
+            theta_out, phi_out, ll_out);
+  return 0;
+}
+
+int onix_lda_vem(const int32_t* doc_ids, const int32_t* word_ids,
+                 const int32_t* counts, int64_t nnz, int32_t n_docs,
+                 int32_t n_vocab, int32_t n_topics, double alpha, double eta,
+                 int32_t em_max_iter, double em_conv, int32_t var_max_iter,
+                 double var_conv, uint64_t seed, int32_t n_threads,
+                 float* theta_out, float* phi_out, double* ll_out) {
+  if (!doc_ids || !word_ids || !counts || !theta_out || !phi_out) return 1;
+  if (n_topics < 2 || n_docs < 1 || n_vocab < 1) return 2;
+  vem_run(doc_ids, word_ids, counts, nnz, n_docs, n_vocab, n_topics, alpha,
+          eta, em_max_iter, em_conv, var_max_iter, var_conv, seed, n_threads,
+          theta_out, phi_out, ll_out);
+  return 0;
+}
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// CLI — file-contract parity with oni-lda-c (SURVEY.md §3.1, §5.4):
+//   lda_ref <gibbs|vem> <K> <alpha> <eta> <iters> <seed> <corpus.ldac> <outdir>
+// writes final.gamma (D x K), final.beta (K x V log-probs), likelihood.dat.
+// ---------------------------------------------------------------------------
+
+#ifndef ONIX_LDA_REF_NO_MAIN
+int main(int argc, char** argv) {
+  if (argc != 9 && argc != 10) {
+    std::fprintf(stderr,
+                 "usage: %s <gibbs|vem> <K> <alpha> <eta> <iters> <seed> "
+                 "<corpus.ldac> <outdir> [n_vocab]\n",
+                 argv[0]);
+    return 2;
+  }
+  const std::string mode = argv[1];
+  const int K = std::atoi(argv[2]);
+  const double alpha = std::atof(argv[3]);
+  const double eta = std::atof(argv[4]);
+  const int iters = std::atoi(argv[5]);
+  const uint64_t seed = (uint64_t)std::strtoull(argv[6], nullptr, 10);
+  const std::string corpus_path = argv[7];
+  const std::string outdir = argv[8];
+
+  // Parse lda-c format: `N w:c w:c ...` per line. n_vocab may be given
+  // explicitly (the true vocabulary size — matches SparseCounts.read_ldac);
+  // otherwise it is inferred as max word id + 1.
+  std::vector<int32_t> d, w, c;
+  int32_t n_docs = 0;
+  int32_t n_vocab = (argc == 10) ? std::atoi(argv[9]) : 0;
+  {
+    FILE* f = std::fopen(corpus_path.c_str(), "r");
+    if (!f) {
+      std::perror("corpus");
+      return 1;
+    }
+    char* line = nullptr;
+    size_t cap = 0;
+    while (getline(&line, &cap, f) != -1) {
+      char* p = line;
+      long n_terms = std::strtol(p, &p, 10);
+      for (long j = 0; j < n_terms; ++j) {
+        long wi = std::strtol(p, &p, 10);
+        if (*p == ':') ++p;
+        long ci = std::strtol(p, &p, 10);
+        if (wi < 0 || ci <= 0) {
+          std::fprintf(stderr, "corpus line %d: bad entry %ld:%ld\n",
+                       n_docs + 1, wi, ci);
+          free(line);
+          std::fclose(f);
+          return 1;
+        }
+        d.push_back(n_docs);
+        w.push_back((int32_t)wi);
+        c.push_back((int32_t)ci);
+        n_vocab = std::max(n_vocab, (int32_t)wi + 1);
+      }
+      ++n_docs;
+    }
+    free(line);
+    std::fclose(f);
+  }
+
+  std::vector<float> theta((int64_t)n_docs * K), phi((int64_t)K * n_vocab);
+  std::vector<double> ll(iters, 0.0);
+  int rc;
+  if (mode == "gibbs") {
+    rc = onix_lda_gibbs(d.data(), w.data(), c.data(), (int64_t)d.size(),
+                        n_docs, n_vocab, K, alpha, eta, iters, iters / 2, seed,
+                        1, theta.data(), phi.data(), ll.data());
+  } else {
+    rc = onix_lda_vem(d.data(), w.data(), c.data(), (int64_t)d.size(), n_docs,
+                      n_vocab, K, alpha, eta, iters, 1e-5, 30, 1e-6, seed, 1,
+                      theta.data(), phi.data(), ll.data());
+  }
+  if (rc != 0) return rc;
+
+  auto write_matrix = [&](const std::string& path, const float* m,
+                          int64_t rows, int64_t cols, bool log_space) {
+    FILE* f = std::fopen(path.c_str(), "w");
+    if (!f) {
+      std::perror(path.c_str());
+      std::exit(1);
+    }
+    for (int64_t r = 0; r < rows; ++r) {
+      for (int64_t j = 0; j < cols; ++j) {
+        const double x = m[r * cols + j];
+        std::fprintf(f, "%s%.10f", j ? " " : "",
+                     log_space ? std::log(std::max(x, 1e-30)) : x);
+      }
+      std::fputc('\n', f);
+    }
+    std::fclose(f);
+  };
+  write_matrix(outdir + "/final.gamma", theta.data(), n_docs, K, false);
+  write_matrix(outdir + "/final.beta", phi.data(), K, n_vocab, true);
+  {
+    FILE* f = std::fopen((outdir + "/likelihood.dat").c_str(), "w");
+    for (int i = 0; i < iters; ++i) std::fprintf(f, "%.10f\n", ll[i]);
+    std::fclose(f);
+  }
+  return 0;
+}
+#endif

@@ -1,0 +1,148 @@
+"""C++ oracle (onix-lda-ref) tests + the judged overlap harness.
+
+SURVEY.md §4.2: "JAX engine vs onix-lda-ref C++ oracle on identical
+corpus + seeds → score overlap ≥0.95 (the judged metric,
+BASELINE.json `metric`)." The oracle stands in for oni-lda-c
+(reference README.md:84), whose binary is absent from the mount.
+"""
+
+import subprocess
+
+import numpy as np
+import pytest
+
+from onix.config import LDAConfig
+from onix.corpus import anomaly_corpus, synthetic_lda_corpus
+from onix.models.lda_gibbs import GibbsLDA
+
+oracle = pytest.importorskip("onix.oracle")
+
+try:
+    oracle.load_library()
+    HAVE_ORACLE = True
+except oracle.OracleUnavailable:
+    HAVE_ORACLE = False
+
+pytestmark = pytest.mark.skipif(not HAVE_ORACLE,
+                                reason="g++/make unavailable")
+
+
+@pytest.fixture(scope="module")
+def corpus5():
+    corpus, theta, phi = synthetic_lda_corpus(
+        n_docs=150, n_vocab=200, n_topics=5, mean_doc_len=120,
+        alpha=0.2, eta=0.05, seed=7)
+    return corpus, theta, phi
+
+
+def _recovery(phi_true, phi_est):
+    from scipy.optimize import linear_sum_assignment
+    a = phi_true / np.linalg.norm(phi_true, axis=1, keepdims=True)
+    b = phi_est / np.linalg.norm(phi_est, axis=1, keepdims=True)
+    sim = a @ b.T
+    r, c = linear_sum_assignment(-sim)
+    return sim[r, c].mean()
+
+
+def test_gibbs_recovers_topics(corpus5):
+    corpus, _, phi_true = corpus5
+    out = oracle.gibbs(corpus.to_doc_word_counts(), n_topics=5, alpha=0.5,
+                       eta=0.05, n_sweeps=60, seed=1)
+    assert _recovery(phi_true, out["phi"]) > 0.9
+    # Convergence: likelihood improves over the run.
+    assert out["ll"][-1] > out["ll"][0] + 0.1
+
+
+def test_vem_recovers_topics_and_ll_monotone(corpus5):
+    corpus, _, phi_true = corpus5
+    out = oracle.vem(corpus.to_doc_word_counts(), n_topics=5, alpha=0.5,
+                     eta=0.05, em_max_iter=40, seed=1)
+    assert _recovery(phi_true, out["phi"]) > 0.9
+    # VB bound must be (near-)monotone (SURVEY.md §4.2 "likelihood
+    # monotonicity for VB"); allow tiny numerical wiggle.
+    ll = out["ll"]
+    diffs = np.diff(ll[:np.argmax(ll) + 1])
+    assert (diffs >= -1e-3 * np.abs(ll[:-1][: len(diffs)])).all()
+
+
+def test_gibbs_deterministic_same_seed(corpus5):
+    corpus, _, _ = corpus5
+    sc = corpus.to_doc_word_counts()
+    a = oracle.gibbs(sc, n_topics=5, alpha=0.5, eta=0.05, n_sweeps=10, seed=9)
+    b = oracle.gibbs(sc, n_topics=5, alpha=0.5, eta=0.05, n_sweeps=10, seed=9)
+    np.testing.assert_array_equal(a["theta"], b["theta"])
+    np.testing.assert_array_equal(a["phi"], b["phi"])
+
+
+def test_multithread_gibbs_matches_quality(corpus5):
+    """AD-LDA (4 threads, per-sweep merge) must match single-thread quality
+    — same claim the sharded JAX engine makes for its psum merge."""
+    corpus, _, phi_true = corpus5
+    sc = corpus.to_doc_word_counts()
+    out = oracle.gibbs(sc, n_topics=5, alpha=0.5, eta=0.05, n_sweeps=60,
+                       seed=1, n_threads=4)
+    assert _recovery(phi_true, out["phi"]) > 0.9
+
+
+def test_judged_overlap_jax_vs_oracle():
+    """The headline harness: identical anomaly corpus through the JAX
+    batched-Gibbs engine and the C++ oracle; bottom-k suspicious sets must
+    overlap. Small-scale rehearsal of BASELINE.json's top-1k ≥ 0.95."""
+    corpus, planted = anomaly_corpus(n_docs=250, n_vocab=300, n_topics=8,
+                                     mean_doc_len=250, n_anomalies=40, seed=2)
+    k_topics, alpha, eta = 8, 0.5, 0.05
+
+    cfg = LDAConfig(n_topics=k_topics, alpha=alpha, eta=eta, n_sweeps=80,
+                    burn_in=40, block_size=4096, seed=0)
+    model = GibbsLDA(cfg, corpus.n_docs, corpus.n_vocab)
+    jax_fit = model.fit(corpus)
+    # Score through the PRODUCTION scorer so the harness exercises the
+    # shipped metric path, not a reimplementation.
+    from onix.models.scoring import score_all
+    jax_scores = score_all(jax_fit["theta"], jax_fit["phi_wk"],
+                           corpus.doc_ids, corpus.word_ids)
+
+    ora = oracle.gibbs(corpus.to_doc_word_counts(), n_topics=k_topics,
+                       alpha=alpha, eta=eta, n_sweeps=80, burn_in=40, seed=3)
+    # Score the SAME token stream with the oracle model.
+    ora_scores = oracle.score_events_np(
+        ora["theta"], ora["phi"], corpus.doc_ids, corpus.word_ids)
+
+    k = 100
+    ov = oracle.topk_overlap(jax_scores, ora_scores, k)
+    assert ov >= 0.8, f"top-{k} overlap vs oracle too low: {ov:.3f}"
+
+    # Both engines must surface the planted anomalies near the bottom.
+    for scores, name in ((jax_scores, "jax"), (ora_scores, "oracle")):
+        bottom = set(np.argsort(scores)[:200].tolist())
+        hit = len(bottom & set(planted.tolist())) / len(planted)
+        assert hit >= 0.8, f"{name} missed planted anomalies: {hit:.2f}"
+
+
+def test_cli_file_contract(tmp_path, corpus5):
+    """The CLI writes the reference's output files: final.gamma, final.beta
+    (log-probs), likelihood.dat (SURVEY.md §3.1, §5.4)."""
+    corpus, _, _ = corpus5
+    sc = corpus.to_doc_word_counts()
+    corpus_path = tmp_path / "corpus.ldac"
+    sc.write_ldac(corpus_path)
+    subprocess.run(
+        [str(oracle._BIN_PATH), "gibbs", "5", "0.5", "0.05", "20", "1",
+         str(corpus_path), str(tmp_path), str(corpus.n_vocab)],
+        check=True, capture_output=True)
+    # Malformed corpus (negative word id) must be a parse error, not UB.
+    bad = tmp_path / "bad.ldac"
+    bad.write_text("1 -3:2\n")
+    rc = subprocess.run(
+        [str(oracle._BIN_PATH), "gibbs", "5", "0.5", "0.05", "5", "1",
+         str(bad), str(tmp_path)], capture_output=True)
+    assert rc.returncode == 1
+    gamma = np.loadtxt(tmp_path / "final.gamma")
+    beta = np.loadtxt(tmp_path / "final.beta")
+    ll = np.loadtxt(tmp_path / "likelihood.dat")
+    assert gamma.shape == (corpus.n_docs, 5)
+    assert beta.shape == (5, corpus.n_vocab)
+    assert ll.shape == (20,)
+    # beta rows are log-probs: logsumexp ≈ 0.
+    lse = np.log(np.exp(beta - beta.max(1, keepdims=True)).sum(1)) + beta.max(1)
+    np.testing.assert_allclose(lse, 0.0, atol=1e-5)
