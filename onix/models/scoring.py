@@ -36,6 +36,53 @@ class TopK(NamedTuple):
     #                     M events qualified (score is +inf there)
 
 
+def _finalize_topk(scores: jax.Array, indices: jax.Array) -> TopK:
+    order = jnp.argsort(scores)
+    scores, indices = scores[order], indices[order]
+    # Unfilled slots (fewer than max_results qualifying events) carry +inf
+    # scores; force their indices to the -1 sentinel so a consumer can
+    # never gather a real event row through a padding slot.
+    indices = jnp.where(jnp.isfinite(scores), indices, -1)
+    return TopK(scores=scores, indices=indices)
+
+
+@functools.partial(jax.jit, static_argnames=("max_results", "chunk"))
+def bottom_k(
+    scores: jax.Array,        # float32 [N] precomputed event scores
+    *,
+    tol: float,
+    max_results: int,
+    chunk: int = 1 << 20,
+) -> TopK:
+    """Bottom-`max_results` among precomputed scores < tol — the selection
+    half of `top_suspicious` for callers that aggregate scores before
+    selecting (e.g. flow events take the min over src/dst-doc tokens)."""
+    n = scores.shape[0]
+    chunk = min(chunk, max(n, 1))
+    pad = (-n) % chunk
+    if pad:
+        scores = jnp.pad(scores, (0, pad), constant_values=jnp.inf)
+    n_chunks = (n + pad) // chunk
+    s2 = scores.reshape(n_chunks, -1)
+    base = jnp.arange(chunk, dtype=jnp.int32)
+
+    def step(carry, xs):
+        best_s, best_i = carry
+        sc, ci = xs
+        sc = jnp.where(sc < tol, sc, jnp.inf)
+        idx = ci * chunk + base
+        cat_s = jnp.concatenate([best_s, sc])
+        cat_i = jnp.concatenate([best_i, idx])
+        neg, pos = jax.lax.top_k(-cat_s, max_results)
+        return (-neg, cat_i[pos]), None
+
+    init = (jnp.full((max_results,), jnp.inf, jnp.float32),
+            jnp.full((max_results,), -1, jnp.int32))
+    (out_s, out_i), _ = jax.lax.scan(
+        step, init, (s2, jnp.arange(n_chunks, dtype=jnp.int32)))
+    return _finalize_topk(out_s, out_i)
+
+
 @functools.partial(jax.jit, static_argnames=("max_results", "chunk"))
 def top_suspicious(
     theta: jax.Array,
@@ -83,13 +130,7 @@ def top_suspicious(
             jnp.full((max_results,), -1, jnp.int32))
     (scores, indices), _ = jax.lax.scan(
         step, init, (d, w, m, jnp.arange(n_chunks, dtype=jnp.int32)))
-    order = jnp.argsort(scores)
-    scores, indices = scores[order], indices[order]
-    # Unfilled slots (fewer than max_results qualifying events) carry +inf
-    # scores; force their indices to the -1 sentinel so a consumer can
-    # never gather a real event row through a padding slot.
-    indices = jnp.where(jnp.isfinite(scores), indices, -1)
-    return TopK(scores=scores, indices=indices)
+    return _finalize_topk(scores, indices)
 
 
 _score_events_jit = jax.jit(score_events)

@@ -40,9 +40,11 @@ def _build() -> None:
 
 
 def _stale() -> bool:
-    src = _NATIVE_DIR / "lda_ref.cpp"
-    return (not _LIB_PATH.exists()
-            or _LIB_PATH.stat().st_mtime < src.stat().st_mtime)
+    if not _LIB_PATH.exists() or not _BIN_PATH.exists():
+        return True
+    built = min(_LIB_PATH.stat().st_mtime, _BIN_PATH.stat().st_mtime)
+    return any(built < (_NATIVE_DIR / f).stat().st_mtime
+               for f in ("lda_ref.cpp", "Makefile"))
 
 
 def load_library() -> ctypes.CDLL:

@@ -1,0 +1,4 @@
+"""Per-datatype scoring pipelines: word creation → corpus → LDA → results.
+
+The TPU-era rendering of oni-ml's Spark jobs (SURVEY.md §2.1 #4–#8, #11).
+"""

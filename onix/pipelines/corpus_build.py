@@ -1,0 +1,134 @@
+"""Pre-LDA corpus build: (ip, word) pairs → integer corpus + feedback loop.
+
+The reference's FlowPreLDA/DNSPreLDA/ProxyPreLDA Spark jobs group words
+per document (IP), assign integer word ids, write the lda-c corpus file,
+and apply analyst feedback by duplicating labeled events ×DUPFACTOR —
+the model-biasing "noise filter" loop (SURVEY.md §2.1 #8, reference
+README.md:48). onix keeps the token-expanded view on device arrays
+instead of a corpus file (onix.corpus), and the feedback contract is a
+CSV of (ip, word) rows the analyst marked benign.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import pathlib
+
+import numpy as np
+import pandas as pd
+
+from onix.corpus import Corpus
+from onix.pipelines.words import WordTable
+
+
+@dataclasses.dataclass
+class Vocabulary:
+    """Deterministic word-string ↔ integer-id mapping (sorted unique)."""
+
+    words: np.ndarray              # object [V], sorted
+
+    @staticmethod
+    def fit(*word_arrays: np.ndarray) -> "Vocabulary":
+        return Vocabulary(np.unique(np.concatenate(word_arrays)))
+
+    @property
+    def size(self) -> int:
+        return int(self.words.shape[0])
+
+    def ids(self, words: np.ndarray, strict: bool = True) -> np.ndarray:
+        """Map word strings to ids; unknown words -> -1 (strict=False)."""
+        idx = np.searchsorted(self.words, words)
+        idx = np.clip(idx, 0, self.size - 1)
+        ok = self.words[idx] == words
+        if strict and not ok.all():
+            missing = np.unique(np.asarray(words)[~ok])[:5]
+            raise KeyError(f"unknown words (first 5): {missing.tolist()}")
+        return np.where(ok, idx, -1).astype(np.int32)
+
+    def save(self, path: str | pathlib.Path) -> None:
+        pathlib.Path(path).write_text("\n".join(self.words) + "\n")
+
+    @staticmethod
+    def load(path: str | pathlib.Path) -> "Vocabulary":
+        return Vocabulary(np.array(
+            pathlib.Path(path).read_text().splitlines(), dtype=object))
+
+
+@dataclasses.dataclass
+class CorpusBundle:
+    """A built corpus plus everything needed to attribute scores back to
+    source events and to reproduce the build."""
+
+    corpus: Corpus                 # includes feedback-duplicated tokens
+    vocab: Vocabulary
+    doc_keys: np.ndarray           # object [D] doc id -> IP string
+    token_event: np.ndarray        # int64 [n_real_tokens] token -> event row
+    n_real_tokens: int             # tokens from real events (before feedback)
+
+    def doc_index(self, ips: np.ndarray) -> np.ndarray:
+        idx = np.searchsorted(self.doc_keys, ips)
+        idx = np.clip(idx, 0, len(self.doc_keys) - 1)
+        if not (self.doc_keys[idx] == ips).all():
+            raise KeyError("IP not in corpus")
+        return idx.astype(np.int32)
+
+
+def build_corpus(words: WordTable,
+                 feedback: pd.DataFrame | None = None,
+                 dupfactor: int = 1000) -> CorpusBundle:
+    """Assemble the integer corpus; append feedback tokens ×dupfactor.
+
+    Feedback rows are (ip, word) pairs the analyst labeled NOT suspicious
+    (oa label == 3 in the reference's severity scheme [R-med]); massively
+    duplicating them raises p(word|ip) so similar events stop surfacing —
+    exactly the reference's DUPFACTOR mechanism (SURVEY.md §2.1 #8).
+    Feedback referencing unseen ips/words is ignored (stale feedback from
+    an earlier vocabulary must not poison today's run).
+    """
+    doc_keys = np.unique(words.ip)
+    vocab = Vocabulary.fit(words.word)
+    doc_of = {k: i for i, k in enumerate(doc_keys)}
+
+    doc_ids = np.array([doc_of[i] for i in words.ip], np.int32)
+    word_ids = vocab.ids(words.word)
+
+    fb_docs = np.empty(0, np.int32)
+    fb_words = np.empty(0, np.int32)
+    if feedback is not None and len(feedback):
+        ips = feedback["ip"].astype(str).to_numpy()
+        ws = feedback["word"].astype(str).to_numpy()
+        known = np.array([i in doc_of for i in ips])
+        wid = vocab.ids(ws, strict=False)
+        keep = known & (wid >= 0)
+        if keep.any():
+            fb_docs = np.repeat(
+                np.array([doc_of[i] for i in ips[keep]], np.int32), dupfactor)
+            fb_words = np.repeat(wid[keep], dupfactor)
+
+    corpus = Corpus(
+        doc_ids=np.concatenate([doc_ids, fb_docs]),
+        word_ids=np.concatenate([word_ids, fb_words]),
+        n_docs=len(doc_keys),
+        n_vocab=vocab.size,
+    )
+    return CorpusBundle(
+        corpus=corpus,
+        vocab=vocab,
+        doc_keys=doc_keys,
+        token_event=words.event_idx.astype(np.int64),
+        n_real_tokens=words.n_rows,
+    )
+
+
+def event_scores(bundle: CorpusBundle, token_scores: np.ndarray,
+                 n_events: int) -> np.ndarray:
+    """Per-event score = min over the event's tokens (most suspicious
+    direction wins — flow events carry a src-doc and a dst-doc token).
+
+    `token_scores` covers the REAL tokens only (feedback duplicates are
+    training-only and never scored)."""
+    if token_scores.shape[0] != bundle.n_real_tokens:
+        raise ValueError("token_scores must cover exactly the real tokens")
+    out = np.full(n_events, np.inf, np.float64)
+    np.minimum.at(out, bundle.token_event, token_scores)
+    return out

@@ -1,0 +1,176 @@
+"""The scoring run: one day of one datatype, end to end.
+
+The `ml_ops.sh <date> <type> [TOL] [MAXRESULTS]` equivalent
+(SURVEY.md §3.1): read the day's partition from the store, create words,
+build the corpus (applying analyst feedback ×DUPFACTOR), fit the LDA
+engine (batched collapsed Gibbs or streaming SVI), score every raw
+event, and emit the per-day results CSV for OA plus a run manifest
+(config hash, seed, convergence series — SURVEY.md §5.5).
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+
+from onix.config import OnixConfig
+from onix.models.scoring import bottom_k, score_all
+from onix.pipelines.corpus_build import CorpusBundle, build_corpus, event_scores
+from onix.pipelines.words import WORD_FNS
+from onix.store import Store, feedback_path, results_path
+
+
+BENIGN_LABEL = 3   # the reference's severity scale: 1/2 = threat, 3 = benign
+
+
+def load_feedback(cfg: OnixConfig, datatype: str, date: str) -> pd.DataFrame | None:
+    """Most recent feedback CSV at or before `date` (the reference consumes
+    the analyst labels on the NEXT ML run — SURVEY.md §3.3).
+
+    Only rows the analyst marked BENIGN bias the model — duplicating a
+    confirmed-threat row would teach the model to stop surfacing the
+    attack pattern."""
+    fdir = pathlib.Path(cfg.store.feedback_dir)
+    if not fdir.exists():
+        return None
+    candidates = sorted(fdir.glob(f"{datatype}_scores_*.csv"))
+    cutoff = feedback_path(fdir, datatype, date).name
+    eligible = [p for p in candidates if p.name <= cutoff]
+    if not eligible:
+        return None
+    fb = pd.read_csv(eligible[-1], dtype=str)
+    if "label" in fb.columns:
+        fb = fb[pd.to_numeric(fb["label"], errors="coerce") == BENIGN_LABEL]
+    return fb
+
+
+def fit_engine(cfg: OnixConfig, bundle: CorpusBundle, engine: str) -> dict:
+    """Fit theta/phi_wk with the requested engine on the bundle's corpus."""
+    corpus = bundle.corpus
+    if engine == "gibbs":
+        from onix.models.lda_gibbs import GibbsLDA
+        model = GibbsLDA(cfg.lda, corpus.n_docs, corpus.n_vocab)
+        fit = model.fit(corpus)
+        return {"theta": fit["theta"], "phi_wk": fit["phi_wk"],
+                "ll_history": fit["ll_history"]}
+    if engine == "sharded":
+        from onix.parallel.mesh import make_mesh
+        from onix.parallel.sharded_gibbs import ShardedGibbsLDA
+        mesh = make_mesh(dp=cfg.mesh.dp, mp=1)
+        model = ShardedGibbsLDA(cfg.lda, corpus.n_vocab, mesh=mesh)
+        fit = model.fit(corpus)
+        return {"theta": np.asarray(fit["theta"]),
+                "phi_wk": np.asarray(fit["phi_wk"]),
+                "ll_history": fit.get("ll_history", [])}
+    if engine == "svi":
+        from onix.models.lda_svi import SVILda, make_minibatch, phi_estimate
+        model = SVILda(cfg.lda, corpus.n_vocab, corpus.n_docs)
+        state = model.init()
+        order = np.random.default_rng(cfg.lda.seed).permutation(corpus.n_tokens)
+        bs = cfg.lda.svi_batch_size
+        gamma_by_doc = np.full((corpus.n_docs, cfg.lda.n_topics),
+                               cfg.lda.alpha, np.float32)
+        n_batches = max(1, (corpus.n_tokens + bs - 1) // bs)
+        for e in range(max(1, cfg.lda.n_sweeps // 10)):
+            for b in range(n_batches):
+                sel = order[b * bs:(b + 1) * bs]
+                if sel.size == 0:
+                    continue
+                batch = make_minibatch(corpus.doc_ids[sel],
+                                       corpus.word_ids[sel],
+                                       pad_to=bs, pad_docs=min(bs, corpus.n_docs))
+                state, gamma = model.update(state, batch)
+                gm = np.asarray(gamma)
+                dm = np.asarray(batch.doc_map)
+                real = dm >= 0
+                gamma_by_doc[dm[real]] = gm[real]
+        theta = gamma_by_doc / gamma_by_doc.sum(1, keepdims=True)
+        return {"theta": theta, "phi_wk": np.asarray(phi_estimate(state)),
+                "ll_history": []}
+    raise ValueError(f"unknown engine {engine!r}")
+
+
+def run_scoring(cfg: OnixConfig, engine: str = "gibbs",
+                table: pd.DataFrame | None = None) -> int:
+    """Execute one scoring run; returns a process exit code.
+
+    `table` lets tests/embedding callers inject the day's events directly;
+    otherwise the store partition for (datatype, date) is read.
+    """
+    t0 = time.time()
+    datatype = cfg.pipeline.datatype
+    date = cfg.pipeline.date
+    store = Store(cfg.store.root)
+    if table is None:
+        table = store.read(datatype, date)
+    n_events = len(table)
+
+    words = WORD_FNS[datatype](table)
+    feedback = load_feedback(cfg, datatype, date)
+    bundle = build_corpus(words, feedback, cfg.pipeline.dupfactor)
+
+    fit = fit_engine(cfg, bundle, engine)
+
+    # Score REAL tokens only (feedback duplicates are training-only).
+    tok_scores = score_all(
+        fit["theta"], fit["phi_wk"],
+        bundle.corpus.doc_ids[:bundle.n_real_tokens],
+        bundle.corpus.word_ids[:bundle.n_real_tokens])
+    ev_scores = event_scores(bundle, tok_scores, n_events)
+
+    # Filter < TOL, ascending, top MAXRESULTS (SURVEY.md §3.1 POST-LDA) —
+    # through the fused device selection scan, the same path the 1B-event
+    # benchmark exercises.
+    sel = bottom_k(jnp.asarray(ev_scores.astype(np.float32)),
+                   tol=cfg.pipeline.tol,
+                   max_results=min(cfg.pipeline.max_results, n_events))
+    sel_idx = np.asarray(sel.indices)
+    top = sel_idx[sel_idx >= 0]
+
+    results = table.iloc[top].copy()
+    results.insert(0, "score", ev_scores[top])
+    results.insert(1, "event_idx", top)
+    # Word/doc provenance: attribute each selected event to the token that
+    # ACHIEVED its min score (for flow that may be the dst-IP doc — the
+    # analyst must label the endpoint that actually drove the detection,
+    # or the feedback loop can never suppress it).
+    achieving = np.flatnonzero(
+        tok_scores <= ev_scores[bundle.token_event])
+    min_tok = np.full(n_events, -1, np.int64)
+    # Reversed fancy assignment: last write wins, so each event keeps its
+    # FIRST achieving token.
+    min_tok[bundle.token_event[achieving][::-1]] = achieving[::-1]
+    results.insert(2, "ip", bundle.doc_keys[
+        bundle.corpus.doc_ids[min_tok[top]]])
+    results.insert(3, "word", bundle.vocab.words[
+        bundle.corpus.word_ids[min_tok[top]]])
+
+    out_csv = results_path(cfg.store.results_dir, datatype, date)
+    out_csv.parent.mkdir(parents=True, exist_ok=True)
+    results.to_csv(out_csv, index=False)
+
+    # Run manifest (SURVEY.md §5.5: config hash, data partition, seed).
+    manifest = {
+        "datatype": datatype, "date": date, "engine": engine,
+        "config_hash": cfg.config_hash,
+        "seed": cfg.lda.seed,
+        "n_events": int(n_events),
+        "n_docs": int(bundle.corpus.n_docs),
+        "n_vocab": int(bundle.corpus.n_vocab),
+        "n_tokens": int(bundle.corpus.n_tokens),
+        "n_feedback_tokens": int(bundle.corpus.n_tokens - bundle.n_real_tokens),
+        "n_results": int(len(results)),
+        "wall_seconds": round(time.time() - t0, 3),
+        "ll_history": fit["ll_history"],
+        "bin_edges": {k: (v if isinstance(v, list) else np.asarray(v).tolist())
+                      for k, v in words.edges.items()},
+    }
+    out_csv.with_suffix(".manifest.json").write_text(
+        json.dumps(manifest, indent=2))
+    cfg.archive(out_csv.with_suffix(".config.json"))
+    return 0

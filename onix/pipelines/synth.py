@@ -1,0 +1,313 @@
+"""Synthetic telemetry generators with planted anomalies.
+
+The reference validated end-to-end behavior with a canned demo day
+(2016-07-08, reference README.md:50-58) — the Docker demo effectively IS
+its integration fixture (SURVEY.md §4). The mount carries no data, so
+onix generates its own demo days.
+
+Background traffic is ROLE-STRUCTURED: each host draws a mixture over a
+small set of behavior profiles (web browsing, DNS-heavy, backup, mail,
+…) and its events are emitted from that mixture — the same latent
+structure real enterprise traffic has and exactly what a topic model can
+learn per-IP. Anomalies are off-profile events (exfil-shaped flows,
+DGA/tunnel DNS, beaconing proxy hits) whose row indices are returned for
+assertion — the "filter billion of events to a few thousands" contract
+(reference README.md:42).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pandas as pd
+
+DEMO_DATE = "2016-07-08"
+
+
+def _ips(n_hosts: int, prefix: str = "10.0") -> np.ndarray:
+    return np.array([f"{prefix}.{i // 256}.{i % 256}" for i in range(n_hosts)])
+
+
+def _host_mixture(rng: np.random.Generator, n_hosts: int,
+                  n_profiles: int) -> np.ndarray:
+    """Sparse per-host profile mixture (each host has 1-2 dominant roles)."""
+    return rng.dirichlet(np.full(n_profiles, 0.3), size=n_hosts)
+
+
+def _times(date: str, hours: np.ndarray) -> list[str]:
+    hh = hours.astype(int)
+    mm = ((hours - hh) * 60).astype(int)
+    return [f"{date} {h:02d}:{m:02d}:00" for h, m in zip(hh, mm)]
+
+
+def _shuffle(table: pd.DataFrame, n_bg: int, n_events: int,
+             rng: np.random.Generator) -> tuple[pd.DataFrame, np.ndarray]:
+    """Shuffle rows; return (table, new indices of the planted anomalies)."""
+    perm = rng.permutation(n_events)
+    table = table.iloc[perm].reset_index(drop=True)
+    inv = np.empty(n_events, np.int64)
+    inv[perm] = np.arange(n_events)
+    return table, np.sort(inv[np.arange(n_bg, n_events)])
+
+
+# ---------------------------------------------------------------------------
+# flow
+# ---------------------------------------------------------------------------
+
+# (dport, proto, peak_hour, hour_sd, log_pkt_mu, log_byte_per_pkt_mu)
+_FLOW_PROFILES = [
+    (443, "TCP", 14.0, 2.5, 3.0, 6.2),    # web browsing
+    (80, "TCP", 11.0, 3.0, 2.5, 6.0),     # legacy web
+    (53, "UDP", 13.0, 5.0, 0.7, 4.2),     # dns chatter
+    (22, "TCP", 10.0, 4.0, 4.0, 5.5),     # ssh/dev
+    (445, "TCP", 2.0, 1.5, 6.0, 7.0),     # nightly backup/smb
+    (25, "TCP", 9.0, 3.0, 3.5, 6.5),      # mail
+]
+
+
+def synth_flow_day(n_events: int = 20000, n_hosts: int = 120,
+                   n_anomalies: int = 30, date: str = DEMO_DATE,
+                   seed: int = 0) -> tuple[pd.DataFrame, np.ndarray]:
+    """One day of netflow records (nfdump-style columns, SURVEY.md §2.1 #2).
+
+    Returns (table, anomaly_row_indices)."""
+    rng = np.random.default_rng(seed)
+    hosts = _ips(n_hosts)
+    n_prof = len(_FLOW_PROFILES)
+    mix = _host_mixture(rng, n_hosts, n_prof)
+    # Each profile talks to its own small server pool (per-role peers).
+    servers = {p: np.array([f"192.168.{p}.{i + 1}" for i in range(4)])
+               for p in range(n_prof)}
+
+    n_bg = n_events - n_anomalies
+    h_idx = rng.integers(0, n_hosts, n_bg)
+    # Vectorized profile draw per event from the host's mixture.
+    u = rng.random(n_bg)
+    prof = (mix[h_idx].cumsum(axis=1) < u[:, None]).sum(axis=1)
+    prof = np.clip(prof, 0, n_prof - 1)
+
+    cfg = np.array(_FLOW_PROFILES, dtype=object)
+    dport = np.array([cfg[p][0] for p in prof], np.int64)
+    proto = np.array([cfg[p][1] for p in prof], dtype=object)
+    hour = np.clip(rng.normal([cfg[p][2] for p in prof],
+                              [cfg[p][3] for p in prof]), 0, 23.99)
+    ipkt = np.exp(rng.normal([cfg[p][4] for p in prof], 0.6)).astype(np.int64) + 1
+    bpp = np.exp(rng.normal([cfg[p][5] for p in prof], 0.3)).astype(np.int64) + 40
+    ibyt = ipkt * bpp
+    sip = hosts[h_idx]
+    dip = np.array([servers[p][i % 4] for p, i in
+                    zip(prof, rng.integers(0, 4, n_bg))])
+    sport = rng.integers(1025, 65535, n_bg)
+
+    # Anomalies: exfil-shaped — ephemeral↔ephemeral ports to rare external
+    # peers, off-hours, outsized transfers; heterogeneous so no single
+    # signature word repeats enough to form its own topic.
+    a_sip = hosts[rng.integers(0, n_hosts, n_anomalies)]
+    a_dip = np.array([f"203.0.{rng.integers(0, 16)}.{rng.integers(1, 255)}"
+                      for _ in range(n_anomalies)])
+    a_dport = rng.integers(31337, 65535, n_anomalies)
+    a_sport = rng.integers(1025, 65535, n_anomalies)
+    a_proto = np.full(n_anomalies, "TCP", dtype=object)
+    a_hour = rng.uniform(0, 6, n_anomalies)
+    a_ipkt = np.exp(rng.normal(7, 1.5, n_anomalies)).astype(np.int64) + 1
+    a_ibyt = a_ipkt * rng.integers(900, 1460, n_anomalies)
+
+    def col(bg, an):
+        return np.concatenate([bg, an])
+
+    table = pd.DataFrame({
+        "treceived": _times(date, col(hour, a_hour)),
+        "sip": col(sip, a_sip),
+        "dip": col(dip, a_dip),
+        "sport": col(sport, a_sport).astype(np.int32),
+        "dport": col(dport, a_dport).astype(np.int32),
+        "proto": col(proto, a_proto),
+        "ipkt": col(ipkt, a_ipkt),
+        "ibyt": col(ibyt, a_ibyt),
+        "opkt": (col(ipkt, a_ipkt) * 0.8).astype(np.int64),
+        "obyt": (col(ibyt, a_ibyt) * 0.3).astype(np.int64),
+    })
+    return _shuffle(table, n_bg, n_events, rng)
+
+
+# ---------------------------------------------------------------------------
+# dns
+# ---------------------------------------------------------------------------
+
+# (domain pool, subdomain pool, qtype dist, peak_hour, hour_sd)
+_DNS_PROFILES = [
+    (["google.com", "gstatic.com", "youtube.com"],
+     ["www", "", "apis"], [1, 28], 13.0, 3.0),
+    (["github.com", "npmjs.org", "pypi.org"],
+     ["api", "registry", ""], [1, 28], 11.0, 2.5),
+    (["office365.com", "windowsupdate.com", "live.com"],
+     ["outlook", "login", "update"], [1], 10.0, 3.5),
+    (["netflix.com", "nflxvideo.net", "akamai.net"],
+     ["www", "cdn", "media"], [1, 28], 20.0, 2.5),
+    (["facebook.com", "fbcdn.net", "instagram.com"],
+     ["www", "static", "edge"], [1], 15.0, 4.0),
+]
+
+
+def synth_dns_day(n_events: int = 20000, n_hosts: int = 120,
+                  n_anomalies: int = 30, date: str = DEMO_DATE,
+                  seed: int = 0) -> tuple[pd.DataFrame, np.ndarray]:
+    """One day of DNS replies (tshark-style columns, SURVEY.md §2.1 #6).
+
+    Anomalies: DGA/tunnel-shaped — long high-entropy subdomains, TXT
+    queries, off-hours, NXDOMAIN mixes."""
+    rng = np.random.default_rng(seed)
+    hosts = _ips(n_hosts)
+    n_prof = len(_DNS_PROFILES)
+    mix = _host_mixture(rng, n_hosts, n_prof)
+
+    n_bg = n_events - n_anomalies
+    h_idx = rng.integers(0, n_hosts, n_bg)
+    u = rng.random(n_bg)
+    prof = np.clip((mix[h_idx].cumsum(axis=1) < u[:, None]).sum(axis=1),
+                   0, n_prof - 1)
+
+    qname, qtype, hour = [], [], []
+    for p in prof:
+        doms, subs, qts, mu, sd = _DNS_PROFILES[p]
+        sub = subs[rng.integers(0, len(subs))]
+        dom = doms[rng.integers(0, len(doms))]
+        qname.append(f"{sub}.{dom}" if sub else dom)
+        qtype.append(qts[rng.integers(0, len(qts))])
+        hour.append(np.clip(rng.normal(mu, sd), 0, 23.99))
+    qname = np.array(qname, dtype=object)
+    qtype = np.array(qtype, np.int32)
+    hour = np.array(hour)
+    rcode = np.zeros(n_bg, np.int32)
+    frame_len = (80 + 1.2 * np.char.str_len(qname.astype(str))
+                 + rng.integers(0, 12, n_bg)).astype(np.int32)
+
+    alphabet = list("abcdefghijklmnopqrstuvwxyz0123456789")
+
+    def dga():
+        n = rng.integers(18, 40)
+        return "".join(rng.choice(alphabet, n)) + "." + \
+            rng.choice(["biz", "info", "notld", "xy"])
+
+    a_qname = np.array([dga() for _ in range(n_anomalies)], dtype=object)
+    a_hour = rng.uniform(0, 6, n_anomalies)
+    a_qtype = rng.choice([16, 10, 255], n_anomalies).astype(np.int32)  # TXT/NULL/ANY
+    a_rcode = rng.choice([0, 3], n_anomalies).astype(np.int32)
+    a_frame_len = (120 + 4 * np.char.str_len(a_qname.astype(str))).astype(np.int32)
+
+    def col(bg, an):
+        return np.concatenate([bg, an])
+
+    table = pd.DataFrame({
+        "frame_time": _times(date, col(hour, a_hour)),
+        "frame_len": col(frame_len, a_frame_len),
+        "ip_dst": col(hosts[h_idx], hosts[rng.integers(0, n_hosts, n_anomalies)]),
+        "dns_qry_name": col(qname, a_qname),
+        "dns_qry_type": col(qtype, a_qtype),
+        "dns_qry_rcode": col(rcode, a_rcode),
+    })
+    return _shuffle(table, n_bg, n_events, rng)
+
+
+# ---------------------------------------------------------------------------
+# proxy
+# ---------------------------------------------------------------------------
+
+# (site pool, path pool, content type, method dist, peak_hour)
+_PROXY_PROFILES = [
+    (["www.google.com", "www.bing.com"],
+     ["/search?q=news", "/search?q=weather", "/"], "text/html", 13.0),
+    (["cdn.jsdelivr.net", "static.cloudflare.com"],
+     ["/js/app.min.js", "/css/site.css", "/fonts/r.woff2"],
+     "application/javascript", 12.0),
+    (["update.microsoft.com", "dl.delivery.mp.microsoft.com"],
+     ["/update/v11/cab", "/filestream/x"],
+     "application/octet-stream", 4.0),
+    (["www.youtube.com", "i.ytimg.com"],
+     ["/watch?v=abc123", "/vi/xyz/hq.jpg"], "video/mp4", 19.0),
+    (["mail.office365.com", "outlook.office.com"],
+     ["/owa/", "/api/v2/messages"], "application/json", 10.0),
+]
+
+_AGENTS = np.array([
+    "Mozilla/5.0 (Windows NT 10.0; Win64; x64)",
+    "Mozilla/5.0 (Macintosh; Intel Mac OS X 10_15)",
+    "Mozilla/5.0 (X11; Linux x86_64)"])
+
+
+def synth_proxy_day(n_events: int = 20000, n_hosts: int = 120,
+                    n_anomalies: int = 30, date: str = DEMO_DATE,
+                    seed: int = 0) -> tuple[pd.DataFrame, np.ndarray]:
+    """One day of proxy logs (Bluecoat-style columns, SURVEY.md §2.1 #1).
+
+    Anomalies: beaconing to raw-IP hosts, long high-entropy URIs, rare
+    agents, octet-stream POSTs at night."""
+    rng = np.random.default_rng(seed)
+    hosts = _ips(n_hosts)
+    n_prof = len(_PROXY_PROFILES)
+    mix = _host_mixture(rng, n_hosts, n_prof)
+
+    n_bg = n_events - n_anomalies
+    h_idx = rng.integers(0, n_hosts, n_bg)
+    u = rng.random(n_bg)
+    prof = np.clip((mix[h_idx].cumsum(axis=1) < u[:, None]).sum(axis=1),
+                   0, n_prof - 1)
+
+    site, path, ctype, hour = [], [], [], []
+    for p in prof:
+        sites, paths, ct, mu = _PROXY_PROFILES[p]
+        site.append(sites[rng.integers(0, len(sites))])
+        path.append(paths[rng.integers(0, len(paths))])
+        ctype.append(ct)
+        hour.append(np.clip(rng.normal(mu, 2.5), 0, 23.99))
+    site = np.array(site, dtype=object)
+    path = np.array(path, dtype=object)
+    ctype = np.array(ctype, dtype=object)
+    hour = np.array(hour)
+    method = rng.choice(np.array(["GET", "POST"]), n_bg, p=[.92, .08])
+    respcode = rng.choice([200, 304, 404], n_bg, p=[.85, .1, .05])
+    agent = _AGENTS[rng.integers(0, len(_AGENTS), n_bg)]
+    csbytes = np.exp(rng.normal(6, 1, n_bg)).astype(np.int64)
+
+    # Anomalies come from distinct small "campaigns" (different tools,
+    # URI styles, hours) so they are heterogeneous in word space — a
+    # single repeated signature would form its own topic and stop being
+    # rare to the model (the same reason the reference needs DUPFACTOR
+    # to deliberately un-rare analyst-cleared patterns).
+    junk_alpha = list("abcdefghijklmnopqrstuvwxyz0123456789%2F")
+
+    def junk(lo, hi):
+        return "/" + "".join(rng.choice(junk_alpha, rng.integers(lo, hi)))
+
+    camp_len = [(30, 60), (60, 120), (120, 400), (25, 45), (200, 400)]
+    camp = rng.integers(0, len(camp_len), n_anomalies)
+    a_paths = np.array([junk(*camp_len[c]) for c in camp], dtype=object)
+    a_sites = np.array([f"198.51.{rng.integers(0, 100)}.{rng.integers(1, 255)}"
+                        for _ in range(n_anomalies)], dtype=object)
+    a_hour = np.clip(camp * 1.7 + rng.uniform(0, 1.5, n_anomalies), 0, 23.99)
+    a_agents = np.array([f"tool{c}/{rng.integers(1, 9)}.{rng.integers(0, 9)}"
+                         for c in camp], dtype=object)
+    a_cs = np.exp(rng.normal(10, 1, n_anomalies)).astype(np.int64)
+
+    def col(bg, an):
+        return np.concatenate([bg, an])
+
+    hours_all = col(hour, a_hour)
+    table = pd.DataFrame({
+        "p_date": np.full(n_events, date),
+        "p_time": [t.split(" ")[1] for t in _times(date, hours_all)],
+        "clientip": col(hosts[h_idx], hosts[rng.integers(0, n_hosts, n_anomalies)]),
+        "host": col(site, a_sites),
+        "reqmethod": col(method, np.full(n_anomalies, "POST", dtype=object)),
+        "useragent": col(agent, a_agents),
+        "resconttype": col(ctype, np.full(n_anomalies,
+                                          "application/octet-stream",
+                                          dtype=object)),
+        "respcode": col(respcode, rng.choice([200, 503], n_anomalies)).astype(np.int32),
+        "uripath": col(path, a_paths),
+        "csbytes": col(csbytes, a_cs),
+        "scbytes": np.exp(rng.normal(7, 1, n_events)).astype(np.int64),
+    })
+    return _shuffle(table, n_bg, n_events, rng)
+
+
+SYNTH = {"flow": synth_flow_day, "dns": synth_dns_day, "proxy": synth_proxy_day}

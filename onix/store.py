@@ -1,0 +1,101 @@
+"""Partitioned telemetry store — the Hive/HDFS substrate equivalent.
+
+The reference keeps telemetry in Hive tables `flow`/`dns`/`proxy`
+partitioned by y/m/d(/h) on HDFS (SURVEY.md §2.1 #3, L3; reference
+README.md:37 "Load data in Hadoop"). onix keeps the same logical layout
+as a local (or network-mounted) Parquet dataset:
+
+    <root>/<datatype>/y=YYYY/m=MM/d=DD/part-NNNNN.parquet
+
+Stage boundaries remain files (SURVEY.md §1 "Interfaces between layers
+are files, not RPCs") so every stage stays independently re-runnable.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import pathlib
+import re
+
+import numpy as np
+import pandas as pd
+
+DATE_RE = re.compile(r"^(\d{4})-?(\d{2})-?(\d{2})$")
+
+
+def parse_date(date: str) -> tuple[str, str, str]:
+    """'2016-07-08' or '20160708' -> ('2016', '07', '08')."""
+    m = DATE_RE.match(date)
+    if not m:
+        raise ValueError(f"bad date {date!r}; want YYYY-MM-DD or YYYYMMDD")
+    return m.group(1), m.group(2), m.group(3)
+
+
+@dataclasses.dataclass
+class Store:
+    root: str | pathlib.Path
+
+    def partition_dir(self, datatype: str, date: str) -> pathlib.Path:
+        y, mo, d = parse_date(date)
+        return pathlib.Path(self.root) / datatype / f"y={y}" / f"m={mo}" / f"d={d}"
+
+    def write(self, datatype: str, date: str, table: pd.DataFrame,
+              part: int = 0) -> pathlib.Path:
+        """Write one partition file (append-style via distinct part numbers)."""
+        pdir = self.partition_dir(datatype, date)
+        pdir.mkdir(parents=True, exist_ok=True)
+        path = pdir / f"part-{part:05d}.parquet"
+        table.to_parquet(path, index=False)
+        return path
+
+    def read(self, datatype: str, date: str) -> pd.DataFrame:
+        """Read a full day partition (all part files, concatenated in order)."""
+        pdir = self.partition_dir(datatype, date)
+        parts = sorted(pdir.glob("part-*.parquet"))
+        if not parts:
+            raise FileNotFoundError(
+                f"no data for {datatype} {date} under {pdir}")
+        return pd.concat([pd.read_parquet(p) for p in parts],
+                         ignore_index=True)
+
+    def dates(self, datatype: str) -> list[str]:
+        """All dates with data for a datatype, ascending."""
+        base = pathlib.Path(self.root) / datatype
+        out = []
+        for ddir in base.glob("y=*/m=*/d=*"):
+            if any(ddir.glob("part-*.parquet")):
+                y = ddir.parent.parent.name[2:]
+                mo = ddir.parent.name[2:]
+                d = ddir.name[2:]
+                out.append(f"{y}-{mo}-{d}")
+        return sorted(out)
+
+    def has(self, datatype: str, date: str) -> bool:
+        try:
+            return any(self.partition_dir(datatype, date).glob("part-*.parquet"))
+        except ValueError:
+            return False
+
+
+def results_path(results_dir: str | pathlib.Path, datatype: str,
+                 date: str) -> pathlib.Path:
+    """Per-day scored-results CSV for OA — the L4→L5 contract
+    (SURVEY.md §1: 'a scored-results CSV per day per datatype')."""
+    y, mo, d = parse_date(date)
+    return (pathlib.Path(results_dir) / f"{y}{mo}{d}"
+            / f"{datatype}_results.csv")
+
+
+def feedback_path(feedback_dir: str | pathlib.Path, datatype: str,
+                  date: str) -> pathlib.Path:
+    """Analyst feedback CSV the next ML run consumes (the L5→L4 noise
+    filter loop, reference README.md:48)."""
+    y, mo, d = parse_date(date)
+    return (pathlib.Path(feedback_dir) / f"{datatype}_scores_{y}{mo}{d}.csv")
+
+
+def hour_of(ts: pd.Series) -> np.ndarray:
+    """Hour-of-day [0,24) as float (hour + minute fraction) from a
+    timestamp-like column (string or datetime)."""
+    dt = pd.to_datetime(ts, format="mixed")
+    return (dt.dt.hour + dt.dt.minute / 60.0).to_numpy(np.float32)

@@ -68,3 +68,33 @@ def test_planted_anomalies_rank_suspicious():
     bottom = set(np.argsort(scores, kind="stable")[:200].tolist())
     hits = len(bottom & set(planted.tolist()))
     assert hits >= 14, f"only {hits}/20 planted anomalies in bottom-200"
+
+
+def test_bottom_k_matches_top_suspicious():
+    """bottom_k over precomputed scores == the fused top_suspicious path."""
+    import jax.numpy as jnp
+    from onix.models.scoring import bottom_k, score_events, top_suspicious
+
+    rng = np.random.default_rng(4)
+    theta = rng.dirichlet(np.full(6, 0.5), size=40).astype(np.float32)
+    phi_wk = rng.dirichlet(np.full(6, 0.5), size=90).astype(np.float32)
+    d = jnp.asarray(rng.integers(0, 40, 5000).astype(np.int32))
+    w = jnp.asarray(rng.integers(0, 90, 5000).astype(np.int32))
+    m = jnp.ones(5000, np.float32)
+    fused = top_suspicious(jnp.asarray(theta), jnp.asarray(phi_wk), d, w, m,
+                           tol=0.02, max_results=50, chunk=512)
+    scores = score_events(jnp.asarray(theta), jnp.asarray(phi_wk), d, w)
+    split = bottom_k(scores, tol=0.02, max_results=50, chunk=512)
+    np.testing.assert_allclose(np.asarray(fused.scores),
+                               np.asarray(split.scores), rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(fused.indices),
+                                  np.asarray(split.indices))
+
+
+def test_bottom_k_fewer_qualifying_than_k():
+    import jax.numpy as jnp
+    from onix.models.scoring import bottom_k
+
+    scores = jnp.asarray(np.array([0.5, 0.1, 0.9, 0.2], np.float32))
+    out = bottom_k(scores, tol=0.3, max_results=4, chunk=2)
+    np.testing.assert_array_equal(np.asarray(out.indices), [1, 3, -1, -1])

@@ -1,0 +1,135 @@
+"""Word-creation unit tests (SURVEY.md §4.1: hand-computed examples,
+determinism, feedback duplication)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from onix.pipelines import synth
+from onix.pipelines.corpus_build import Vocabulary, build_corpus, event_scores
+from onix.pipelines.words import (WORD_FNS, _port_class, dns_words,
+                                  flow_words, proxy_words)
+
+
+def test_port_class_hand_examples():
+    sport = np.array([44123, 80, 443, 22, 55555])
+    dport = np.array([443, 51234, 80, 1024, 44444])
+    out = _port_class(sport, dport)
+    assert out.tolist() == ["443", "80", "80", "22", "HH"]
+
+
+@pytest.fixture(scope="module")
+def flow_day():
+    return synth.synth_flow_day(n_events=2000, n_anomalies=10, seed=1)
+
+
+def test_flow_words_shape_and_docs(flow_day):
+    table, _ = flow_day
+    wt = flow_words(table)
+    # Two rows per event: src doc and dst doc, same word.
+    assert wt.n_rows == 2 * len(table)
+    np.testing.assert_array_equal(wt.word[:len(table)], wt.word[len(table):])
+    assert (wt.ip[:len(table)] == table["sip"].to_numpy()).all()
+    assert (wt.ip[len(table):] == table["dip"].to_numpy()).all()
+
+
+def test_flow_words_deterministic_and_edge_reuse(flow_day):
+    table, _ = flow_day
+    a = flow_words(table)
+    b = flow_words(table)
+    np.testing.assert_array_equal(a.word, b.word)
+    # Apply-mode with fitted edges on a subset reproduces the same words.
+    sub = table.iloc[:100]
+    c = flow_words(sub, edges=a.edges)
+    np.testing.assert_array_equal(c.word[:100], a.word[:100])
+
+
+def test_dns_word_components():
+    table = pd.DataFrame({
+        "frame_time": ["2016-07-08 10:00:00", "2016-07-08 03:30:00"],
+        "frame_len": [80, 400],
+        "ip_dst": ["10.0.0.1", "10.0.0.2"],
+        "dns_qry_name": ["www.example.com", "qqqqjx0vz9k.notarealtld"],
+        "dns_qry_type": [1, 16],
+        "dns_qry_rcode": [0, 3],
+    })
+    wt = dns_words(table, n_bins=2)
+    parts0 = wt.word[0].split("_")
+    parts1 = wt.word[1].split("_")
+    assert len(parts0) == 8
+    assert parts0[-1] == "1" and parts1[-1] == "0"   # TLD validity flag
+    assert parts0[5] == "1" and parts1[5] == "16"     # qtype
+    assert parts0[6] == "0" and parts1[6] == "3"      # rcode
+    assert (wt.ip == table["ip_dst"].to_numpy()).all()
+
+
+def test_proxy_words_rare_agent_and_ip_host():
+    n = 60
+    table = pd.DataFrame({
+        "p_date": ["2016-07-08"] * n,
+        "p_time": ["12:00:00"] * n,
+        "clientip": [f"10.0.0.{i}" for i in range(n)],
+        "host": ["www.ok.com"] * (n - 1) + ["198.51.100.7"],
+        "reqmethod": ["GET"] * n,
+        "useragent": ["Mozilla/5.0"] * (n - 1) + ["weird-agent/0.1"],
+        "resconttype": ["text/html"] * n,
+        "respcode": [200] * n,
+        "uripath": ["/index.html"] * n,
+        "csbytes": [500] * n,
+    })
+    wt = proxy_words(table, n_bins=2)
+    # Word layout: code-class_ua_hostisip_urilenbin_urientropybin_hourbin.
+    # The single weird agent collapses to RARE ('R'), host-is-ip flag set.
+    last = wt.word[-1].split("_")
+    first = wt.word[0].split("_")
+    assert last[1] == "R" and first[1].startswith("C")
+    assert last[2] == "1" and first[2] == "0"
+
+
+def test_vocabulary_roundtrip(tmp_path):
+    v = Vocabulary.fit(np.array(["b", "a", "b", "c"], dtype=object))
+    assert v.size == 3
+    np.testing.assert_array_equal(v.ids(np.array(["a", "c"])), [0, 2])
+    with pytest.raises(KeyError):
+        v.ids(np.array(["zz"]))
+    assert v.ids(np.array(["zz"]), strict=False)[0] == -1
+    v.save(tmp_path / "vocab.txt")
+    v2 = Vocabulary.load(tmp_path / "vocab.txt")
+    np.testing.assert_array_equal(v.words, v2.words)
+
+
+def test_build_corpus_feedback_duplication(flow_day):
+    table, _ = flow_day
+    wt = flow_words(table)
+    base = build_corpus(wt, feedback=None)
+    fb = pd.DataFrame({"ip": [wt.ip[0]], "word": [wt.word[0]]})
+    dup = build_corpus(wt, feedback=fb, dupfactor=50)
+    assert dup.corpus.n_tokens == base.corpus.n_tokens + 50
+    # Stale feedback (unknown ip/word) is dropped, not an error.
+    stale = pd.DataFrame({"ip": ["1.2.3.4"], "word": ["NOPE"]})
+    same = build_corpus(wt, feedback=stale, dupfactor=50)
+    assert same.corpus.n_tokens == base.corpus.n_tokens
+
+
+def test_event_scores_min_aggregation(flow_day):
+    table, _ = flow_day
+    wt = flow_words(table)
+    bundle = build_corpus(wt)
+    tok = np.arange(bundle.n_real_tokens, dtype=np.float64)
+    ev = event_scores(bundle, tok, len(table))
+    # Each flow event has tokens at i and i+n; min is i.
+    np.testing.assert_array_equal(ev, np.arange(len(table), dtype=np.float64))
+    with pytest.raises(ValueError):
+        event_scores(bundle, tok[:-1], len(table))
+
+
+@pytest.mark.parametrize("datatype", ["flow", "dns", "proxy"])
+def test_synth_days_word_pipeline(datatype):
+    table, anomalies = synth.SYNTH[datatype](n_events=1500, n_anomalies=10,
+                                             seed=3)
+    assert len(table) == 1500
+    wt = WORD_FNS[datatype](table)
+    assert wt.n_rows >= 1500
+    bundle = build_corpus(wt)
+    assert bundle.corpus.n_vocab > 10
+    assert bundle.corpus.n_docs > 10
