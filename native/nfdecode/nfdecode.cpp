@@ -1,0 +1,181 @@
+// onix-nfdecode — C++ binary netflow decoder (≙ oni-nfdump, reference
+// .gitmodules:13-15, README.md:83; SURVEY.md §2.4.2).
+//
+// The reference carries a patched fork of the nfdump C tool to turn binary
+// netflow captures into text for the flow ingest path (SURVEY.md §3.2:
+// "subprocess: oni-nfdump binary decodes nfcapd → CSV"). onix implements
+// its own decoder for the OPEN protocol — Cisco NetFlow v5 export packets
+// (24-byte header + N×48-byte records, big-endian) — rather than porting
+// nfdump's proprietary internal nfcapd framing. A capture file here is a
+// concatenation of v5 export packets as received off the wire.
+//
+// Exposed as a C ABI for ctypes (onix/ingest/nfdecode.py): two-pass
+// (count, then fill caller-allocated SoA arrays — no ownership transfer
+// across the FFI), plus a CLI that streams CSV to stdout.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+constexpr size_t kHeaderLen = 24;
+constexpr size_t kRecordLen = 48;
+constexpr uint16_t kVersion = 5;
+constexpr uint16_t kMaxRecordsPerPacket = 30;  // v5 spec: <= 30 flows/packet
+
+uint16_t be16(const uint8_t* p) {
+  return (uint16_t)((p[0] << 8) | p[1]);
+}
+uint32_t be32(const uint8_t* p) {
+  return ((uint32_t)p[0] << 24) | ((uint32_t)p[1] << 16) |
+         ((uint32_t)p[2] << 8) | (uint32_t)p[3];
+}
+
+struct PacketView {
+  const uint8_t* records;  // first record
+  uint16_t count;
+  uint32_t sys_uptime_ms;
+  uint32_t unix_secs;
+};
+
+// Validate + view one packet at `p`. Returns bytes consumed, 0 on error.
+size_t parse_header(const uint8_t* p, size_t remaining, PacketView* out) {
+  if (remaining < kHeaderLen) return 0;
+  if (be16(p) != kVersion) return 0;
+  const uint16_t count = be16(p + 2);
+  if (count == 0 || count > kMaxRecordsPerPacket) return 0;
+  const size_t need = kHeaderLen + (size_t)count * kRecordLen;
+  if (remaining < need) return 0;
+  out->records = p + kHeaderLen;
+  out->count = count;
+  out->sys_uptime_ms = be32(p + 4);
+  out->unix_secs = be32(p + 8);
+  return need;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Count records in a buffer of concatenated v5 packets. Returns the
+// record count, or -1 if the buffer is malformed (trailing garbage,
+// bad version, truncated packet).
+int64_t nf5_count(const uint8_t* buf, int64_t len) {
+  if (!buf || len < 0) return -1;
+  int64_t total = 0;
+  size_t off = 0;
+  while (off < (size_t)len) {
+    PacketView pv;
+    const size_t used = parse_header(buf + off, (size_t)len - off, &pv);
+    if (used == 0) return -1;
+    total += pv.count;
+    off += used;
+  }
+  return total;
+}
+
+// Decode into caller-allocated arrays of length `n` (from nf5_count).
+// Flow start time = unix_secs - (sys_uptime - First)/1000 (standard v5
+// uptime arithmetic). Returns the number of records written, -1 on error.
+int64_t nf5_decode(const uint8_t* buf, int64_t len, int64_t n,
+                   uint32_t* sip, uint32_t* dip, uint16_t* sport,
+                   uint16_t* dport, uint8_t* proto, uint8_t* tcp_flags,
+                   uint32_t* dpkts, uint32_t* doctets, double* start_ts,
+                   double* end_ts) {
+  if (!buf || !sip || !dip || !sport || !dport || !proto || !tcp_flags ||
+      !dpkts || !doctets || !start_ts || !end_ts)
+    return -1;
+  int64_t i = 0;
+  size_t off = 0;
+  while (off < (size_t)len) {
+    PacketView pv;
+    const size_t used = parse_header(buf + off, (size_t)len - off, &pv);
+    if (used == 0) return -1;
+    for (uint16_t r = 0; r < pv.count; ++r) {
+      if (i >= n) return -1;
+      const uint8_t* rec = pv.records + (size_t)r * kRecordLen;
+      sip[i] = be32(rec + 0);
+      dip[i] = be32(rec + 4);
+      dpkts[i] = be32(rec + 16);
+      doctets[i] = be32(rec + 20);
+      const uint32_t first_ms = be32(rec + 24);
+      const uint32_t last_ms = be32(rec + 28);
+      sport[i] = be16(rec + 32);
+      dport[i] = be16(rec + 34);
+      tcp_flags[i] = rec[37];
+      proto[i] = rec[38];
+      // Router boot epoch = unix_secs - uptime/1000; flow times are
+      // offsets from boot. int64 math: First may exceed uptime (clock
+      // skew in the exporter) — keep the signed difference exact.
+      const double boot =
+          (double)pv.unix_secs - (double)pv.sys_uptime_ms / 1000.0;
+      start_ts[i] = boot + (double)first_ms / 1000.0;
+      end_ts[i] = boot + (double)last_ms / 1000.0;
+      ++i;
+    }
+    off += used;
+  }
+  return i;
+}
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// CLI: nfdecode <capture.nf5>  — stream CSV to stdout, one row per flow,
+// schema matching the ingest path's flow table (onix/ingest/nfdecode.py).
+// ---------------------------------------------------------------------------
+
+#ifndef ONIX_NFDECODE_NO_MAIN
+int main(int argc, char** argv) {
+  if (argc != 2) {
+    std::fprintf(stderr, "usage: %s <capture.nf5>\n", argv[0]);
+    return 2;
+  }
+  FILE* f = std::fopen(argv[1], "rb");
+  if (!f) {
+    std::perror(argv[1]);
+    return 1;
+  }
+  std::fseek(f, 0, SEEK_END);
+  const long sz = std::ftell(f);
+  std::fseek(f, 0, SEEK_SET);
+  std::vector<uint8_t> buf((size_t)sz);
+  if (std::fread(buf.data(), 1, (size_t)sz, f) != (size_t)sz) {
+    std::fclose(f);
+    std::fprintf(stderr, "short read\n");
+    return 1;
+  }
+  std::fclose(f);
+
+  const int64_t n = nf5_count(buf.data(), sz);
+  if (n < 0) {
+    std::fprintf(stderr, "malformed netflow v5 stream\n");
+    return 1;
+  }
+  std::vector<uint32_t> sip(n), dip(n), dpkts(n), doctets(n);
+  std::vector<uint16_t> sport(n), dport(n);
+  std::vector<uint8_t> proto(n), flags(n);
+  std::vector<double> t0(n), t1(n);
+  if (nf5_decode(buf.data(), sz, n, sip.data(), dip.data(), sport.data(),
+                 dport.data(), proto.data(), flags.data(), dpkts.data(),
+                 doctets.data(), t0.data(), t1.data()) != n) {
+    std::fprintf(stderr, "decode error\n");
+    return 1;
+  }
+  std::printf("start_ts,end_ts,sip,dip,sport,dport,proto,tcp_flags,ipkt,ibyt\n");
+  auto ip_str = [](uint32_t ip, char* out) {
+    std::snprintf(out, 16, "%u.%u.%u.%u", (ip >> 24) & 255, (ip >> 16) & 255,
+                  (ip >> 8) & 255, ip & 255);
+  };
+  char a[16], b[16];
+  for (int64_t i = 0; i < n; ++i) {
+    ip_str(sip[i], a);
+    ip_str(dip[i], b);
+    std::printf("%.3f,%.3f,%s,%s,%u,%u,%u,%u,%u,%u\n", t0[i], t1[i], a, b,
+                sport[i], dport[i], proto[i], flags[i], dpkts[i], doctets[i]);
+  }
+  return 0;
+}
+#endif

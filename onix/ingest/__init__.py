@@ -1,0 +1,8 @@
+"""Parallel ingest: decoders + watcher/worker loading into the store.
+
+The TPU-era rendering of oni-ingest (reference README.md:35-38,79;
+SURVEY.md §2.1 #1, §3.2) without the Kafka/Hadoop footprint: a polling
+directory watcher fans decoded files out to a worker pool that writes
+partitioned Parquet (onix.store) — same collector→worker→store shape,
+one process.
+"""

@@ -1,0 +1,185 @@
+"""ctypes bridge to the C++ netflow decoder + a v5 packet writer.
+
+The decoder (native/nfdecode) stands in for the reference's patched
+nfdump fork (SURVEY.md §2.1 #2): binary NetFlow v5 capture → flow table.
+The writer generates spec-conformant v5 packet streams for round-trip
+tests and synthetic captures (SURVEY.md §4.1 "C++ decoder round-trip on
+synthesized nfcapd records").
+"""
+
+from __future__ import annotations
+
+import ctypes
+import pathlib
+import struct
+import subprocess
+
+import numpy as np
+import pandas as pd
+
+_NATIVE_DIR = pathlib.Path(__file__).resolve().parent.parent.parent / \
+    "native" / "nfdecode"
+_LIB_PATH = _NATIVE_DIR / "build" / "libonix_nfdecode.so"
+_BIN_PATH = _NATIVE_DIR / "build" / "nfdecode"
+
+_lib = None
+
+PROTO_NAMES = {1: "ICMP", 6: "TCP", 17: "UDP", 47: "GRE", 50: "ESP"}
+
+
+class DecoderUnavailable(RuntimeError):
+    pass
+
+
+def _stale() -> bool:
+    if not _LIB_PATH.exists() or not _BIN_PATH.exists():
+        return True
+    built = min(_LIB_PATH.stat().st_mtime, _BIN_PATH.stat().st_mtime)
+    return any(built < (_NATIVE_DIR / f).stat().st_mtime
+               for f in ("nfdecode.cpp", "Makefile"))
+
+
+def load_library() -> ctypes.CDLL:
+    global _lib
+    if _lib is not None:
+        return _lib
+    if _stale():
+        try:
+            subprocess.run(["make", "-C", str(_NATIVE_DIR)], check=True,
+                           capture_output=True, text=True)
+        except (OSError, subprocess.CalledProcessError) as e:
+            detail = getattr(e, "stderr", "") or str(e)
+            raise DecoderUnavailable(f"cannot build nfdecode: {detail}") from e
+    lib = ctypes.CDLL(str(_LIB_PATH))
+    u8 = ctypes.POINTER(ctypes.c_uint8)
+    u16 = ctypes.POINTER(ctypes.c_uint16)
+    u32 = ctypes.POINTER(ctypes.c_uint32)
+    f64 = ctypes.POINTER(ctypes.c_double)
+    lib.nf5_count.restype = ctypes.c_int64
+    lib.nf5_count.argtypes = [u8, ctypes.c_int64]
+    lib.nf5_decode.restype = ctypes.c_int64
+    lib.nf5_decode.argtypes = [u8, ctypes.c_int64, ctypes.c_int64,
+                               u32, u32, u16, u16, u8, u8, u32, u32, f64, f64]
+    _lib = lib
+    return lib
+
+
+def ip_to_str(ips: np.ndarray) -> np.ndarray:
+    """uint32 host-order IPs -> dotted-quad strings, vectorized."""
+    ips = np.asarray(ips, np.uint32)
+    return np.char.add(
+        np.char.add(
+            np.char.add((ips >> 24).astype(str), "."),
+            np.char.add(((ips >> 16) & 255).astype(str), ".")),
+        np.char.add(((ips >> 8) & 255).astype(str),
+                    np.char.add(".", (ips & 255).astype(str))))
+
+
+def str_to_ip(strs) -> np.ndarray:
+    parts = np.array([s.split(".") for s in strs], np.uint32)
+    return (parts[:, 0] << 24) | (parts[:, 1] << 16) | (parts[:, 2] << 8) | parts[:, 3]
+
+
+def decode_bytes(data: bytes) -> pd.DataFrame:
+    """Decode a v5 packet stream into the ingest flow table."""
+    lib = load_library()
+    buf = np.frombuffer(data, np.uint8)
+    bp = buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+    n = lib.nf5_count(bp, len(data))
+    if n < 0:
+        raise ValueError("malformed netflow v5 stream")
+    arrays = {
+        "sip": np.empty(n, np.uint32), "dip": np.empty(n, np.uint32),
+        "sport": np.empty(n, np.uint16), "dport": np.empty(n, np.uint16),
+        "proto": np.empty(n, np.uint8), "tcp_flags": np.empty(n, np.uint8),
+        "ipkt": np.empty(n, np.uint32), "ibyt": np.empty(n, np.uint32),
+        "start_ts": np.empty(n, np.float64), "end_ts": np.empty(n, np.float64),
+    }
+
+    def p(name, ct):
+        return arrays[name].ctypes.data_as(ctypes.POINTER(ct))
+
+    wrote = lib.nf5_decode(
+        bp, len(data), n,
+        p("sip", ctypes.c_uint32), p("dip", ctypes.c_uint32),
+        p("sport", ctypes.c_uint16), p("dport", ctypes.c_uint16),
+        p("proto", ctypes.c_uint8), p("tcp_flags", ctypes.c_uint8),
+        p("ipkt", ctypes.c_uint32), p("ibyt", ctypes.c_uint32),
+        p("start_ts", ctypes.c_double), p("end_ts", ctypes.c_double))
+    if wrote != n:
+        raise ValueError(f"decode error: wrote {wrote} of {n}")
+
+    ts = pd.to_datetime(arrays["start_ts"], unit="s")
+    return pd.DataFrame({
+        "treceived": ts.strftime("%Y-%m-%d %H:%M:%S"),
+        "sip": ip_to_str(arrays["sip"]),
+        "dip": ip_to_str(arrays["dip"]),
+        "sport": arrays["sport"].astype(np.int32),
+        "dport": arrays["dport"].astype(np.int32),
+        "proto": np.array([PROTO_NAMES.get(x, str(x))
+                           for x in arrays["proto"]], dtype=object),
+        "ipkt": arrays["ipkt"].astype(np.int64),
+        "ibyt": arrays["ibyt"].astype(np.int64),
+        "opkt": np.zeros(n, np.int64),    # v5 is unidirectional
+        "obyt": np.zeros(n, np.int64),
+        "tcp_flags": arrays["tcp_flags"].astype(np.int32),
+    })
+
+
+def decode_file(path: str | pathlib.Path) -> pd.DataFrame:
+    return decode_bytes(pathlib.Path(path).read_bytes())
+
+
+# -- v5 packet writer (synthetic captures + round-trip tests) --------------
+
+
+def write_v5(table: pd.DataFrame, *, sys_uptime_ms: int = 3_600_000,
+             records_per_packet: int = 30) -> bytes:
+    """Encode a flow table (uint32 sip/dip or dotted strings, numeric
+    ports/proto/counters, float start_ts/end_ts epoch seconds) as a
+    NetFlow v5 packet stream."""
+    n = len(table)
+    sip = table["sip"].to_numpy()
+    if sip.dtype.kind in ("U", "O", "S"):
+        sip = str_to_ip(table["sip"].astype(str))
+        dip = str_to_ip(table["dip"].astype(str))
+    else:
+        sip = sip.astype(np.uint32)
+        dip = table["dip"].to_numpy(np.uint32)
+    sport = table["sport"].to_numpy(np.int64)
+    dport = table["dport"].to_numpy(np.int64)
+    proto = table["proto"].to_numpy()
+    if proto.dtype.kind in ("U", "O", "S"):
+        rev = {v: k for k, v in PROTO_NAMES.items()}
+        proto = np.array([rev.get(str(x).upper(), 6) for x in proto], np.int64)
+    ipkt = table["ipkt"].to_numpy(np.int64)
+    ibyt = table["ibyt"].to_numpy(np.int64)
+    start = table["start_ts"].to_numpy(np.float64)
+    end = table["end_ts"].to_numpy(np.float64)
+    flags = (table["tcp_flags"].to_numpy(np.int64)
+             if "tcp_flags" in table else np.zeros(n, np.int64))
+
+    out = bytearray()
+    seq = 0
+    for lo in range(0, n, records_per_packet):
+        hi = min(lo + records_per_packet, n)
+        cnt = hi - lo
+        # Router "boot" chosen per packet so flow offsets fit in uint32 ms:
+        # unix_secs = first flow start; First/Last are offsets from boot.
+        unix_secs = int(start[lo])
+        boot = unix_secs - sys_uptime_ms / 1000.0
+        out += struct.pack(">HHIIIIBBH", 5, cnt, sys_uptime_ms, unix_secs,
+                           0, seq, 0, 0, 0)
+        for i in range(lo, hi):
+            first_ms = max(0, int(round((start[i] - boot) * 1000)))
+            last_ms = max(first_ms, int(round((end[i] - boot) * 1000)))
+            out += struct.pack(
+                ">IIIHHIIIIHHBBBBHHBBH",
+                int(sip[i]), int(dip[i]), 0, 0, 0,
+                int(ipkt[i]) & 0xFFFFFFFF, int(ibyt[i]) & 0xFFFFFFFF,
+                first_ms & 0xFFFFFFFF, last_ms & 0xFFFFFFFF,
+                int(sport[i]) & 0xFFFF, int(dport[i]) & 0xFFFF,
+                0, int(flags[i]) & 0xFF, int(proto[i]) & 0xFF, 0,
+                0, 0, 0, 0, 0)
+        seq += cnt
+    return bytes(out)

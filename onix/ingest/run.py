@@ -1,0 +1,80 @@
+"""Ingest execution: decode raw telemetry files into store partitions.
+
+The collector→worker→Hive-load path of the reference (SURVEY.md §3.2)
+rendered as: decode (C++ nfdecode subprocess-free via ctypes, tshark TSV,
+Bluecoat log) → partition rows by day → write Parquet parts. Each input
+file becomes its own part file (numbered by an atomic per-partition
+counter), so parallel workers never collide — the reference got the same
+property from HDFS staging files.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import threading
+
+import pandas as pd
+
+from onix.config import OnixConfig
+from onix.store import Store
+
+_part_lock = threading.Lock()
+
+
+def decode(datatype: str, path: str | pathlib.Path) -> pd.DataFrame:
+    if datatype == "flow":
+        from onix.ingest.nfdecode import decode_file
+        return decode_file(path)
+    if datatype == "dns":
+        from onix.ingest.parsers import parse_tshark_dns
+        return parse_tshark_dns(path)
+    if datatype == "proxy":
+        from onix.ingest.parsers import parse_bluecoat
+        return parse_bluecoat(path)
+    raise ValueError(f"unknown datatype {datatype!r}")
+
+
+def _day_of(datatype: str, table: pd.DataFrame) -> pd.Series:
+    if datatype == "flow":
+        return table["treceived"].str.slice(0, 10)
+    if datatype == "dns":
+        return table["frame_time"].str.slice(0, 10)
+    return table["p_date"].astype(str)
+
+
+def _next_part(store: Store, datatype: str, date: str) -> int:
+    """Next free part number for a partition (single-writer discipline:
+    guarded by a process-wide lock; SURVEY.md §5.2 'deterministic
+    single-writer queues')."""
+    pdir = store.partition_dir(datatype, date)
+    existing = sorted(pdir.glob("part-*.parquet"))
+    return (int(existing[-1].stem.split("-")[1]) + 1) if existing else 0
+
+
+def ingest_file(store: Store, datatype: str,
+                path: str | pathlib.Path) -> dict[str, int]:
+    """Decode one raw file and append its rows to the day partitions it
+    spans. Returns {date: n_rows}."""
+    table = decode(datatype, path)
+    out: dict[str, int] = {}
+    if not len(table):
+        return out
+    for date, day_rows in table.groupby(_day_of(datatype, table)):
+        with _part_lock:
+            part = _next_part(store, datatype, str(date))
+            store.write(datatype, str(date), day_rows.reset_index(drop=True),
+                        part=part)
+        out[str(date)] = len(day_rows)
+    return out
+
+
+def run_ingest(cfg: OnixConfig, datatype: str, paths: list[str]) -> int:
+    store = Store(cfg.store.root)
+    total = 0
+    for p in paths:
+        counts = ingest_file(store, datatype, p)
+        for date, n in sorted(counts.items()):
+            print(f"{p}: {n} rows -> {datatype} {date}")
+            total += n
+    print(f"ingested {total} rows from {len(paths)} file(s)")
+    return 0

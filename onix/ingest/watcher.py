@@ -1,0 +1,122 @@
+"""Directory watcher + worker pool — the ingest collector.
+
+The reference's master collector watches landing directories and fans
+work out to workers over Kafka (SURVEY.md §3.2). onix keeps the shape —
+a polling watcher feeding a bounded worker pool — in one process with a
+durable ledger of processed files, so restart gives at-least-once
+redelivery (the property Kafka offsets gave the reference) without a
+broker dependency. Files are claimed atomically from the ledger
+(single-writer discipline, SURVEY.md §5.2).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import json
+import pathlib
+import threading
+import time
+
+from onix.config import OnixConfig
+from onix.ingest.run import ingest_file
+from onix.store import Store
+
+
+class Ledger:
+    """Durable record of files already ingested (name+size+mtime keyed),
+    guarded by a lock for worker threads."""
+
+    def __init__(self, path: str | pathlib.Path):
+        self.path = pathlib.Path(path)
+        self._lock = threading.Lock()
+        self._done: dict[str, list] = {}
+        if self.path.exists():
+            self._done = json.loads(self.path.read_text())
+
+    @staticmethod
+    def _key(p: pathlib.Path) -> tuple[str, list]:
+        st = p.stat()
+        return str(p.resolve()), [st.st_size, st.st_mtime]
+
+    def claim(self, p: pathlib.Path) -> bool:
+        """Atomically claim a file; False if already processed unchanged."""
+        key, sig = self._key(p)
+        with self._lock:
+            if self._done.get(key) == sig:
+                return False
+            self._done[key] = sig
+            self._flush()
+            return True
+
+    def release(self, p: pathlib.Path) -> None:
+        """Un-claim after a failed ingest so the next poll retries it."""
+        key = str(p.resolve())
+        with self._lock:
+            self._done.pop(key, None)
+            self._flush()
+
+    def _flush(self) -> None:
+        tmp = self.path.with_suffix(".tmp")
+        tmp.write_text(json.dumps(self._done))
+        tmp.replace(self.path)
+
+
+class IngestWatcher:
+    """Poll a landing directory; ingest new files via a worker pool."""
+
+    def __init__(self, cfg: OnixConfig, datatype: str,
+                 landing_dir: str | pathlib.Path,
+                 n_workers: int = 2, poll_interval: float = 0.5,
+                 patterns: tuple[str, ...] = ("*.nf5", "*.tsv", "*.log",
+                                              "*.csv")):
+        self.cfg = cfg
+        self.datatype = datatype
+        self.landing = pathlib.Path(landing_dir)
+        self.store = Store(cfg.store.root)
+        self.poll_interval = poll_interval
+        self.patterns = patterns
+        self.ledger = Ledger(self.landing / ".onix_ingest_ledger.json")
+        self._pool = concurrent.futures.ThreadPoolExecutor(n_workers)
+        self._stop = threading.Event()
+        self._stats_lock = threading.Lock()
+        self.stats: dict[str, int] = {"files": 0, "rows": 0, "errors": 0}
+
+    def _candidates(self) -> list[pathlib.Path]:
+        out: list[pathlib.Path] = []
+        for pat in self.patterns:
+            out.extend(self.landing.glob(pat))
+        return sorted(out)
+
+    def _work(self, path: pathlib.Path) -> None:
+        try:
+            counts = ingest_file(self.store, self.datatype, path)
+            with self._stats_lock:
+                self.stats["files"] += 1
+                self.stats["rows"] += sum(counts.values())
+        except Exception:
+            self.ledger.release(path)
+            with self._stats_lock:
+                self.stats["errors"] += 1
+
+    def poll_once(self) -> int:
+        """One poll cycle; returns the number of files dispatched."""
+        dispatched = 0
+        futures = []
+        for path in self._candidates():
+            if self.ledger.claim(path):
+                futures.append(self._pool.submit(self._work, path))
+                dispatched += 1
+        concurrent.futures.wait(futures)
+        return dispatched
+
+    def run(self, max_seconds: float | None = None) -> None:
+        t0 = time.time()
+        while not self._stop.is_set():
+            self.poll_once()
+            if max_seconds is not None and time.time() - t0 > max_seconds:
+                break
+            self._stop.wait(self.poll_interval)
+        self._pool.shutdown(wait=True)
+
+    def stop(self) -> None:
+        self._stop.set()

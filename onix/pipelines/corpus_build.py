@@ -21,6 +21,18 @@ from onix.corpus import Corpus
 from onix.pipelines.words import WordTable
 
 
+def _lookup_sorted(keys: np.ndarray, values: np.ndarray, strict: bool,
+                   what: str) -> np.ndarray:
+    """Vectorized sorted-array lookup; unknown values -> -1 (strict=False)."""
+    idx = np.searchsorted(keys, values)
+    idx = np.clip(idx, 0, len(keys) - 1)
+    ok = keys[idx] == values
+    if strict and not ok.all():
+        missing = np.unique(np.asarray(values)[~ok])[:5]
+        raise KeyError(f"unknown {what} (first 5): {missing.tolist()}")
+    return np.where(ok, idx, -1).astype(np.int32)
+
+
 @dataclasses.dataclass
 class Vocabulary:
     """Deterministic word-string ↔ integer-id mapping (sorted unique)."""
@@ -37,13 +49,7 @@ class Vocabulary:
 
     def ids(self, words: np.ndarray, strict: bool = True) -> np.ndarray:
         """Map word strings to ids; unknown words -> -1 (strict=False)."""
-        idx = np.searchsorted(self.words, words)
-        idx = np.clip(idx, 0, self.size - 1)
-        ok = self.words[idx] == words
-        if strict and not ok.all():
-            missing = np.unique(np.asarray(words)[~ok])[:5]
-            raise KeyError(f"unknown words (first 5): {missing.tolist()}")
-        return np.where(ok, idx, -1).astype(np.int32)
+        return _lookup_sorted(self.words, words, strict, "words")
 
     def save(self, path: str | pathlib.Path) -> None:
         pathlib.Path(path).write_text("\n".join(self.words) + "\n")
@@ -65,12 +71,9 @@ class CorpusBundle:
     token_event: np.ndarray        # int64 [n_real_tokens] token -> event row
     n_real_tokens: int             # tokens from real events (before feedback)
 
-    def doc_index(self, ips: np.ndarray) -> np.ndarray:
-        idx = np.searchsorted(self.doc_keys, ips)
-        idx = np.clip(idx, 0, len(self.doc_keys) - 1)
-        if not (self.doc_keys[idx] == ips).all():
-            raise KeyError("IP not in corpus")
-        return idx.astype(np.int32)
+    def doc_index(self, ips: np.ndarray, strict: bool = True) -> np.ndarray:
+        """Map IP strings to doc ids; unknown IPs -> -1 (strict=False)."""
+        return _lookup_sorted(self.doc_keys, ips, strict, "IPs")
 
 
 def build_corpus(words: WordTable,
@@ -87,22 +90,20 @@ def build_corpus(words: WordTable,
     """
     doc_keys = np.unique(words.ip)
     vocab = Vocabulary.fit(words.word)
-    doc_of = {k: i for i, k in enumerate(doc_keys)}
-
-    doc_ids = np.array([doc_of[i] for i in words.ip], np.int32)
+    # Vectorized searchsorted mapping — this runs once per token and is
+    # on the billion-event path.
+    doc_ids = _lookup_sorted(doc_keys, words.ip, True, "IPs")
     word_ids = vocab.ids(words.word)
 
     fb_docs = np.empty(0, np.int32)
     fb_words = np.empty(0, np.int32)
     if feedback is not None and len(feedback):
-        ips = feedback["ip"].astype(str).to_numpy()
-        ws = feedback["word"].astype(str).to_numpy()
-        known = np.array([i in doc_of for i in ips])
-        wid = vocab.ids(ws, strict=False)
-        keep = known & (wid >= 0)
+        did = _lookup_sorted(doc_keys, feedback["ip"].astype(str).to_numpy(),
+                             False, "IPs")
+        wid = vocab.ids(feedback["word"].astype(str).to_numpy(), strict=False)
+        keep = (did >= 0) & (wid >= 0)
         if keep.any():
-            fb_docs = np.repeat(
-                np.array([doc_of[i] for i in ips[keep]], np.int32), dupfactor)
+            fb_docs = np.repeat(did[keep], dupfactor)
             fb_words = np.repeat(wid[keep], dupfactor)
 
     corpus = Corpus(

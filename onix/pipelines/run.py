@@ -71,19 +71,29 @@ def fit_engine(cfg: OnixConfig, bundle: CorpusBundle, engine: str) -> dict:
         from onix.models.lda_svi import SVILda, make_minibatch, phi_estimate
         model = SVILda(cfg.lda, corpus.n_vocab, corpus.n_docs)
         state = model.init()
-        order = np.random.default_rng(cfg.lda.seed).permutation(corpus.n_tokens)
-        bs = cfg.lda.svi_batch_size
+        rng = np.random.default_rng(cfg.lda.seed)
+        # DOCUMENT minibatches (svi_batch_size is documents per batch —
+        # the config contract): group tokens by doc, batch whole docs.
+        order = np.argsort(corpus.doc_ids, kind="stable")
+        d_sorted = corpus.doc_ids[order]
+        w_sorted = corpus.word_ids[order]
+        bounds = np.searchsorted(d_sorted, np.arange(corpus.n_docs + 1))
+        bs_docs = min(cfg.lda.svi_batch_size, corpus.n_docs)
+        doc_perm = rng.permutation(corpus.n_docs)
+        doc_batches = [doc_perm[i:i + bs_docs]
+                       for i in range(0, corpus.n_docs, bs_docs)]
+        tok_sel = [np.concatenate([np.arange(bounds[d], bounds[d + 1])
+                                   for d in b]) for b in doc_batches]
+        # One static token shape across batches -> one compiled svi_step.
+        pad_to = max(int(s.size) for s in tok_sel)
         gamma_by_doc = np.full((corpus.n_docs, cfg.lda.n_topics),
                                cfg.lda.alpha, np.float32)
-        n_batches = max(1, (corpus.n_tokens + bs - 1) // bs)
-        for e in range(max(1, cfg.lda.n_sweeps // 10)):
-            for b in range(n_batches):
-                sel = order[b * bs:(b + 1) * bs]
+        for _ in range(max(1, cfg.lda.n_sweeps // 10)):
+            for sel in tok_sel:
                 if sel.size == 0:
                     continue
-                batch = make_minibatch(corpus.doc_ids[sel],
-                                       corpus.word_ids[sel],
-                                       pad_to=bs, pad_docs=min(bs, corpus.n_docs))
+                batch = make_minibatch(d_sorted[sel], w_sorted[sel],
+                                       pad_to=pad_to, pad_docs=bs_docs)
                 state, gamma = model.update(state, batch)
                 gm = np.asarray(gamma)
                 dm = np.asarray(batch.doc_map)
@@ -126,9 +136,11 @@ def run_scoring(cfg: OnixConfig, engine: str = "gibbs",
     # Filter < TOL, ascending, top MAXRESULTS (SURVEY.md §3.1 POST-LDA) —
     # through the fused device selection scan, the same path the 1B-event
     # benchmark exercises.
+    # bottom_k pads and sentinels unfilled slots itself, so max_results
+    # needs no clamping to n_events (and an empty day yields an empty CSV).
     sel = bottom_k(jnp.asarray(ev_scores.astype(np.float32)),
                    tol=cfg.pipeline.tol,
-                   max_results=min(cfg.pipeline.max_results, n_events))
+                   max_results=cfg.pipeline.max_results)
     sel_idx = np.asarray(sel.indices)
     top = sel_idx[sel_idx >= 0]
 

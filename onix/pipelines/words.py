@@ -186,9 +186,9 @@ def proxy_words(table: pd.DataFrame, n_bins: int = N_BINS_DEFAULT,
     host = table["host"].astype(str).to_numpy()
     host_is_ip = np.array([int(bool(_IP_RE.match(h))) for h in host], np.int64)
     ua = _ua_classes(table["useragent"].astype(str).to_numpy(), edges)
-    # Compact UA class id for the word string.
-    ua_id = np.array(["R" if a == "RARE" else f"C{edges['ua_common'].index(a)}"
-                      for a in ua], dtype=object)
+    # Compact UA class id for the word string (single O(n) map pass).
+    ua_code = {a: f"C{i}" for i, a in enumerate(edges["ua_common"])}
+    ua_id = np.array([ua_code.get(a, "R") for a in ua], dtype=object)
     code_class = (table["respcode"].to_numpy(np.int64) // 100)
 
     word = np.array(
