@@ -50,6 +50,18 @@ def build_parser() -> argparse.ArgumentParser:
     p_ingest.add_argument("datatype", choices=("flow", "dns", "proxy"))
     p_ingest.add_argument("paths", nargs="+", help="raw capture/log files")
 
+    p_stream = sub.add_parser(
+        "stream", help="streaming scoring: online-VB model updated and "
+                       "scored per ingest minibatch (one file = one batch)")
+    _add_common(p_stream)
+    p_stream.add_argument("datatype", choices=("flow", "dns", "proxy"))
+    p_stream.add_argument("paths", nargs="+", help="raw telemetry files, "
+                          "consumed in order as minibatches")
+    p_stream.add_argument("--buckets", type=int, default=1 << 15,
+                          help="hashed vocabulary size (static V)")
+    p_stream.add_argument("--epochs", type=int, default=1,
+                          help="replay the file list N times (burn-in)")
+
     p_oa = sub.add_parser(
         "oa", help="operational analytics: enrich scored results for the UI")
     _add_common(p_oa)
@@ -81,6 +93,11 @@ def main(argv: list[str] | None = None) -> int:
     if args.command == "ingest":
         from onix.ingest.run import run_ingest
         return run_ingest(cfg, args.datatype, args.paths)
+
+    if args.command == "stream":
+        from onix.pipelines.streaming import run_stream
+        return run_stream(cfg, args.datatype, args.paths,
+                          n_buckets=args.buckets, epochs=args.epochs)
 
     if args.command == "oa":
         from onix.oa.engine import run_oa

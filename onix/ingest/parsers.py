@@ -73,7 +73,11 @@ def parse_bluecoat(path: str | pathlib.Path) -> pd.DataFrame:
         line = line.strip()
         if not line or line.startswith("#"):
             continue
-        parts = shlex.split(line)
+        try:
+            parts = shlex.split(line)
+        except ValueError as e:     # unbalanced quote in a field
+            raise ValueError(f"{path}:{line_no}: unparseable log line "
+                             f"({e})") from e
         if len(parts) != len(BLUECOAT_FIELDS):
             raise ValueError(
                 f"{path}:{line_no}: expected {len(BLUECOAT_FIELDS)} fields, "
@@ -101,15 +105,20 @@ def parse_bluecoat(path: str | pathlib.Path) -> pd.DataFrame:
 
 
 def format_bluecoat(table: pd.DataFrame) -> str:
-    """Inverse of parse_bluecoat for synthetic captures/round-trip tests."""
+    """Inverse of parse_bluecoat for synthetic captures/round-trip tests.
+
+    Double quotes inside a user-agent are degraded to single quotes —
+    a '"' inside the quoted field would make the emitted line
+    unparseable (the same normalization proxy appliances apply)."""
     lines = []
     for _, r in table.iterrows():
         uripath, _, uriquery = str(r["uripath"]).partition("?")
+        ua = str(r["useragent"]).replace('"', "'")
         lines.append(" ".join([
             str(r["p_date"]), str(r["p_time"]), "120", str(r["clientip"]),
             str(r["respcode"]), "TCP_HIT", str(r["reqmethod"]), "http",
             str(r["host"]), "80", uripath or "/", uriquery or "-", "-", "-",
-            str(r["resconttype"]), f'"{r["useragent"]}"', "-",
+            str(r["resconttype"]), f'"{ua}"', "-",
             str(r["scbytes"]), str(r["csbytes"]),
         ]))
     return "\n".join(lines) + "\n"

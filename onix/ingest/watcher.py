@@ -7,12 +7,20 @@ durable ledger of processed files, so restart gives at-least-once
 redelivery (the property Kafka offsets gave the reference) without a
 broker dependency. Files are claimed atomically from the ledger
 (single-writer discipline, SURVEY.md §5.2).
+
+Delivery semantics: the ledger records a file only AFTER its rows are in
+the store, so a crash mid-ingest re-ingests the file on restart
+(at-least-once — duplicate part files are possible after a crash, never
+silent loss). A file must show the same size+mtime on two consecutive
+polls before it is claimed, so half-written or still-growing captures
+are left alone until the producer finishes them.
 """
 
 from __future__ import annotations
 
 import concurrent.futures
 import json
+import logging
 import pathlib
 import threading
 import time
@@ -21,15 +29,23 @@ from onix.config import OnixConfig
 from onix.ingest.run import ingest_file
 from onix.store import Store
 
+log = logging.getLogger("onix.ingest")
+
 
 class Ledger:
     """Durable record of files already ingested (name+size+mtime keyed),
-    guarded by a lock for worker threads."""
+    guarded by a lock for worker threads.
+
+    `claim` only reserves a file in memory (so two workers never race on
+    it); `commit` persists it as done once ingest succeeds. A crash
+    between the two leaves no durable record — the file is retried on
+    restart."""
 
     def __init__(self, path: str | pathlib.Path):
         self.path = pathlib.Path(path)
         self._lock = threading.Lock()
         self._done: dict[str, list] = {}
+        self._inflight: set[str] = set()
         if self.path.exists():
             self._done = json.loads(self.path.read_text())
 
@@ -39,19 +55,27 @@ class Ledger:
         return str(p.resolve()), [st.st_size, st.st_mtime]
 
     def claim(self, p: pathlib.Path) -> bool:
-        """Atomically claim a file; False if already processed unchanged."""
+        """Reserve a file for this process; False if done or in flight."""
         key, sig = self._key(p)
         with self._lock:
-            if self._done.get(key) == sig:
+            if self._done.get(key) == sig or key in self._inflight:
                 return False
+            self._inflight.add(key)
+            return True
+
+    def commit(self, p: pathlib.Path) -> None:
+        """Durably record a successfully ingested file."""
+        key, sig = self._key(p)
+        with self._lock:
+            self._inflight.discard(key)
             self._done[key] = sig
             self._flush()
-            return True
 
     def release(self, p: pathlib.Path) -> None:
         """Un-claim after a failed ingest so the next poll retries it."""
         key = str(p.resolve())
         with self._lock:
+            self._inflight.discard(key)
             self._done.pop(key, None)
             self._flush()
 
@@ -68,14 +92,17 @@ class IngestWatcher:
                  landing_dir: str | pathlib.Path,
                  n_workers: int = 2, poll_interval: float = 0.5,
                  patterns: tuple[str, ...] = ("*.nf5", "*.tsv", "*.log",
-                                              "*.csv")):
+                                              "*.csv"),
+                 require_stable: bool = True):
         self.cfg = cfg
         self.datatype = datatype
         self.landing = pathlib.Path(landing_dir)
         self.store = Store(cfg.store.root)
         self.poll_interval = poll_interval
         self.patterns = patterns
+        self.require_stable = require_stable
         self.ledger = Ledger(self.landing / ".onix_ingest_ledger.json")
+        self._last_sig: dict[str, list] = {}    # quiescence tracking
         self._pool = concurrent.futures.ThreadPoolExecutor(n_workers)
         self._stop = threading.Event()
         self._stats_lock = threading.Lock()
@@ -87,13 +114,25 @@ class IngestWatcher:
             out.extend(self.landing.glob(pat))
         return sorted(out)
 
+    def _stable(self, path: pathlib.Path) -> bool:
+        """True once size+mtime are unchanged since the previous poll —
+        a still-growing capture would otherwise be ingested twice (once
+        truncated, once whole), duplicating its head rows."""
+        key, sig = Ledger._key(path)
+        prev = self._last_sig.get(key)
+        self._last_sig[key] = sig
+        return prev == sig
+
     def _work(self, path: pathlib.Path) -> None:
         try:
             counts = ingest_file(self.store, self.datatype, path)
+            self.ledger.commit(path)
             with self._stats_lock:
                 self.stats["files"] += 1
                 self.stats["rows"] += sum(counts.values())
         except Exception:
+            log.exception("ingest failed for %s (will retry next poll)",
+                          path)
             self.ledger.release(path)
             with self._stats_lock:
                 self.stats["errors"] += 1
@@ -103,7 +142,13 @@ class IngestWatcher:
         dispatched = 0
         futures = []
         for path in self._candidates():
-            if self.ledger.claim(path):
+            try:
+                if self.require_stable and not self._stable(path):
+                    continue
+                claimed = self.ledger.claim(path)
+            except OSError:
+                continue    # vanished/rotated between glob and stat
+            if claimed:
                 futures.append(self._pool.submit(self._work, path))
                 dispatched += 1
         concurrent.futures.wait(futures)

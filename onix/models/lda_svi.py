@@ -88,10 +88,12 @@ def _e_log_dirichlet(x: jax.Array, axis: int) -> jax.Array:
 def svi_step(
     state: SVIState,
     batch: MiniBatch,
+    corpus_docs: jax.Array,  # D — total docs the stream represents; a
+    #                          TRACED scalar so a streaming driver can
+    #                          grow its running estimate without retracing
     *,
     alpha: float,
     eta: float,
-    corpus_docs: float,      # D — total docs the stream represents
     tau0: float,
     kappa: float,
     local_iters: int,
@@ -120,7 +122,7 @@ def svi_step(
     # Natural-gradient step on lambda, scaled to the full corpus by the
     # number of REAL documents in the batch (doc_map == -1 rows are padding).
     n_real = (batch.doc_map >= 0).sum().astype(jnp.float32)
-    scale = corpus_docs / jnp.maximum(n_real, 1.0)
+    scale = jnp.asarray(corpus_docs, jnp.float32) / jnp.maximum(n_real, 1.0)
     lam_hat = eta + scale * jnp.zeros_like(state.lam).at[batch.word_ids].add(phi)
     rho = (tau0 + state.step.astype(jnp.float32)) ** (-kappa)
     lam = (1.0 - rho) * state.lam + rho * lam_hat
@@ -143,7 +145,6 @@ class SVILda:
         self._step = jax.jit(functools.partial(
             svi_step,
             alpha=config.alpha, eta=config.eta,
-            corpus_docs=float(corpus_docs),
             tau0=config.svi_tau0, kappa=config.svi_kappa,
             local_iters=config.svi_local_iters,
         ), static_argnames=("batch_docs",))
@@ -151,5 +152,10 @@ class SVILda:
     def init(self) -> SVIState:
         return init_state(self.n_vocab, self.config.n_topics, self.config.seed)
 
-    def update(self, state: SVIState, batch: MiniBatch):
-        return self._step(state, batch, batch_docs=batch.n_docs)
+    def update(self, state: SVIState, batch: MiniBatch,
+               corpus_docs: float | None = None):
+        """One SVI step. `corpus_docs` overrides the construction-time D —
+        streaming callers pass their running distinct-doc estimate (traced,
+        so a growing value never retraces)."""
+        d = float(self.corpus_docs if corpus_docs is None else corpus_docs)
+        return self._step(state, batch, d, batch_docs=batch.n_docs)

@@ -58,6 +58,9 @@ def bottom_k(
     half of `top_suspicious` for callers that aggregate scores before
     selecting (e.g. flow events take the min over src/dst-doc tokens)."""
     n = scores.shape[0]
+    if n == 0:     # static shape: resolved at trace time, not per-call
+        return TopK(scores=jnp.full((max_results,), jnp.inf, jnp.float32),
+                    indices=jnp.full((max_results,), -1, jnp.int32))
     chunk = min(chunk, max(n, 1))
     pad = (-n) % chunk
     if pad:
@@ -103,6 +106,9 @@ def top_suspicious(
     fused scan — no host round-trips.
     """
     n = doc_ids.shape[0]
+    if n == 0:     # static shape: resolved at trace time, not per-call
+        return TopK(scores=jnp.full((max_results,), jnp.inf, jnp.float32),
+                    indices=jnp.full((max_results,), -1, jnp.int32))
     chunk = min(chunk, max(n, 1))
     pad = (-n) % chunk
     if pad:

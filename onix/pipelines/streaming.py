@@ -1,0 +1,249 @@
+"""Streaming scoring: online-VB LDA over ingest minibatches.
+
+Covers BASELINE.json configs[4] ("streaming online-VB LDA over
+oni-ingest minibatches (incremental scoring)") — a capability the
+reference does NOT have: oni-lda-c re-fits from scratch once per day
+(SURVEY.md §3.1), so a beacon that starts at 09:00 is invisible until
+the next day's batch run. onix scores each ingest minibatch the moment
+it lands, against a model updated by every batch seen so far.
+
+Streaming-specific design (vs the batch path in pipelines/run.py):
+
+- **Hashed vocabulary.** A batch run fits its vocabulary after seeing
+  the whole day; a stream never sees "the whole day". Words hash into a
+  fixed number of buckets (stable blake2b, not Python's per-process
+  hash), so the topic-word parameter lambda [V,K] has a static shape
+  forever — the XLA-friendly rendering of an unbounded vocabulary.
+- **Frozen bin edges.** Quantile edges are fitted on the first batch
+  (or a warmup batch) and applied verbatim afterwards; re-fitting per
+  batch would silently redefine every word mid-stream.
+- **Growing document table.** IPs get dense doc ids on first sight;
+  the per-doc gamma store grows by powers of two so the scoring step
+  compiles O(log D) times, not O(batches).
+- **Static shapes.** Token and doc axes of every minibatch are padded
+  to powers of two — a stream of irregular batches reuses a handful of
+  compiled programs (asserted in tests).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+
+import numpy as np
+import pandas as pd
+
+from onix.config import OnixConfig
+from onix.models.lda_svi import SVILda, SVIState, make_minibatch, phi_estimate
+from onix.models.scoring import score_all
+from onix.pipelines.words import WORD_FNS
+
+
+def _next_pow2(n: int, floor: int = 256) -> int:
+    p = floor
+    while p < n:
+        p <<= 1
+    return p
+
+
+class HashedVocabulary:
+    """Stable word-string → bucket-id map for unbounded streams.
+
+    blake2b (keyed by nothing, digest truncated to 8 bytes) mod
+    n_buckets: deterministic across processes/runs — Python's builtin
+    `hash` is salted per process and would scramble the model on every
+    restart. Collisions merge rare words into shared buckets, which for
+    a rarity detector is conservative (a colliding rare word can only
+    look MORE common, never less)."""
+
+    def __init__(self, n_buckets: int = 1 << 15):
+        if n_buckets < 2:
+            raise ValueError("n_buckets must be >= 2")
+        self.n_buckets = int(n_buckets)
+        self._cache: dict[str, int] = {}
+
+    def _one(self, word: str) -> int:
+        h = self._cache.get(word)
+        if h is None:
+            digest = hashlib.blake2b(word.encode(), digest_size=8).digest()
+            h = int.from_bytes(digest, "little") % self.n_buckets
+            self._cache[word] = h
+        return h
+
+    def ids(self, words: np.ndarray) -> np.ndarray:
+        uniq, inv = np.unique(np.asarray(words, dtype=object), return_inverse=True)
+        ids = np.fromiter((self._one(w) for w in uniq), np.int32, len(uniq))
+        return ids[inv]
+
+
+class DocTable:
+    """IP string → dense doc id, first-seen order (grows forever)."""
+
+    def __init__(self):
+        self._index: dict[str, int] = {}
+        self.keys: list[str] = []
+
+    @property
+    def n_docs(self) -> int:
+        return len(self.keys)
+
+    def ids(self, ips: np.ndarray) -> np.ndarray:
+        uniq, inv = np.unique(np.asarray(ips, dtype=object), return_inverse=True)
+        out = np.empty(len(uniq), np.int32)
+        for i, ip in enumerate(uniq):
+            idx = self._index.get(ip)
+            if idx is None:
+                idx = len(self.keys)
+                self._index[ip] = idx
+                self.keys.append(ip)
+            out[i] = idx
+        return out[inv]
+
+
+@dataclasses.dataclass
+class BatchResult:
+    """Incremental scoring output for one minibatch."""
+
+    scores: np.ndarray        # float64 [n_events] per-event score
+    alerts: pd.DataFrame      # events with score < tol, ascending, enriched
+    n_events: int
+    n_new_docs: int
+    step: int                 # global SVI step after this batch
+
+
+class StreamingScorer:
+    """Online-VB LDA fed by ingest minibatches, scoring as it goes.
+
+    Usage: one instance per datatype stream; call `process(table)` for
+    each decoded minibatch (a file, a Kafka-equivalent queue drain, a
+    store partition slice). Returns per-event scores plus the alert rows
+    under `tol`."""
+
+    def __init__(self, cfg: OnixConfig, datatype: str,
+                 n_buckets: int = 1 << 15):
+        cfg.validate()
+        self.cfg = cfg
+        self.datatype = datatype
+        self.vocab = HashedVocabulary(n_buckets)
+        self.docs = DocTable()
+        self.word_fn = WORD_FNS[datatype]
+        self.edges: dict | None = None
+        self.model = SVILda(cfg.lda, n_buckets, corpus_docs=1)
+        self.state: SVIState = self.model.init()
+        k = cfg.lda.n_topics
+        self._gamma = np.full((_next_pow2(1), k), cfg.lda.alpha, np.float32)
+        self.pad_shapes: set[tuple[int, int]] = set()   # compile accounting
+
+    # -- internals --------------------------------------------------------
+
+    def _grow(self, n_docs: int) -> None:
+        cap = self._gamma.shape[0]
+        if n_docs <= cap:
+            return
+        new_cap = _next_pow2(n_docs, floor=cap)
+        grown = np.full((new_cap, self._gamma.shape[1]),
+                        self.cfg.lda.alpha, np.float32)
+        grown[:cap] = self._gamma
+        self._gamma = grown
+
+    def _theta(self) -> np.ndarray:
+        """Padded-capacity doc-topic estimate; never-seen rows are the
+        uniform prior (maximally non-committal for brand-new IPs)."""
+        return self._gamma / self._gamma.sum(1, keepdims=True)
+
+    # -- the streaming step -----------------------------------------------
+
+    def process(self, table: pd.DataFrame) -> BatchResult:
+        """Word-create, model-update, and score one minibatch."""
+        n_events = len(table)
+        if n_events == 0:
+            return BatchResult(np.empty(0), table.iloc[0:0].copy(), 0, 0,
+                               int(self.state.step))
+        words = self.word_fn(table, edges=self.edges)
+        if self.edges is None:
+            self.edges = words.edges       # frozen from the first batch on
+        wid = self.vocab.ids(words.word)
+        docs_before = self.docs.n_docs
+        did = self.docs.ids(words.ip)
+        self._grow(self.docs.n_docs)
+
+        t = len(wid)
+        n_batch_docs = len(np.unique(did))
+        pad_to = _next_pow2(t)
+        pad_docs = _next_pow2(n_batch_docs, floor=64)
+        self.pad_shapes.add((pad_to, pad_docs))
+        batch = make_minibatch(did, wid, pad_to=pad_to, pad_docs=pad_docs)
+
+        # Corpus-size estimate for the natural-gradient scale: the docs
+        # seen so far (the standard running-D choice for streams).
+        self.state, gamma = self.model.update(
+            self.state, batch, corpus_docs=max(self.docs.n_docs, 2))
+        gm = np.asarray(gamma)
+        dm = np.asarray(batch.doc_map)
+        real = dm >= 0
+        self._gamma[dm[real]] = gm[real]
+
+        # Incremental scoring of THIS batch's events under the updated
+        # model (token padding reuses the batch's pow2 shape, so the
+        # scoring program compiles once per shape too).
+        theta = self._theta()
+        phi = np.asarray(phi_estimate(self.state))
+        d_pad = np.zeros(pad_to, np.int32)
+        w_pad = np.zeros(pad_to, np.int32)
+        d_pad[:t] = did
+        w_pad[:t] = wid
+        tok_scores = score_all(theta, phi, d_pad, w_pad, chunk=pad_to)[:t]
+
+        ev_scores = np.full(n_events, np.inf, np.float64)
+        np.minimum.at(ev_scores, words.event_idx, tok_scores)
+
+        tol = self.cfg.pipeline.tol
+        hit = np.flatnonzero(ev_scores < tol)
+        hit = hit[np.argsort(ev_scores[hit], kind="stable")]
+        hit = hit[: self.cfg.pipeline.max_results]
+        alerts = table.iloc[hit].copy()
+        alerts.insert(0, "score", ev_scores[hit])
+        alerts.insert(1, "event_idx", hit)
+
+        return BatchResult(scores=ev_scores, alerts=alerts,
+                           n_events=n_events,
+                           n_new_docs=self.docs.n_docs - docs_before,
+                           step=int(self.state.step))
+
+
+def run_stream(cfg: OnixConfig, datatype: str, paths: list[str],
+               n_buckets: int = 1 << 15, epochs: int = 1) -> int:
+    """CLI driver: each raw telemetry file is one minibatch — decode,
+    update the model, score, append alerts to a per-day streaming CSV.
+
+    `epochs > 1` replays the file list (useful to burn in a model before
+    leaving it running on live data)."""
+    from onix.ingest.run import decode
+    from onix.store import results_path
+
+    scorer = StreamingScorer(cfg, datatype, n_buckets=n_buckets)
+    total_events = 0
+    total_alerts = 0
+    for epoch in range(epochs):
+        for p in paths:
+            table = decode(datatype, p)
+            res = scorer.process(table)
+            total_events += res.n_events
+            if epoch == epochs - 1 and len(res.alerts):
+                # Alerts land in per-day files keyed like batch results.
+                from onix.ingest.run import _day_of
+                for date, rows in res.alerts.groupby(
+                        _day_of(datatype, res.alerts)):
+                    out = results_path(cfg.store.results_dir, datatype,
+                                       str(date))
+                    out = out.with_name(f"{datatype}_streaming.csv")
+                    out.parent.mkdir(parents=True, exist_ok=True)
+                    rows.to_csv(out, mode="a", index=False,
+                                header=not out.exists())
+                    total_alerts += len(rows)
+            print(f"[epoch {epoch}] {p}: {res.n_events} events, "
+                  f"{len(res.alerts)} alerts, {res.n_new_docs} new docs, "
+                  f"svi step {res.step}")
+    print(f"stream done: {total_events} events, {total_alerts} alerts, "
+          f"{len(scorer.pad_shapes)} compiled shapes")
+    return 0

@@ -150,6 +150,9 @@ def test_watcher_ingests_and_dedupes(tmp_path):
 
     (landing / "a.nf5").write_bytes(nfd.write_v5(_synth_flow_arrays(30, seed=1)))
     (landing / "b.nf5").write_bytes(nfd.write_v5(_synth_flow_arrays(40, seed=2)))
+    # First poll only observes (quiescence check: a file must hold the
+    # same size+mtime across two polls before it is claimed).
+    assert w.poll_once() == 0
     assert w.poll_once() == 2
     assert w.stats == {"files": 2, "rows": 70, "errors": 0}
     # Unchanged files are not re-ingested.
@@ -167,16 +170,62 @@ def test_watcher_ingests_and_dedupes(tmp_path):
     assert w.stats["files"] == 3 and w.stats["rows"] == 80
     # Ledger survives restart: a fresh watcher re-ingests nothing.
     w2 = IngestWatcher(cfg, "flow", landing)
-    assert w2.poll_once() == 0
+    assert w2.poll_once() == 0 and w2.poll_once() == 0
     w2._pool.shutdown()
 
     # Bad file: error counted, claim released for retry.
     (landing / "bad.nf5").write_bytes(b"garbage bytes here")
     w3 = IngestWatcher(cfg, "flow", landing)
+    assert w3.poll_once() == 0    # observing poll
     assert w3.poll_once() == 1
     assert w3.stats["errors"] == 1
     assert w3.poll_once() == 1    # retried (still failing)
     w3._pool.shutdown()
+
+
+@needs_decoder
+def test_watcher_waits_for_growing_files(tmp_path):
+    """A capture still being appended to must not be ingested until the
+    producer stops writing — otherwise its head rows land twice."""
+    landing = tmp_path / "landing"
+    landing.mkdir()
+    cfg = OnixConfig()
+    cfg.store.root = str(tmp_path / "store")
+    w = IngestWatcher(cfg, "flow", landing)
+
+    part1 = nfd.write_v5(_synth_flow_arrays(20, seed=1))
+    (landing / "grow.nf5").write_bytes(part1)
+    assert w.poll_once() == 0                   # first sighting
+    # File grows between polls: quiescence clock resets.
+    (landing / "grow.nf5").write_bytes(
+        part1 + nfd.write_v5(_synth_flow_arrays(10, seed=2)))
+    assert w.poll_once() == 0
+    assert w.poll_once() == 1                   # stable now -> ingested once
+    assert w.stats["rows"] == 30
+    store = Store(cfg.store.root)
+    assert sum(len(store.read("flow", d)) for d in store.dates("flow")) == 30
+    w._pool.shutdown()
+
+
+@needs_decoder
+def test_ledger_commits_only_after_success(tmp_path):
+    """Crash-durability contract: the on-disk ledger must not record a
+    file until its rows are in the store (at-least-once, never loss)."""
+    from onix.ingest.watcher import Ledger
+    landing = tmp_path / "landing"
+    landing.mkdir()
+    f = landing / "a.nf5"
+    f.write_bytes(nfd.write_v5(_synth_flow_arrays(5)))
+    lpath = landing / "ledger.json"
+    led = Ledger(lpath)
+    assert led.claim(f)
+    assert not led.claim(f)         # in-flight: no double claim
+    # Simulated crash before commit: a fresh ledger re-offers the file.
+    led2 = Ledger(lpath)
+    assert led2.claim(f)
+    led2.commit(f)
+    led3 = Ledger(lpath)
+    assert not led3.claim(f)        # durably done
 
 
 @needs_decoder

@@ -1,0 +1,116 @@
+"""Streaming online-VB path tests (SURVEY.md §4.5: "feed the same day as
+one batch vs minibatches, assert bounded score divergence") — judged
+config 4, BASELINE.json "streaming online-VB LDA over oni-ingest
+minibatches (incremental scoring)"."""
+
+import numpy as np
+import pandas as pd
+
+from onix.config import OnixConfig
+from onix.ingest.parsers import format_bluecoat
+from onix.pipelines.streaming import (DocTable, HashedVocabulary,
+                                      StreamingScorer, run_stream)
+from onix.pipelines.synth import synth_flow_day, synth_proxy_day
+
+
+def _cfg(**lda_overrides) -> OnixConfig:
+    cfg = OnixConfig()
+    cfg.lda.n_topics = 8
+    cfg.lda.svi_tau0 = 1.0      # stream-reactive schedule for short tests
+    for k, v in lda_overrides.items():
+        setattr(cfg.lda, k, v)
+    return cfg.validate()
+
+
+def test_hashed_vocab_stable_across_instances():
+    words = np.array([f"w{i}_{i % 7}" for i in range(500)], dtype=object)
+    a = HashedVocabulary(1 << 13).ids(words)
+    b = HashedVocabulary(1 << 13).ids(words)
+    np.testing.assert_array_equal(a, b)          # process-stable hashing
+    assert a.min() >= 0 and a.max() < (1 << 13)
+    # Distinct words should rarely collide at this fill factor.
+    assert len(np.unique(a)) >= 480
+
+
+def test_doc_table_first_seen_order():
+    t = DocTable()
+    ids1 = t.ids(np.array(["b", "a", "b"], dtype=object))
+    assert t.n_docs == 2
+    ids2 = t.ids(np.array(["c", "a"], dtype=object))
+    assert t.n_docs == 3
+    # Ids are stable: "a"/"b" keep their first-seen ids.
+    assert ids1.tolist() == [ids1[0], ids1[1], ids1[0]]
+    assert ids2[1] == ids1[1]
+    assert t.keys[ids2[0]] == "c"
+
+
+def test_streaming_matches_batch_and_surfaces_anomalies():
+    """One day fed as 8 minibatches vs as a single batch: both must
+    surface the planted anomalies, with bounded rank divergence."""
+    table, anomalies = synth_flow_day(n_events=4000, n_hosts=80,
+                                      n_anomalies=15, seed=11)
+    chunks = [table.iloc[i:i + 500].reset_index(drop=True)
+              for i in range(0, 4000, 500)]
+
+    stream = StreamingScorer(_cfg(), "flow", n_buckets=1 << 13)
+    for epoch in range(2):
+        scores = np.full(4000, np.inf)
+        for ci, ch in enumerate(chunks):
+            res = stream.process(ch)
+            assert res.n_events == 500
+            scores[ci * 500:(ci + 1) * 500] = res.scores
+    # Equal-size minibatches must reuse one compiled shape (static-shape
+    # padding contract — a retrace per batch would be a TPU-side bug).
+    assert len(stream.pad_shapes) == 1
+
+    batch = StreamingScorer(_cfg(), "flow", n_buckets=1 << 13)
+    for epoch in range(2):
+        bres = batch.process(table)
+
+    s_rank = np.argsort(np.argsort(scores))
+    b_rank = np.argsort(np.argsort(bres.scores))
+
+    s_recall = np.isin(np.argsort(scores)[:300], anomalies).sum() / 15
+    b_recall = np.isin(np.argsort(bres.scores)[:300], anomalies).sum() / 15
+    assert s_recall >= 0.6, f"streaming surfaced only {s_recall:.0%}"
+    assert b_recall >= 0.8, f"batch surfaced only {b_recall:.0%}"
+    # Bounded divergence between the two feeding regimes (§4.5).
+    rho = np.corrcoef(s_rank, b_rank)[0, 1]
+    assert rho >= 0.55, f"rank correlation {rho:.2f} too low"
+
+
+def test_streaming_alerts_respect_tol_and_order():
+    table, _ = synth_flow_day(n_events=2000, n_anomalies=10, seed=5)
+    cfg = _cfg()
+    cfg.pipeline.tol = 0.05
+    sc = StreamingScorer(cfg, "flow", n_buckets=1 << 12)
+    res = sc.process(table)
+    if len(res.alerts):
+        a = res.alerts["score"].to_numpy()
+        assert (a < 0.05).all()
+        assert (np.diff(a) >= 0).all()
+    assert len(res.alerts) <= cfg.pipeline.max_results
+
+
+def test_run_stream_cli_writes_alert_files(tmp_path):
+    """File-per-minibatch driver: proxy logs in, streaming alert CSV out."""
+    table, _ = synth_proxy_day(n_events=1200, n_anomalies=12, seed=7)
+    paths = []
+    for i in range(3):
+        p = tmp_path / f"proxy_{i}.log"
+        p.write_text(format_bluecoat(
+            table.iloc[i * 400:(i + 1) * 400].reset_index(drop=True)))
+        paths.append(str(p))
+
+    cfg = OnixConfig()
+    cfg.store.root = str(tmp_path / "store")
+    cfg.store.results_dir = str(tmp_path / "results")
+    cfg.lda.n_topics = 6
+    cfg.lda.svi_tau0 = 1.0
+    cfg.pipeline.tol = 0.5
+
+    assert run_stream(cfg, "proxy", paths, n_buckets=1 << 12, epochs=2) == 0
+    out = list((tmp_path / "results").glob("*/proxy_streaming.csv"))
+    assert out, "no streaming alerts written"
+    alerts = pd.concat([pd.read_csv(p) for p in out])
+    assert "score" in alerts.columns and len(alerts) > 0
