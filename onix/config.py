@@ -44,6 +44,7 @@ class LDAConfig:
     svi_kappa: float = 0.7
     svi_batch_size: int = 4096  # documents per SVI minibatch
     svi_local_iters: int = 30   # local E-step fixed-point iterations
+    checkpoint_every: int = 0   # sweeps between sampler checkpoints (0=off)
 
     def validate(self) -> None:
         if self.n_topics < 2:
@@ -54,6 +55,8 @@ class LDAConfig:
             raise ValueError("block_size must be >=1")
         if not (0.5 < self.svi_kappa <= 1.0):
             raise ValueError("svi_kappa must be in (0.5, 1] for convergence")
+        if self.checkpoint_every < 0:
+            raise ValueError("checkpoint_every must be >= 0")
 
 
 @dataclass

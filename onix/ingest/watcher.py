@@ -45,7 +45,7 @@ class Ledger:
         self.path = pathlib.Path(path)
         self._lock = threading.Lock()
         self._done: dict[str, list] = {}
-        self._inflight: set[str] = set()
+        self._inflight: dict[str, list] = {}    # key -> sig AT CLAIM TIME
         if self.path.exists():
             self._done = json.loads(self.path.read_text())
 
@@ -60,22 +60,26 @@ class Ledger:
         with self._lock:
             if self._done.get(key) == sig or key in self._inflight:
                 return False
-            self._inflight.add(key)
+            self._inflight[key] = sig
             return True
 
     def commit(self, p: pathlib.Path) -> None:
-        """Durably record a successfully ingested file."""
-        key, sig = self._key(p)
+        """Durably record a successfully ingested file — under the
+        signature captured at claim time, NOT the file's current one:
+        rows appended while ingest was reading must leave the file
+        looking changed, so the next poll re-offers it."""
+        key = str(p.resolve())
         with self._lock:
-            self._inflight.discard(key)
-            self._done[key] = sig
-            self._flush()
+            sig = self._inflight.pop(key, None)
+            if sig is not None:
+                self._done[key] = sig
+                self._flush()
 
     def release(self, p: pathlib.Path) -> None:
         """Un-claim after a failed ingest so the next poll retries it."""
         key = str(p.resolve())
         with self._lock:
-            self._inflight.discard(key)
+            self._inflight.pop(key, None)
             self._done.pop(key, None)
             self._flush()
 

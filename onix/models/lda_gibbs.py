@@ -207,17 +207,47 @@ class GibbsLDA:
         )
 
     def fit(self, corpus: Corpus, n_sweeps: int | None = None,
-            callback=None) -> dict:
+            callback=None, checkpoint_dir=None, resume: bool = True) -> dict:
+        """Run the sweep loop; optionally checkpoint every
+        `config.checkpoint_every` sweeps into `checkpoint_dir` and resume
+        from the newest matching checkpoint there (SURVEY.md §5.3-5.4:
+        resume-on-preemption). Resumed runs are bit-identical to
+        uninterrupted ones — the sweep is a pure function of the state."""
+        from onix import checkpoint as ckpt
+
         cfg = self.config
         n_sweeps = cfg.n_sweeps if n_sweeps is None else n_sweeps
         docs, words, mask = self.prepare(corpus)
-        state = init_state(docs, words, mask, self.n_docs, self.n_vocab,
-                           cfg.n_topics, cfg.seed)
+        fp = ckpt.fingerprint(cfg, self.n_docs, self.n_vocab,
+                              corpus.n_tokens)
+        # Per-fingerprint subdir: checkpoints of runs with a different
+        # identity can neither be adopted nor pruned by this run.
+        if checkpoint_dir is not None:
+            import pathlib
+            checkpoint_dir = pathlib.Path(checkpoint_dir) / fp
+        start = 0
+        state = None
+        if checkpoint_dir is not None and resume:
+            saved = ckpt.load_latest(checkpoint_dir)
+            if saved is not None and saved.meta.get("fingerprint") == fp:
+                state = GibbsState(**{k: jnp.asarray(v)
+                                      for k, v in saved.arrays.items()})
+                start = saved.sweep + 1
+        if state is None:
+            state = init_state(docs, words, mask, self.n_docs, self.n_vocab,
+                               cfg.n_topics, cfg.seed)
         theta0, phi0 = self._estimates(state)
-        ll_history = [(-1, float(self._ll(theta0, phi0, docs, words, mask)))]
-        for s in range(n_sweeps):
+        ll_history = [(start - 1,
+                       float(self._ll(theta0, phi0, docs, words, mask)))]
+        for s in range(start, n_sweeps):
             state = self._sweep(state, docs, words, mask,
                                 accumulate=s >= cfg.burn_in)
+            if (checkpoint_dir is not None and cfg.checkpoint_every > 0
+                    and (s + 1) % cfg.checkpoint_every == 0):
+                ckpt.save(checkpoint_dir, s,
+                          {k: np.asarray(v)
+                           for k, v in state._asdict().items()},
+                          {"fingerprint": fp, "engine": "gibbs"})
             if callback is not None or s == n_sweeps - 1 or s % 10 == 9:
                 theta, phi_wk = self._estimates(state)
                 ll = float(self._ll(theta, phi_wk, docs, words, mask))

@@ -52,10 +52,16 @@ def load_feedback(cfg: OnixConfig, datatype: str, date: str) -> pd.DataFrame | N
 def fit_engine(cfg: OnixConfig, bundle: CorpusBundle, engine: str) -> dict:
     """Fit theta/phi_wk with the requested engine on the bundle's corpus."""
     corpus = bundle.corpus
+    # Resume-on-preemption (SURVEY.md §5.3-5.4): per-(datatype, date)
+    # checkpoint dir, active when the config asks for it.
+    ck_dir = None
+    if cfg.lda.checkpoint_every > 0:
+        ck_dir = (pathlib.Path(cfg.store.checkpoint_dir)
+                  / cfg.pipeline.datatype / cfg.pipeline.date.replace("-", ""))
     if engine == "gibbs":
         from onix.models.lda_gibbs import GibbsLDA
         model = GibbsLDA(cfg.lda, corpus.n_docs, corpus.n_vocab)
-        fit = model.fit(corpus)
+        fit = model.fit(corpus, checkpoint_dir=ck_dir)
         return {"theta": fit["theta"], "phi_wk": fit["phi_wk"],
                 "ll_history": fit["ll_history"]}
     if engine == "sharded":
@@ -63,7 +69,7 @@ def fit_engine(cfg: OnixConfig, bundle: CorpusBundle, engine: str) -> dict:
         from onix.parallel.sharded_gibbs import ShardedGibbsLDA
         mesh = make_mesh(dp=cfg.mesh.dp, mp=1)
         model = ShardedGibbsLDA(cfg.lda, corpus.n_vocab, mesh=mesh)
-        fit = model.fit(corpus)
+        fit = model.fit(corpus, checkpoint_dir=ck_dir)
         return {"theta": np.asarray(fit["theta"]),
                 "phi_wk": np.asarray(fit["phi_wk"]),
                 "ll_history": fit.get("ll_history", [])}

@@ -56,6 +56,9 @@ class HashedVocabulary:
     a rarity detector is conservative (a colliding rare word can only
     look MORE common, never less)."""
 
+    _CACHE_LIMIT = 1 << 18    # bound: a stream sees unbounded distinct
+    #                           strings; the cache must not grow with it
+
     def __init__(self, n_buckets: int = 1 << 15):
         if n_buckets < 2:
             raise ValueError("n_buckets must be >= 2")
@@ -67,7 +70,8 @@ class HashedVocabulary:
         if h is None:
             digest = hashlib.blake2b(word.encode(), digest_size=8).digest()
             h = int.from_bytes(digest, "little") % self.n_buckets
-            self._cache[word] = h
+            if len(self._cache) < self._CACHE_LIMIT:
+                self._cache[word] = h
         return h
 
     def ids(self, words: np.ndarray) -> np.ndarray:
