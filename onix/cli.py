@@ -68,6 +68,41 @@ def build_parser() -> argparse.ArgumentParser:
     p_oa.add_argument("date", help="day to process, YYYY-MM-DD")
     p_oa.add_argument("datatype", choices=("flow", "dns", "proxy"))
 
+    p_serve = sub.add_parser(
+        "serve", help="serve the analyst dashboards + feedback endpoint "
+                      "(the reference's notebook file server on :8889)")
+    _add_common(p_serve)
+    p_serve.add_argument("--port", type=int, default=8889)
+    p_serve.add_argument("--host", default="127.0.0.1")
+
+    p_label = sub.add_parser(
+        "label", help="label OA results by rank (headless analyst feedback; "
+                      "the dashboard Save button does the same via POST)")
+    _add_common(p_label)
+    p_label.add_argument("date", help="day, YYYY-MM-DD")
+    p_label.add_argument("datatype", choices=("flow", "dns", "proxy"))
+    p_label.add_argument("ranks", type=int, nargs="+",
+                         help="dashboard rank numbers to label")
+    p_label.add_argument("--label", type=int, required=True,
+                         choices=(1, 2, 3),
+                         help="1 high threat, 2 medium, 3 benign (only "
+                              "benign rows bias the next model run)")
+
+    p_setup = sub.add_parser(
+        "setup", help="create the store layout and archive the config "
+                      "(the oni-setup equivalent; idempotent)")
+    _add_common(p_setup)
+
+    p_demo = sub.add_parser(
+        "demo", help="one-command end-to-end demo: synthesize the "
+                     "2016-07-08 day, ingest, score, enrich, serve")
+    _add_common(p_demo)
+    p_demo.add_argument("--events", type=int, default=20000,
+                        help="synthetic events per datatype")
+    p_demo.add_argument("--serve", action="store_true",
+                        help="serve the dashboards when done")
+    p_demo.add_argument("--port", type=int, default=8889)
+
     return parser
 
 
@@ -102,6 +137,26 @@ def main(argv: list[str] | None = None) -> int:
     if args.command == "oa":
         from onix.oa.engine import run_oa
         return run_oa(cfg, args.date, args.datatype)
+
+    if args.command == "serve":
+        from onix.oa.serve import run_serve
+        return run_serve(cfg, port=args.port, host=args.host)
+
+    if args.command == "setup":
+        from onix.setup_cmd import run_setup
+        return run_setup(cfg)
+
+    if args.command == "demo":
+        from onix.setup_cmd import run_demo
+        return run_demo(cfg, n_events=args.events, serve=args.serve,
+                        port=args.port)
+
+    if args.command == "label":
+        from onix.oa.feedback import label_by_rank
+        path = label_by_rank(cfg, args.datatype, args.date, args.ranks,
+                             args.label)
+        print(f"onix label: {len(args.ranks)} rows -> {path}")
+        return 0
 
     return 2
 

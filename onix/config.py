@@ -45,6 +45,11 @@ class LDAConfig:
     svi_batch_size: int = 4096  # documents per SVI minibatch
     svi_local_iters: int = 30   # local E-step fixed-point iterations
     checkpoint_every: int = 0   # sweeps between sampler checkpoints (0=off)
+    # Independent Gibbs chains, batched on device via vmap; event scores
+    # average over chains. Single chains are rank-unstable (recall on the
+    # same data swings with the model seed — SURVEY.md §7.3.2's
+    # "rank-stability tricks"); ≥4 chains stabilize the judged top-k.
+    n_chains: int = 1
 
     def validate(self) -> None:
         if self.n_topics < 2:
@@ -57,6 +62,8 @@ class LDAConfig:
             raise ValueError("svi_kappa must be in (0.5, 1] for convergence")
         if self.checkpoint_every < 0:
             raise ValueError("checkpoint_every must be >= 0")
+        if self.n_chains < 1:
+            raise ValueError("n_chains must be >= 1")
 
 
 @dataclass
@@ -121,11 +128,23 @@ class StoreConfig:
 
 
 @dataclass
+class OAConfig:
+    """Operational Analytics (SURVEY.md §2.1 #12-#13): enrichment inputs
+    and the per-date UI data directory the dashboards read."""
+
+    data_dir: str = "data/onix/oa"
+    geoip_db: str = ""          # CSV: network,country,city,latitude,longitude,isp
+    reputation: str = ""        # plugin specs, comma-separated: local:<path>|noop
+    top_domains: str = ""       # popular-domains list file (rank order)
+
+
+@dataclass
 class OnixConfig:
     lda: LDAConfig = field(default_factory=LDAConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)
     pipeline: PipelineConfig = field(default_factory=PipelineConfig)
     store: StoreConfig = field(default_factory=StoreConfig)
+    oa: OAConfig = field(default_factory=OAConfig)
 
     def validate(self) -> "OnixConfig":
         self.lda.validate()
@@ -195,6 +214,7 @@ _NESTED = {
     (OnixConfig, "mesh"): MeshConfig,
     (OnixConfig, "pipeline"): PipelineConfig,
     (OnixConfig, "store"): StoreConfig,
+    (OnixConfig, "oa"): OAConfig,
 }
 
 

@@ -55,17 +55,16 @@ def _one_hot(z: jax.Array, k: int) -> jax.Array:
     return jax.nn.one_hot(z, k, dtype=jnp.int32)
 
 
-def init_state(
+def init_state_keyed(
+    key: jax.Array,
     doc_blocks: jax.Array,
     word_blocks: jax.Array,
     mask_blocks: jax.Array,
     n_docs: int,
     n_vocab: int,
     n_topics: int,
-    seed: int,
 ) -> GibbsState:
     """Random topic init + exact count build via one scatter pass."""
-    key = jax.random.PRNGKey(seed)
     key, zkey = jax.random.split(key)
     shape = doc_blocks.shape
     z = jax.random.randint(zkey, shape, 0, n_topics, dtype=jnp.int32)
@@ -82,6 +81,41 @@ def init_state(
         acc_nwk=jnp.zeros((n_vocab, n_topics), jnp.float32),
         n_acc=jnp.zeros((), jnp.int32),
     )
+
+
+def init_state(
+    doc_blocks: jax.Array,
+    word_blocks: jax.Array,
+    mask_blocks: jax.Array,
+    n_docs: int,
+    n_vocab: int,
+    n_topics: int,
+    seed: int,
+) -> GibbsState:
+    return init_state_keyed(jax.random.PRNGKey(seed), doc_blocks,
+                            word_blocks, mask_blocks, n_docs, n_vocab,
+                            n_topics)
+
+
+def init_chains(
+    doc_blocks: jax.Array,
+    word_blocks: jax.Array,
+    mask_blocks: jax.Array,
+    n_docs: int,
+    n_vocab: int,
+    n_topics: int,
+    seed: int,
+    n_chains: int,
+) -> GibbsState:
+    """Stacked state for `n_chains` independent chains (leading chain
+    axis on every array). Chains differ only in their PRNG streams; on
+    TPU vmap turns the per-chain gathers/scatters into one batched
+    program, so C chains cost ~one sweep of C× the tokens."""
+    keys = jax.vmap(jax.random.fold_in, in_axes=(None, 0))(
+        jax.random.PRNGKey(seed), jnp.arange(n_chains, dtype=jnp.uint32))
+    return jax.vmap(
+        lambda k: init_state_keyed(k, doc_blocks, word_blocks, mask_blocks,
+                                   n_docs, n_vocab, n_topics))(keys)
 
 
 def make_block_step(*, alpha: float, eta: float, n_vocab: int,
@@ -187,12 +221,32 @@ class GibbsLDA:
         self.config = config
         self.n_docs = n_docs
         self.n_vocab = n_vocab
-        self._sweep = jax.jit(functools.partial(
-            sweep, alpha=config.alpha, eta=config.eta, n_vocab=n_vocab,
-        ), static_argnames=("accumulate",))
-        self._estimates = jax.jit(functools.partial(
-            posterior_estimates, alpha=config.alpha, eta=config.eta))
-        self._ll = jax.jit(log_likelihood)
+        chains = config.n_chains
+        base_sweep = functools.partial(
+            sweep, alpha=config.alpha, eta=config.eta, n_vocab=n_vocab)
+        base_est = functools.partial(
+            posterior_estimates, alpha=config.alpha, eta=config.eta)
+        if chains == 1:
+            self._sweep = jax.jit(base_sweep,
+                                  static_argnames=("accumulate",))
+            self._estimates = jax.jit(base_est)
+            self._ll = jax.jit(log_likelihood)
+        else:
+            # vmap over the chain axis of the state; token blocks are
+            # shared (broadcast). theta/phi keep a leading chain axis —
+            # scoring averages probabilities over it.
+            def sweep_chains(state, d, w, m, accumulate):
+                return jax.vmap(lambda s: base_sweep(
+                    s, d, w, m, accumulate=accumulate))(state)
+
+            def ll_chains(theta, phi_wk, d, w, m):
+                return jax.vmap(lambda t, p: log_likelihood(
+                    t, p, d, w, m))(theta, phi_wk).mean()
+
+            self._sweep = jax.jit(sweep_chains,
+                                  static_argnames=("accumulate",))
+            self._estimates = jax.jit(jax.vmap(base_est))
+            self._ll = jax.jit(ll_chains)
 
     def prepare(self, corpus: Corpus, shuffle: bool = True):
         if shuffle:
@@ -234,8 +288,13 @@ class GibbsLDA:
                                       for k, v in saved.arrays.items()})
                 start = saved.sweep + 1
         if state is None:
-            state = init_state(docs, words, mask, self.n_docs, self.n_vocab,
-                               cfg.n_topics, cfg.seed)
+            if cfg.n_chains == 1:
+                state = init_state(docs, words, mask, self.n_docs,
+                                   self.n_vocab, cfg.n_topics, cfg.seed)
+            else:
+                state = init_chains(docs, words, mask, self.n_docs,
+                                    self.n_vocab, cfg.n_topics, cfg.seed,
+                                    cfg.n_chains)
         theta0, phi0 = self._estimates(state)
         ll_history = [(start - 1,
                        float(self._ll(theta0, phi0, docs, words, mask)))]
@@ -257,6 +316,8 @@ class GibbsLDA:
         theta, phi_wk = self._estimates(state)
         return {
             "state": state,
+            # n_chains>1 stacks a leading chain axis: theta [C,D,K],
+            # phi_wk [C,V,K]; scoring.score_events averages over it.
             "theta": np.asarray(theta),
             "phi_wk": np.asarray(phi_wk),   # [V,K]; phi[k,v] = phi_wk[v,k]
             "ll_history": ll_history,

@@ -26,8 +26,17 @@ import numpy as np
 def score_events(theta: jax.Array, phi_wk: jax.Array,
                  doc_ids: jax.Array, word_ids: jax.Array) -> jax.Array:
     """p(word | doc) = sum_k theta[d,k] * phi_wk[w,k] — one gather-dot per
-    event; K rides the VPU lanes."""
-    return jnp.sum(theta[doc_ids] * phi_wk[word_ids], axis=-1)
+    event; K rides the VPU lanes.
+
+    Multi-chain estimates (theta [C,D,K], phi_wk [C,V,K] from
+    `LDAConfig.n_chains > 1`) average the probability over chains —
+    score-averaging, not matrix-averaging, so topic label switching
+    between chains cannot corrupt the estimate.
+    """
+    if theta.ndim == 2:
+        return jnp.sum(theta[doc_ids] * phi_wk[word_ids], axis=-1)
+    p = jnp.sum(theta[:, doc_ids] * phi_wk[:, word_ids], axis=-1)
+    return p.mean(axis=0)
 
 
 class TopK(NamedTuple):

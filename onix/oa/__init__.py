@@ -1,0 +1,7 @@
+"""Operational Analytics — the oni-oa batch engine equivalent.
+
+The reference's L5 (SURVEY.md §2.1 #12): per day/type, pull the ML
+results CSV, enrich (GeoIP, domain/ISP mapping, reputation plugins),
+and emit the per-date JSON/CSV files the analyst UI reads
+(reference README.md:45-48; `.gitmodules:10-12`).
+"""

@@ -1,0 +1,195 @@
+"""The OA batch engine: results CSV -> enriched per-date UI data files.
+
+Equivalent of the reference's `start_oa.py --date <d> --type <t>`
+(SURVEY.md §3.3): fetch the day's scored results, run the enrichment
+loop (GeoIP, domain context, reputation plugins — all offline-capable,
+see components.py), and emit the per-date JSON/CSV files the dashboards
+read, keyed by date exactly like the reference UI's `#date=` routing
+(reference README.md:55-56).
+
+Output layout under `cfg.oa.data_dir`:
+
+    <datatype>/<YYYYMMDD>/suspicious.csv    enriched analyst table
+    <datatype>/<YYYYMMDD>/suspicious.json   same rows for the UI fetch
+    <datatype>/<YYYYMMDD>/summary.json      stats/histogram/timeline
+    <datatype>/<YYYYMMDD>/graph.json        network graph nodes+links
+    <datatype>/dates.json                   date index for the picker
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+
+import numpy as np
+import pandas as pd
+
+from onix.config import OnixConfig
+from onix.oa.components import (GeoIPDB, build_reputation, domain_context,
+                                reputation_column)
+from onix.store import parse_date, results_path
+
+
+def oa_dir(cfg: OnixConfig, datatype: str, date: str) -> pathlib.Path:
+    y, mo, d = parse_date(date)
+    return pathlib.Path(cfg.oa.data_dir) / datatype / f"{y}{mo}{d}"
+
+
+def _load_geoip(cfg: OnixConfig) -> GeoIPDB:
+    if cfg.oa.geoip_db:
+        return GeoIPDB.load(cfg.oa.geoip_db)
+    return GeoIPDB.builtin()
+
+
+def _load_top_domains(cfg: OnixConfig) -> list[str]:
+    """Popularity list, normalized to the SLD keys domain_context ranks
+    by — accepts Alexa/Umbrella-style `google.com` lines, optional
+    `rank,domain` CSV prefixes, or bare SLDs; first occurrence wins."""
+    if not cfg.oa.top_domains:
+        return []
+    from onix.utils.features import subdomain_split
+    out: list[str] = []
+    seen = set()
+    for line in pathlib.Path(cfg.oa.top_domains).read_text().splitlines():
+        line = line.strip().lower()
+        if not line or line.startswith("#"):
+            continue
+        name = line.rsplit(",", 1)[-1] if "," in line else line
+        _, sld, _, _ = subdomain_split(name)
+        if sld and sld not in seen:
+            seen.add(sld)
+            out.append(sld)
+    return out
+
+
+def _hours(df: pd.DataFrame, datatype: str) -> np.ndarray:
+    """Hour-of-day per row, from the datatype's timestamp column."""
+    if datatype == "flow":
+        ts = pd.to_datetime(df["treceived"], format="mixed")
+    elif datatype == "dns":
+        ts = pd.to_datetime(df["frame_time"], format="mixed")
+    else:
+        ts = pd.to_datetime(df["p_time"], format="mixed")
+    return ts.dt.hour.to_numpy(np.int32)
+
+
+def enrich(df: pd.DataFrame, datatype: str, geo: GeoIPDB,
+           rep_clients, top_domains: list[str]) -> pd.DataFrame:
+    """Attach enrichment columns; df is the raw results CSV frame."""
+    out = df.copy()
+    if datatype == "flow":
+        for col, prefix in (("sip", "src"), ("dip", "dst")):
+            g = geo.lookup(out[col].astype(str))
+            g.columns = [c.replace("geo_", f"{prefix}_geo_") for c in g.columns]
+            out = pd.concat([out, g], axis=1)
+        out["src_rep"] = reputation_column(rep_clients, out["sip"])
+        out["dst_rep"] = reputation_column(rep_clients, out["dip"])
+    elif datatype == "dns":
+        g = geo.lookup(out["ip_dst"].astype(str))
+        out = pd.concat([out, g], axis=1)
+        dc = domain_context(out["dns_qry_name"].astype(str), top_domains)
+        out = pd.concat([out, dc], axis=1)
+        out["rep"] = reputation_column(rep_clients, out["dns_qry_name"])
+    else:   # proxy
+        g = geo.lookup(out["clientip"].astype(str))
+        out = pd.concat([out, g], axis=1)
+        dc = domain_context(out["host"].astype(str), top_domains)
+        out = pd.concat([out, dc], axis=1)
+        out["rep"] = reputation_column(rep_clients, out["host"])
+    return out
+
+
+def _graph(df: pd.DataFrame, datatype: str) -> dict:
+    """Nodes + weighted links for the network/chord view."""
+    if datatype == "flow":
+        src, dst = df["sip"].astype(str), df["dip"].astype(str)
+    elif datatype == "dns":
+        src, dst = df["ip_dst"].astype(str), df["domain"].astype(str)
+    else:
+        src, dst = df["clientip"].astype(str), df["host"].astype(str)
+    pairs = pd.DataFrame({"src": src, "dst": dst, "score": df["score"]})
+    links = (pairs.groupby(["src", "dst"], sort=False)
+             .agg(weight=("score", "size"), min_score=("score", "min"))
+             .reset_index())
+    nodes = sorted(set(links["src"]) | set(links["dst"]))
+    return {
+        "nodes": [{"id": n} for n in nodes],
+        "links": [{"source": r.src, "target": r.dst,
+                   "weight": int(r.weight),
+                   "min_score": float(r.min_score)}
+                  for r in links.itertuples()],
+    }
+
+
+def _summary(df: pd.DataFrame, datatype: str, date: str,
+             manifest: dict | None) -> dict:
+    scores = df["score"].to_numpy(np.float64)
+    hist_counts, hist_edges = np.histogram(
+        scores, bins=20) if len(scores) else (np.zeros(20, int),
+                                              np.linspace(0, 1, 21))
+    hours = _hours(df, datatype) if len(df) else np.zeros(0, np.int32)
+    timeline = np.bincount(hours, minlength=24)[:24]
+    doc_col = df["ip"].astype(str) if "ip" in df else pd.Series([], dtype=str)
+    top_docs = doc_col.value_counts().head(10)
+    out = {
+        "datatype": datatype,
+        "date": date,
+        "n_results": int(len(df)),
+        "score_min": float(scores.min()) if len(scores) else None,
+        "score_max": float(scores.max()) if len(scores) else None,
+        "histogram": {"counts": hist_counts.tolist(),
+                      "edges": np.round(hist_edges, 6).tolist()},
+        "timeline_hourly": timeline.tolist(),
+        "top_documents": [{"ip": k, "count": int(v)}
+                          for k, v in top_docs.items()],
+    }
+    if manifest:
+        out["run"] = {k: manifest.get(k) for k in
+                      ("n_events", "n_docs", "n_vocab", "n_tokens",
+                       "engine", "config_hash", "seed", "wall_seconds")}
+    return out
+
+
+def _update_dates_index(base: pathlib.Path, date: str) -> None:
+    y, mo, d = parse_date(date)
+    idx_path = base / "dates.json"
+    dates = set()
+    if idx_path.exists():
+        dates = set(json.loads(idx_path.read_text()))
+    dates.add(f"{y}-{mo}-{d}")
+    idx_path.write_text(json.dumps(sorted(dates)))
+
+
+def run_oa(cfg: OnixConfig, date: str, datatype: str) -> int:
+    res_csv = results_path(cfg.store.results_dir, datatype, date)
+    if not res_csv.exists():
+        print(f"onix oa: no results at {res_csv} — run `onix score` first")
+        return 1
+    df = pd.read_csv(res_csv)
+    manifest = None
+    man_path = res_csv.with_suffix(".manifest.json")
+    if man_path.exists():
+        manifest = json.loads(man_path.read_text())
+
+    geo = _load_geoip(cfg)
+    rep_clients = build_reputation(cfg.oa.reputation)
+    top_domains = _load_top_domains(cfg)
+
+    enriched = enrich(df, datatype, geo, rep_clients, top_domains)
+    # Analyst columns: rank (1-based ascending by score — results CSV is
+    # already score-ascending) and sev (0 = unlabeled; the scoring
+    # notebook/label CLI writes 1/2 threat, 3 benign).
+    enriched.insert(0, "rank", np.arange(1, len(enriched) + 1))
+    enriched["sev"] = 0
+
+    out = oa_dir(cfg, datatype, date)
+    out.mkdir(parents=True, exist_ok=True)
+    enriched.to_csv(out / "suspicious.csv", index=False)
+    (out / "suspicious.json").write_text(
+        enriched.to_json(orient="records"))
+    (out / "summary.json").write_text(
+        json.dumps(_summary(enriched, datatype, date, manifest), indent=2))
+    (out / "graph.json").write_text(json.dumps(_graph(enriched, datatype)))
+    _update_dates_index(out.parent, date)
+    print(f"onix oa: {len(enriched)} results -> {out}")
+    return 0

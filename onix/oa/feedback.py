@@ -1,0 +1,91 @@
+"""Analyst feedback capture — the noise-filter loop's write side.
+
+The reference closes its human-in-the-loop cycle through in-dashboard
+IPython scoring notebooks that write a feedback CSV the next ML run
+consumes ×DUPFACTOR (SURVEY.md §2.1 #14, §3.3; reference README.md:48).
+onix captures the same labels through the dashboard's label controls
+(POSTed via `onix serve`) or the `onix label` CLI, and writes the CSV
+`pipelines/run.load_feedback` reads: columns (ip, word, label) with the
+reference severity scale 1/2 = threat, 3 = benign.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import fcntl
+import pathlib
+
+import pandas as pd
+
+from onix.config import OnixConfig
+from onix.store import feedback_path
+
+FEEDBACK_COLUMNS = ["ip", "word", "label", "rank", "score"]
+VALID_LABELS = (1, 2, 3)        # 1 high threat, 2 medium, 3 benign
+
+
+@contextlib.contextmanager
+def _locked(path: pathlib.Path):
+    """Advisory exclusive lock on a sidecar file — serializes the
+    read-modify-write across the threaded serve handlers AND a
+    concurrently-running `onix label` process."""
+    lock = path.with_suffix(path.suffix + ".lock")
+    with open(lock, "w") as fh:
+        fcntl.flock(fh, fcntl.LOCK_EX)
+        try:
+            yield
+        finally:
+            fcntl.flock(fh, fcntl.LOCK_UN)
+
+
+def append_feedback(cfg: OnixConfig, datatype: str, date: str,
+                    rows: pd.DataFrame) -> pathlib.Path:
+    """Merge labeled rows into the day's feedback CSV.
+
+    Rows need at least (ip, word, label); re-labeling the same (ip, word)
+    keeps the newest label. Returns the feedback file path.
+    """
+    rows = rows.copy()
+    missing = {"ip", "word", "label"} - set(rows.columns)
+    if missing:
+        raise ValueError(f"feedback rows missing columns {sorted(missing)}")
+    numeric = pd.to_numeric(rows["label"], errors="raise")
+    if not (numeric % 1 == 0).all():
+        raise ValueError(f"labels must be integers, got {numeric.tolist()}")
+    rows["label"] = numeric.astype(int)
+    bad = set(rows["label"]) - set(VALID_LABELS)
+    if bad:
+        raise ValueError(f"labels must be in {VALID_LABELS}, got {sorted(bad)}")
+    for col in FEEDBACK_COLUMNS:
+        if col not in rows.columns:
+            rows[col] = ""
+    rows = rows[FEEDBACK_COLUMNS]
+
+    path = feedback_path(cfg.store.feedback_dir, datatype, date)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    with _locked(path):
+        if path.exists():
+            old = pd.read_csv(path, dtype=str)
+            rows = pd.concat([old, rows.astype(str)], ignore_index=True)
+        rows = rows.astype(str).drop_duplicates(["ip", "word"], keep="last")
+        rows.to_csv(path, index=False)
+    return path
+
+
+def label_by_rank(cfg: OnixConfig, datatype: str, date: str,
+                  ranks: list[int], label: int) -> pathlib.Path:
+    """Label OA results rows by their dashboard rank (1-based) — the
+    `onix label` CLI path for headless analysts."""
+    from onix.oa.engine import oa_dir
+    sus = oa_dir(cfg, datatype, date) / "suspicious.csv"
+    if not sus.exists():
+        raise FileNotFoundError(
+            f"no OA output at {sus} — run `onix oa {date} {datatype}` first")
+    df = pd.read_csv(sus)
+    sel = df[df["rank"].isin(ranks)]
+    if len(sel) != len(set(ranks)):
+        known = set(df["rank"].tolist())
+        raise ValueError(f"unknown ranks: {sorted(set(ranks) - known)}")
+    rows = sel[["ip", "word", "rank", "score"]].copy()
+    rows["label"] = label
+    return append_feedback(cfg, datatype, date, rows)

@@ -1,0 +1,140 @@
+"""Dashboard web server — the notebook-file-server equivalent.
+
+The reference serves its static UI from an IPython file server on port
+8889 (`/files/ui/flow/suspicious.html#date=...`, reference
+README.md:55-56). onix serves the same-shaped static UI from a stdlib
+threading HTTP server, mounts the OA data dir at `/data/`, and accepts
+the analyst's label POSTs at `/feedback` (the notebook write path of
+SURVEY.md §2.1 #14, done with a button instead of a notebook cell).
+
+No framework dependency on purpose: the UI is static files + JSON, the
+only dynamic endpoint is the feedback write.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import threading
+from http.server import SimpleHTTPRequestHandler, ThreadingHTTPServer
+
+import pandas as pd
+
+from onix.config import OnixConfig
+from onix.oa.feedback import append_feedback
+
+UI_ROOT = pathlib.Path(__file__).parent / "ui"
+DEFAULT_PORT = 8889             # match the reference's demo port
+
+
+def _safe_join(root: pathlib.Path, rel: str) -> pathlib.Path | None:
+    """Resolve rel under root; None if it escapes (path traversal)."""
+    target = (root / rel.lstrip("/")).resolve()
+    root = root.resolve()
+    if target == root or root in target.parents:
+        return target
+    return None
+
+
+class OAHandler(SimpleHTTPRequestHandler):
+    cfg: OnixConfig             # set on the subclass by make_server
+
+    def log_message(self, fmt, *args):   # quiet by default
+        pass
+
+    def _send_file(self, path: pathlib.Path) -> None:
+        if path.is_dir():
+            path = path / "index.html"
+        if not path.is_file():
+            self.send_error(404)
+            return
+        data = path.read_bytes()
+        self.send_response(200)
+        self.send_header("Content-Type", self.guess_type(str(path)))
+        self.send_header("Content-Length", str(len(data)))
+        self.send_header("Cache-Control", "no-store")
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _resolve(self) -> pathlib.Path | None:
+        path = self.path.split("?", 1)[0].split("#", 1)[0]
+        if path.startswith("/data/"):
+            root = pathlib.Path(self.cfg.oa.data_dir)
+            return _safe_join(root, path[len("/data/"):])
+        return _safe_join(UI_ROOT, path)
+
+    def do_GET(self):
+        target = self._resolve()
+        if target is None:
+            self.send_error(403)
+            return
+        self._send_file(target)
+
+    def do_HEAD(self):
+        # Must mirror do_GET's root mapping — the inherited handler would
+        # serve HEAD from the process cwd, bypassing _safe_join.
+        target = self._resolve()
+        if target is None:
+            self.send_error(403)
+            return
+        if target.is_dir():
+            target = target / "index.html"
+        if not target.is_file():
+            self.send_error(404)
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", self.guess_type(str(target)))
+        self.send_header("Content-Length", str(target.stat().st_size))
+        self.end_headers()
+
+    def do_POST(self):
+        if self.path.split("?", 1)[0] != "/feedback":
+            self.send_error(404)
+            return
+        try:
+            n = int(self.headers.get("Content-Length", 0))
+            body = json.loads(self.rfile.read(n))
+            rows = pd.DataFrame(body["rows"])
+            out = append_feedback(self.cfg, body["datatype"], body["date"],
+                                  rows)
+        except (KeyError, TypeError, ValueError, json.JSONDecodeError) as e:
+            self.send_response(400)
+            payload = json.dumps({"error": str(e)}).encode()
+        else:
+            self.send_response(200)
+            payload = json.dumps({"ok": True, "n": len(rows),
+                                  "path": str(out)}).encode()
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+
+def make_server(cfg: OnixConfig, port: int = DEFAULT_PORT,
+                host: str = "127.0.0.1") -> ThreadingHTTPServer:
+    handler = type("BoundOAHandler", (OAHandler,), {"cfg": cfg})
+    return ThreadingHTTPServer((host, port), handler)
+
+
+def run_serve(cfg: OnixConfig, port: int = DEFAULT_PORT,
+              host: str = "127.0.0.1") -> int:
+    server = make_server(cfg, port, host)
+    print(f"onix serve: dashboards at http://{host}:{port}/ "
+          f"(data from {cfg.oa.data_dir})")
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.server_close()
+    return 0
+
+
+def serve_background(cfg: OnixConfig, port: int = 0,
+                     host: str = "127.0.0.1") -> tuple[ThreadingHTTPServer, int]:
+    """Start the server on a daemon thread (tests, `onix demo`);
+    port 0 picks a free port. Returns (server, bound_port)."""
+    server = make_server(cfg, port, host)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    return server, server.server_address[1]

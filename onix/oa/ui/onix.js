@@ -1,0 +1,214 @@
+/* onix analyst dashboard — shared renderer for flow/dns/proxy.
+ *
+ * Data contract (written by `onix oa`, served by `onix serve`):
+ *   /data/<type>/dates.json                      available dates
+ *   /data/<type>/<yyyymmdd>/suspicious.json      scored+enriched rows
+ *   /data/<type>/<yyyymmdd>/summary.json         tiles/histogram/timeline
+ *   /data/<type>/<yyyymmdd>/graph.json           network nodes+links
+ * Labels POST to /feedback as {datatype, date, rows:[{ip,word,rank,score,label}]}.
+ * Date routing uses the #date=YYYY-MM-DD hash like the reference UI.
+ */
+"use strict";
+
+const TYPE = window.ONIX_TYPE;
+const COLS = {
+  flow: ["rank", "score", "treceived", "sip", "dip", "sport", "dport",
+         "proto", "ipkt", "ibyt", "src_geo_country", "dst_geo_country",
+         "dst_rep"],
+  dns: ["rank", "score", "frame_time", "ip_dst", "dns_qry_name", "domain",
+        "name_entropy", "dns_qry_type", "dns_qry_rcode", "geo_country",
+        "rep"],
+  proxy: ["rank", "score", "p_time", "clientip", "host", "reqmethod",
+          "uripath", "respcode", "useragent", "geo_country", "rep"],
+};
+const REP_COLS = new Set(["rep", "src_rep", "dst_rep"]);
+const labels = new Map();   // rank -> label
+
+function hashDate() {
+  const m = location.hash.match(/date=(\d{4}-\d{2}-\d{2})/);
+  return m ? m[1] : null;
+}
+function dayDir(date) { return date.replaceAll("-", ""); }
+async function getJSON(url) {
+  const r = await fetch(url);
+  if (!r.ok) throw new Error(`${url}: ${r.status}`);
+  return r.json();
+}
+function el(tag, attrs = {}, text = null) {
+  const e = document.createElement(tag);
+  for (const [k, v] of Object.entries(attrs)) e.setAttribute(k, v);
+  if (text !== null) e.textContent = text;
+  return e;
+}
+function svgEl(tag, attrs = {}) {
+  const e = document.createElementNS("http://www.w3.org/2000/svg", tag);
+  for (const [k, v] of Object.entries(attrs)) e.setAttribute(k, v);
+  return e;
+}
+function fmtScore(s) { return Number(s).toExponential(2); }
+
+function renderTiles(sum) {
+  const run = sum.run || {};
+  const tiles = [
+    ["suspicious", sum.n_results],
+    ["events scanned", run.n_events ?? "—"],
+    ["documents (IPs)", run.n_docs ?? "—"],
+    ["vocabulary", run.n_vocab ?? "—"],
+    ["min score", sum.score_min == null ? "—" : fmtScore(sum.score_min)],
+    ["run wall (s)", run.wall_seconds ?? "—"],
+  ];
+  const box = document.getElementById("tiles");
+  box.replaceChildren(...tiles.map(([l, v]) => {
+    const t = el("div", { class: "tile" });
+    t.append(el("div", { class: "v" }, String(v)), el("div", { class: "l" }, l));
+    return t;
+  }));
+}
+
+function renderBars(elId, values, titleFn) {
+  const svgW = 460, svgH = 120, pad = 4;
+  const box = document.getElementById(elId);
+  const svg = svgEl("svg", { viewBox: `0 0 ${svgW} ${svgH}`, width: "100%" });
+  const max = Math.max(1, ...values);
+  const bw = (svgW - pad * 2) / values.length;
+  values.forEach((v, i) => {
+    const h = (svgH - 18) * v / max;
+    const r = svgEl("rect", {
+      class: "bar", x: pad + i * bw + 0.5, width: Math.max(bw - 1, 1),
+      y: svgH - 14 - h, height: h,
+    });
+    r.append(svgEl("title"));
+    r.querySelector("title").textContent = titleFn(i, v);
+    svg.append(r);
+  });
+  const t0 = svgEl("text", { x: pad, y: svgH - 2 });
+  t0.textContent = titleFn(0, values[0]).split(":")[0];
+  const t1 = svgEl("text", { x: svgW - 60, y: svgH - 2 });
+  t1.textContent = titleFn(values.length - 1, values.at(-1)).split(":")[0];
+  svg.append(t0, t1);
+  box.replaceChildren(svg);
+}
+
+function renderGraph(graph) {
+  const box = document.getElementById("graph");
+  const links = [...graph.links].sort((a, b) => a.min_score - b.min_score)
+    .slice(0, 60);
+  const srcs = [...new Set(links.map(l => l.source))];
+  const dsts = [...new Set(links.map(l => l.target))];
+  if (!links.length) { box.replaceChildren(el("div", { class: "empty" }, "no edges")); return; }
+  const rowH = 14, svgW = 460, pad = 110;
+  const svgH = Math.max(srcs.length, dsts.length) * rowH + 24;
+  const svg = svgEl("svg", { viewBox: `0 0 ${svgW} ${svgH}`, width: "100%" });
+  const yOf = (list, id) => 16 + list.indexOf(id) * rowH;
+  const maxW = Math.max(...links.map(l => l.weight));
+  for (const l of links) {
+    const line = svgEl("line", {
+      class: "edge" + (l.min_score <= links[0].min_score * 10 ? " hot" : ""),
+      x1: pad, y1: yOf(srcs, l.source),
+      x2: svgW - pad, y2: yOf(dsts, l.target),
+      "stroke-width": Math.max(1, 4 * l.weight / maxW),
+    });
+    const t = svgEl("title");
+    t.textContent = `${l.source} → ${l.target} (${l.weight} events, ` +
+      `min score ${fmtScore(l.min_score)})`;
+    line.append(t);
+    svg.append(line);
+  }
+  srcs.forEach(s => {
+    const t = svgEl("text", { class: "node", x: pad - 6, y: yOf(srcs, s) + 3,
+                              "text-anchor": "end" });
+    t.textContent = s; svg.append(t);
+  });
+  dsts.forEach(d => {
+    const t = svgEl("text", { class: "node", x: svgW - pad + 6,
+                              y: yOf(dsts, d) + 3 });
+    t.textContent = d; svg.append(t);
+  });
+  box.replaceChildren(svg);
+}
+
+function renderTable(rows, date) {
+  const cols = COLS[TYPE].filter(c => rows.length === 0 || c in rows[0]);
+  const thead = el("thead");
+  const hr = el("tr");
+  cols.forEach(c => hr.append(el("th", {}, c)));
+  hr.append(el("th", {}, "sev"));
+  thead.append(hr);
+  const tbody = el("tbody");
+  for (const row of rows) {
+    const tr = el("tr");
+    for (const c of cols) {
+      const td = el("td", { class: c === "score" ? "score" : "" });
+      let v = row[c];
+      if (c === "score") v = fmtScore(v);
+      td.textContent = v == null ? "" : v;
+      if (REP_COLS.has(c)) td.className = `rep-${row[c]}`;
+      td.title = row[c] == null ? "" : String(row[c]);
+      tr.append(td);
+    }
+    const sel = el("select");
+    [["0", "—"], ["1", "high"], ["2", "med"], ["3", "benign"]].forEach(
+      ([v, t]) => sel.append(el("option", { value: v }, t)));
+    sel.value = String(row.sev ?? 0);
+    sel.addEventListener("change", () => {
+      if (sel.value === "0") labels.delete(row.rank);
+      else labels.set(row.rank, {
+        ip: row.ip, word: row.word, rank: row.rank, score: row.score,
+        label: Number(sel.value),
+      });
+      document.getElementById("save").disabled = labels.size === 0;
+    });
+    tr.append(el("td")).lastChild.append(sel);
+    tbody.append(tr);
+  }
+  const table = document.getElementById("sus-table");
+  table.replaceChildren(thead, tbody);
+  document.getElementById("save").onclick = async () => {
+    const status = document.getElementById("status");
+    try {
+      const r = await fetch("/feedback", {
+        method: "POST", headers: { "Content-Type": "application/json" },
+        body: JSON.stringify({ datatype: TYPE, date,
+                               rows: [...labels.values()] }),
+      });
+      const body = await r.json();
+      if (!r.ok) throw new Error(body.error || r.status);
+      status.textContent = `saved ${body.n} labels — consumed by the next run`;
+      status.className = "ok";
+      labels.clear();
+      document.getElementById("save").disabled = true;
+    } catch (e) {
+      status.textContent = `save failed: ${e.message}`;
+      status.className = "err";
+    }
+  };
+}
+
+async function load() {
+  const dates = await getJSON(`/data/${TYPE}/dates.json`).catch(() => []);
+  const picker = document.getElementById("date-picker");
+  picker.replaceChildren(...dates.map(d => el("option", { value: d }, d)));
+  const date = hashDate() || dates.at(-1);
+  if (!date) {
+    document.querySelector("main").replaceChildren(
+      el("div", { class: "empty" },
+         `no OA output for ${TYPE} yet — run \`onix oa <date> ${TYPE}\``));
+    return;
+  }
+  picker.value = date;
+  picker.onchange = () => { location.hash = `date=${picker.value}`; };
+  const dir = `/data/${TYPE}/${dayDir(date)}`;
+  const [rows, sum, graph] = await Promise.all([
+    getJSON(`${dir}/suspicious.json`), getJSON(`${dir}/summary.json`),
+    getJSON(`${dir}/graph.json`)]);
+  renderTiles(sum);
+  renderBars("hist", sum.histogram.counts,
+    (i, v) => `bin ${i}: ${v} events`);
+  renderBars("timeline", sum.timeline_hourly,
+    (i, v) => `${String(i).padStart(2, "0")}:00: ${v} events`);
+  renderGraph(graph);
+  renderTable(rows, date);
+}
+
+window.addEventListener("hashchange", load);
+window.addEventListener("DOMContentLoaded", load);

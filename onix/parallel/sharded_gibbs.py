@@ -261,7 +261,11 @@ class ShardedGibbsLDA:
         n_sweeps = cfg.n_sweeps if n_sweeps is None else n_sweeps
         sc = self.prepare(corpus)
         docs, words, mask = self.device_corpus(sc)
-        fp = ckpt.fingerprint(cfg, sc.doc_map.shape[0] * sc.n_docs_local,
+        # n_chains is a GibbsLDA-only knob this sampler never reads —
+        # normalize it out so toggling it cannot orphan sharded checkpoints.
+        import dataclasses as _dc
+        fp = ckpt.fingerprint(_dc.replace(cfg, n_chains=1),
+                              sc.doc_map.shape[0] * sc.n_docs_local,
                               sc.n_vocab, corpus.n_tokens,
                               extra={"mesh": list(self.mesh.shape.values())})
         if checkpoint_dir is not None:

@@ -1,0 +1,75 @@
+"""`onix setup` + `onix demo` — the oni-setup / demo-packaging equivalents.
+
+The reference's oni-setup scripts create the HDFS dirs and Hive db/tables
+and distribute the central config (SURVEY.md §2.1 #3, §3.4); its demo is
+a Docker image with a precomputed 2016-07-08 dataset that doubles as the
+integration-test fixture (SURVEY.md §2.1 #15, reference README.md:50-62).
+
+onix setup: materialize the store layout (partitioned Parquet dirs in
+place of Hive DDL) and archive the resolved config — idempotent.
+
+onix demo: synthesize the demo day for all three datatypes, load the
+store, run the full scoring pipeline and OA, and optionally serve the
+dashboards — the one-command end-to-end slice.
+"""
+
+from __future__ import annotations
+
+import pathlib
+
+from onix.config import DATATYPES, OnixConfig
+
+DEMO_DATE = "2016-07-08"        # the reference demo's canned date
+
+
+def run_setup(cfg: OnixConfig) -> int:
+    """Create the storage substrate; safe to re-run."""
+    root = pathlib.Path(cfg.store.root)
+    created = []
+    for d in [root / t for t in DATATYPES] + [
+            pathlib.Path(cfg.store.results_dir),
+            pathlib.Path(cfg.store.feedback_dir),
+            pathlib.Path(cfg.store.checkpoint_dir),
+            pathlib.Path(cfg.oa.data_dir)]:
+        if not d.exists():
+            created.append(str(d))
+        d.mkdir(parents=True, exist_ok=True)
+    cfg.archive(root / "onix.config.json")
+    print(f"onix setup: store at {root} "
+          f"({len(created)} dirs created, config archived)")
+    return 0
+
+
+def run_demo(cfg: OnixConfig, n_events: int = 20000, serve: bool = False,
+             port: int = 8889) -> int:
+    """End-to-end demo on synthetic telemetry for DEMO_DATE."""
+    from onix.oa.engine import run_oa
+    from onix.pipelines.run import run_scoring
+    from onix.pipelines.synth import (synth_dns_day, synth_flow_day,
+                                      synth_proxy_day)
+    from onix.store import Store
+
+    run_setup(cfg)
+    store = Store(cfg.store.root)
+    gens = {"flow": synth_flow_day, "dns": synth_dns_day,
+            "proxy": synth_proxy_day}
+    for datatype in DATATYPES:
+        if not store.has(datatype, DEMO_DATE):
+            table, _anomalies = gens[datatype](n_events=n_events,
+                                               date=DEMO_DATE, seed=7)
+            store.write(datatype, DEMO_DATE, table)
+            print(f"onix demo: synthesized {len(table)} {datatype} events")
+        cfg.pipeline.datatype = datatype
+        cfg.pipeline.date = DEMO_DATE
+        rc = run_scoring(cfg)
+        if rc:
+            return rc
+        rc = run_oa(cfg, DEMO_DATE, datatype)
+        if rc:
+            return rc
+    if serve:
+        from onix.oa.serve import run_serve
+        print(f"onix demo: open http://127.0.0.1:{port}/flow/"
+              f"suspicious.html#date={DEMO_DATE}")
+        return run_serve(cfg, port=port)
+    return 0

@@ -1,0 +1,226 @@
+"""OA batch engine tests (SURVEY.md §2.1 #12, §3.3).
+
+Covers the enrichment components (GeoIP CIDR lookup, domain context,
+reputation plugins) and the end-to-end `run_oa` contract: results CSV in,
+per-date UI data files out.
+"""
+
+import json
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from onix.config import load_config
+from onix.oa.components import (GeoIPDB, LocalListReputation, build_reputation,
+                                cidr_to_range, domain_context, ip_to_u32,
+                                reputation_column)
+from onix.oa.engine import oa_dir, run_oa
+from onix.store import results_path
+
+
+# ---------------------------------------------------------------------------
+# components
+# ---------------------------------------------------------------------------
+
+
+def test_ip_to_u32():
+    got = ip_to_u32(["0.0.0.1", "10.0.0.0", "255.255.255.255", "bogus",
+                     "1.2.3.999", ""])
+    assert got.tolist() == [1, 10 << 24, 0xFFFFFFFF, 0, 0, 0]
+
+
+def test_cidr_to_range():
+    assert cidr_to_range("10.0.0.0/8") == (10 << 24, (11 << 24) - 1)
+    start, end = cidr_to_range("192.168.1.5/32")
+    assert start == end
+    # non-aligned base is masked down to the block boundary
+    start, end = cidr_to_range("10.5.7.9/16")
+    assert start == (10 << 24) | (5 << 16)
+    assert end == start + 0xFFFF
+
+
+def test_geoip_builtin_and_custom(tmp_path):
+    db_csv = tmp_path / "geo.csv"
+    db_csv.write_text(
+        "network,country,city,latitude,longitude,isp\n"
+        "203.0.113.0/24,AU,Sydney,-33.8,151.2,ExampleNet\n")
+    db = GeoIPDB.load(db_csv)
+    got = db.lookup(["10.1.2.3", "203.0.113.77", "8.8.8.8"])
+    assert got["geo_country"].tolist() == ["internal", "AU", "unknown"]
+    assert got["geo_isp"].tolist() == ["internal", "ExampleNet", "unknown"]
+    assert got["geo_lat"].iloc[1] == pytest.approx(-33.8)
+
+
+def test_geoip_range_boundaries():
+    db = GeoIPDB.builtin()
+    got = db.lookup(["10.0.0.0", "10.255.255.255", "11.0.0.0",
+                     "9.255.255.255"])
+    assert got["geo_country"].tolist() == ["internal", "internal",
+                                           "unknown", "unknown"]
+
+
+def test_domain_context():
+    dc = domain_context(["www.mail.example.com", "xkqjzv9a2.evil.biz",
+                         "beacon.x7q"], top_domains=["example", "google"])
+    assert dc["domain"].tolist() == ["example", "evil", "beacon"]
+    assert dc["subdomain"].tolist() == ["www.mail", "xkqjzv9a2", ""]
+    assert dc["domain_rank"].tolist() == [1, -1, -1]
+    assert dc["tld_valid"].tolist() == [True, True, False]
+    # randomish subdomain has higher whole-name entropy than www.mail
+    assert dc["name_entropy"].iloc[1] > 0
+
+
+def test_reputation_local_list(tmp_path):
+    bl = tmp_path / "indicators.txt"
+    bl.write_text("# known-bad\nevil.biz\n198.51.100.7,MEDIUM\n")
+    client = LocalListReputation(bl)
+    got = client.check(["EVIL.biz", "198.51.100.7", "good.org"])
+    assert got["EVIL.biz"] == "HIGH"
+    assert got["198.51.100.7"] == "MEDIUM"
+    assert got["good.org"] == "NONE"
+
+    clients = build_reputation(f"local:{bl},noop")
+    col = reputation_column(clients, ["evil.biz", "good.org"])
+    assert col.tolist() == ["HIGH", "NONE"]
+
+
+def test_reputation_bad_spec():
+    with pytest.raises(ValueError, match="unknown reputation plugin"):
+        build_reputation("gti:key=abc")
+
+
+# ---------------------------------------------------------------------------
+# engine end-to-end
+# ---------------------------------------------------------------------------
+
+
+def _fake_results(datatype: str, n: int = 12) -> pd.DataFrame:
+    rng = np.random.default_rng(0)
+    scores = np.sort(rng.uniform(1e-6, 1e-3, n))
+    base = {
+        "score": scores,
+        "event_idx": np.arange(n),
+        "ip": [f"10.0.0.{i % 4}" for i in range(n)],
+        "word": [f"w{i % 5}" for i in range(n)],
+    }
+    if datatype == "flow":
+        base.update({
+            "treceived": [f"2016-07-08 0{i % 10}:15:00" for i in range(n)],
+            "sip": [f"10.0.0.{i % 4}" for i in range(n)],
+            "dip": [f"203.0.113.{i % 3}" for i in range(n)],
+            "sport": 40000 + np.arange(n), "dport": [443] * n,
+            "proto": ["TCP"] * n, "ipkt": [10] * n, "ibyt": [1000] * n,
+            "opkt": [8] * n, "obyt": [300] * n,
+        })
+    elif datatype == "dns":
+        base.update({
+            "frame_time": [f"2016-07-08 0{i % 10}:15:00" for i in range(n)],
+            "frame_len": [120] * n,
+            "ip_dst": [f"10.0.0.{i % 4}" for i in range(n)],
+            "dns_qry_name": [f"x{i}.evil.biz" for i in range(n)],
+            "dns_qry_type": [1] * n, "dns_qry_rcode": [0] * n,
+        })
+    else:
+        base.update({
+            "p_date": ["2016-07-08"] * n,
+            "p_time": [f"0{i % 10}:15:00" for i in range(n)],
+            "clientip": [f"10.0.0.{i % 4}" for i in range(n)],
+            "host": ["evil.biz"] * n, "reqmethod": ["GET"] * n,
+            "useragent": ["curl/7.0"] * n, "resconttype": ["text/html"] * n,
+            "respcode": [200] * n, "uripath": ["/x"] * n,
+            "csbytes": [100] * n, "scbytes": [5000] * n,
+        })
+    return pd.DataFrame(base)
+
+
+@pytest.mark.parametrize("datatype", ["flow", "dns", "proxy"])
+def test_run_oa_end_to_end(tmp_path, datatype):
+    bl = tmp_path / "bad.txt"
+    bl.write_text("evil.biz\n203.0.113.1\n")
+    cfg = load_config(None, [
+        f"store.root={tmp_path}/store",
+        f"store.results_dir={tmp_path}/results",
+        f"oa.data_dir={tmp_path}/oa",
+        f"oa.reputation=local:{bl}",
+    ])
+    date = "2016-07-08"
+    res = results_path(cfg.store.results_dir, datatype, date)
+    res.parent.mkdir(parents=True, exist_ok=True)
+    df = _fake_results(datatype)
+    df.to_csv(res, index=False)
+    res.with_suffix(".manifest.json").write_text(json.dumps(
+        {"n_events": 999, "n_docs": 4, "n_vocab": 5, "n_tokens": 24,
+         "engine": "gibbs", "config_hash": "abc", "seed": 0,
+         "wall_seconds": 1.0}))
+
+    assert run_oa(cfg, date, datatype) == 0
+
+    out = oa_dir(cfg, datatype, date)
+    sus = pd.read_csv(out / "suspicious.csv")
+    assert len(sus) == len(df)
+    assert sus["rank"].tolist() == list(range(1, len(df) + 1))
+    assert (sus["sev"] == 0).all()
+    if datatype == "flow":
+        assert (sus["src_geo_country"] == "internal").all()
+        assert set(sus["dst_rep"]) <= {"HIGH", "NONE"}
+        assert "HIGH" in set(sus["dst_rep"])       # 203.0.113.1 is listed
+    else:
+        assert (sus["geo_country"] == "internal").all()
+        assert (sus["rep"] == "HIGH").all()
+        assert (sus["domain"] == "evil").all()
+
+    summary = json.loads((out / "summary.json").read_text())
+    assert summary["n_results"] == len(df)
+    assert sum(summary["histogram"]["counts"]) == len(df)
+    assert len(summary["timeline_hourly"]) == 24
+    assert sum(summary["timeline_hourly"]) == len(df)
+    assert summary["run"]["n_events"] == 999
+
+    graph = json.loads((out / "graph.json").read_text())
+    assert graph["nodes"] and graph["links"]
+    total_weight = sum(l["weight"] for l in graph["links"])
+    assert total_weight == len(df)
+
+    dates = json.loads((out.parent / "dates.json").read_text())
+    assert dates == [date]
+    # idempotent re-run, index stays deduped
+    assert run_oa(cfg, date, datatype) == 0
+    assert json.loads((out.parent / "dates.json").read_text()) == [date]
+
+
+def test_run_oa_missing_results(tmp_path):
+    cfg = load_config(None, [f"store.results_dir={tmp_path}/results",
+                             f"oa.data_dir={tmp_path}/oa"])
+    assert run_oa(cfg, "2016-07-08", "flow") == 1
+
+
+def test_geoip_nested_ranges_fall_back_to_outer(tmp_path):
+    """A specific subnet inside a broader range must win inside it, and
+    the broader range must still cover addresses after the subnet ends
+    (code-review regression: naive sorted-start lookup lost the outer
+    range beyond a nested range's end)."""
+    db_csv = tmp_path / "geo.csv"
+    db_csv.write_text(
+        "network,country,city,latitude,longitude,isp\n"
+        "10.1.0.0/16,DC,rack1,1.0,2.0,datacenter\n")
+    db = GeoIPDB.load(db_csv)
+    got = db.lookup(["10.1.2.3", "10.2.3.4", "10.0.0.1"])
+    # inside the nested /16 -> the specific row
+    assert got["geo_isp"].iloc[0] == "datacenter"
+    # after the /16 but still in builtin 10.0.0.0/8 -> internal, not unknown
+    assert got["geo_country"].iloc[1] == "internal"
+    assert got["geo_country"].iloc[2] == "internal"
+
+
+def test_top_domains_accepts_standard_formats(tmp_path):
+    from onix.config import load_config as _lc
+    from onix.oa.engine import _load_top_domains
+    f = tmp_path / "top.txt"
+    f.write_text("# umbrella style\n1,google.com\n2,facebook.com\n"
+                 "example.org\nbare-sld\n3,google.com\n")
+    cfg = _lc(None, [f"oa.top_domains={f}"])
+    assert _load_top_domains(cfg) == ["google", "facebook", "example",
+                                      "bare-sld"]
+    dc = domain_context(["mail.google.com"], _load_top_domains(cfg))
+    assert dc["domain_rank"].tolist() == [1]

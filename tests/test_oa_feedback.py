@@ -1,0 +1,225 @@
+"""Feedback capture + dashboard server tests (SURVEY.md §2.1 #13-#14).
+
+Exercises the full noise-filter loop the reference closes via notebooks
+(reference README.md:48): OA output -> label (CLI and HTTP POST) ->
+feedback CSV -> next scoring run consumes it ×DUPFACTOR.
+"""
+
+import http.client
+import json
+import pathlib
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from onix.config import load_config
+from onix.oa.engine import oa_dir, run_oa
+from onix.oa.feedback import append_feedback, label_by_rank
+from onix.oa.serve import UI_ROOT, serve_background
+from onix.store import feedback_path, results_path
+
+
+@pytest.fixture
+def cfg(tmp_path):
+    return load_config(None, [
+        f"store.root={tmp_path}/store",
+        f"store.results_dir={tmp_path}/results",
+        f"store.feedback_dir={tmp_path}/feedback",
+        f"oa.data_dir={tmp_path}/oa",
+    ])
+
+
+def _seed_oa_output(cfg, datatype="flow", date="2016-07-08", n=6):
+    res = results_path(cfg.store.results_dir, datatype, date)
+    res.parent.mkdir(parents=True, exist_ok=True)
+    pd.DataFrame({
+        "score": np.linspace(1e-6, 1e-4, n),
+        "event_idx": np.arange(n),
+        "ip": [f"10.0.0.{i}" for i in range(n)],
+        "word": [f"w{i}" for i in range(n)],
+        "treceived": ["2016-07-08 03:00:00"] * n,
+        "sip": [f"10.0.0.{i}" for i in range(n)],
+        "dip": ["203.0.113.9"] * n,
+        "sport": [40000] * n, "dport": [443] * n, "proto": ["TCP"] * n,
+        "ipkt": [5] * n, "ibyt": [500] * n, "opkt": [4] * n, "obyt": [200] * n,
+    }).to_csv(res, index=False)
+    assert run_oa(cfg, date, datatype) == 0
+
+
+def test_append_feedback_merges_and_validates(cfg):
+    rows = pd.DataFrame({"ip": ["10.0.0.1"], "word": ["w1"], "label": [3]})
+    path = append_feedback(cfg, "flow", "2016-07-08", rows)
+    assert path == feedback_path(cfg.store.feedback_dir, "flow", "2016-07-08")
+    # re-label same pair: newest label wins, no duplicate row
+    rows2 = pd.DataFrame({"ip": ["10.0.0.1"], "word": ["w1"], "label": [1]})
+    append_feedback(cfg, "flow", "2016-07-08", rows2)
+    got = pd.read_csv(path)
+    assert len(got) == 1
+    assert got["label"].iloc[0] == 1
+
+    with pytest.raises(ValueError, match="labels must be"):
+        append_feedback(cfg, "flow", "2016-07-08",
+                        pd.DataFrame({"ip": ["x"], "word": ["y"],
+                                      "label": [9]}))
+    with pytest.raises(ValueError, match="missing columns"):
+        append_feedback(cfg, "flow", "2016-07-08",
+                        pd.DataFrame({"ip": ["x"]}))
+
+
+def test_label_by_rank(cfg):
+    _seed_oa_output(cfg)
+    path = label_by_rank(cfg, "flow", "2016-07-08", [1, 3], label=3)
+    got = pd.read_csv(path)
+    assert sorted(got["ip"]) == ["10.0.0.0", "10.0.0.2"]
+    assert (got["label"] == 3).all()
+    with pytest.raises(ValueError, match="unknown ranks"):
+        label_by_rank(cfg, "flow", "2016-07-08", [999], label=3)
+
+
+def test_feedback_round_trip_suppresses(cfg):
+    """Labeling benign raises p(word|ip): next run's corpus carries the
+    duplicated tokens — the DUPFACTOR mechanism end to end."""
+    from onix.pipelines.corpus_build import build_corpus
+    from onix.pipelines.run import load_feedback
+    from onix.pipelines.words import WordTable
+
+    _seed_oa_output(cfg)
+    label_by_rank(cfg, "flow", "2016-07-08", [1], label=3)
+    fb = load_feedback(cfg, "flow", "2016-07-09")   # next day's run sees it
+    assert fb is not None and len(fb) == 1
+
+    words = WordTable(
+        ip=np.array(["10.0.0.0", "10.0.0.1"], object),
+        word=np.array(["w0", "w1"], object),
+        event_idx=np.arange(2), edges={})
+    bundle = build_corpus(words, fb, dupfactor=50)
+    assert bundle.corpus.n_tokens == 2 + 50
+    assert bundle.n_real_tokens == 2
+
+
+def test_threat_labels_do_not_bias(cfg):
+    """Threat labels (1/2) must NOT be duplicated into the corpus."""
+    from onix.pipelines.run import load_feedback
+
+    _seed_oa_output(cfg)
+    label_by_rank(cfg, "flow", "2016-07-08", [2], label=1)
+    fb = load_feedback(cfg, "flow", "2016-07-09")
+    assert fb is None or len(fb) == 0
+
+
+def test_serve_static_data_and_feedback(cfg):
+    _seed_oa_output(cfg)
+    server, port = serve_background(cfg)
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+
+        def get(path):
+            conn.request("GET", path)
+            r = conn.getresponse()
+            return r.status, r.read()
+
+        # UI pages for all three datatypes + index
+        for page in ("/", "/flow/suspicious.html", "/dns/suspicious.html",
+                     "/proxy/suspicious.html", "/onix.js", "/onix.css"):
+            status, body = get(page)
+            assert status == 200, page
+            assert body
+        # data mount
+        status, body = get("/data/flow/dates.json")
+        assert status == 200 and json.loads(body) == ["2016-07-08"]
+        status, body = get("/data/flow/20160708/suspicious.json")
+        assert status == 200 and len(json.loads(body)) == 6
+        # path traversal is refused
+        status, _ = get("/data/../../etc/passwd")
+        assert status in (403, 404)
+        # 404 for missing
+        status, _ = get("/nope.html")
+        assert status == 404
+
+        # feedback POST -> CSV on disk
+        payload = json.dumps({
+            "datatype": "flow", "date": "2016-07-08",
+            "rows": [{"ip": "10.0.0.5", "word": "w5", "rank": 6,
+                      "score": 1e-4, "label": 3}]}).encode()
+        conn.request("POST", "/feedback", body=payload,
+                     headers={"Content-Type": "application/json"})
+        r = conn.getresponse()
+        assert r.status == 200, r.read()
+        assert json.loads(r.read())["ok"] is True
+        fb = pd.read_csv(feedback_path(cfg.store.feedback_dir, "flow",
+                                       "2016-07-08"))
+        assert fb["ip"].tolist() == ["10.0.0.5"]
+
+        # malformed POST -> 400
+        conn.request("POST", "/feedback", body=b"{}",
+                     headers={"Content-Type": "application/json"})
+        r = conn.getresponse()
+        assert r.status == 400
+        r.read()
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def test_ui_files_ship_complete():
+    """The static UI must ship every page the nav links to."""
+    for rel in ("index.html", "onix.js", "onix.css",
+                "flow/suspicious.html", "dns/suspicious.html",
+                "proxy/suspicious.html"):
+        assert (UI_ROOT / rel).is_file(), rel
+    for t in ("flow", "dns", "proxy"):
+        html = (UI_ROOT / t / "suspicious.html").read_text()
+        assert f'ONIX_TYPE = "{t}"' in html
+
+
+def test_fractional_label_rejected(cfg):
+    with pytest.raises(ValueError, match="integers"):
+        append_feedback(cfg, "flow", "2016-07-08",
+                        pd.DataFrame({"ip": ["x"], "word": ["y"],
+                                      "label": [2.7]}))
+
+
+def test_concurrent_feedback_writes_do_not_lose_labels(cfg):
+    import concurrent.futures
+    rows = [pd.DataFrame({"ip": [f"10.0.0.{i}"], "word": [f"w{i}"],
+                          "label": [3]}) for i in range(16)]
+    with concurrent.futures.ThreadPoolExecutor(8) as ex:
+        list(ex.map(lambda r: append_feedback(cfg, "flow", "2016-07-08", r),
+                    rows))
+    got = pd.read_csv(feedback_path(cfg.store.feedback_dir, "flow",
+                                    "2016-07-08"))
+    assert len(got) == 16
+
+
+def test_serve_head_and_malformed_post(cfg):
+    _seed_oa_output(cfg)
+    server, port = serve_background(cfg)
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+        # HEAD follows the same root mapping as GET (no cwd disclosure)
+        conn.request("HEAD", "/flow/suspicious.html")
+        r = conn.getresponse()
+        assert r.status == 200 and int(r.headers["Content-Length"]) > 0
+        r.read()
+        conn.request("HEAD", "/data/flow/dates.json")
+        r = conn.getresponse(); assert r.status == 200; r.read()
+        conn.request("HEAD", "/pyproject.toml")   # exists in cwd, not UI
+        r = conn.getresponse(); assert r.status == 404; r.read()
+        # non-object JSON body -> 400, not a crashed handler thread
+        conn.request("POST", "/feedback", body=b"[1,2,3]",
+                     headers={"Content-Type": "application/json"})
+        r = conn.getresponse()
+        assert r.status == 400
+        r.read()
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def test_n_chains_rejected_for_non_gibbs_engines(cfg):
+    from onix.pipelines.corpus_build import CorpusBundle
+    from onix.pipelines.run import fit_engine
+    cfg.lda.n_chains = 4
+    with pytest.raises(ValueError, match="only implemented for the 'gibbs'"):
+        fit_engine(cfg, None, "svi")

@@ -1,0 +1,64 @@
+"""`onix setup` / `onix demo` integration tests (SURVEY.md §2.1 #3, #15).
+
+The demo is the reference's canned-day Docker image reimagined as a
+one-command synthetic run — and, like the reference's, it doubles as the
+end-to-end integration fixture (SURVEY.md §4: "the demo effectively IS
+the integration test fixture").
+"""
+
+import json
+import pathlib
+
+import pytest
+
+from onix.cli import main as cli_main
+from onix.config import load_config
+from onix.setup_cmd import DEMO_DATE, run_demo, run_setup
+
+
+def _overrides(tmp_path, extra=()):
+    return [
+        "-s", f"store.root={tmp_path}/store",
+        "-s", f"store.results_dir={tmp_path}/results",
+        "-s", f"store.feedback_dir={tmp_path}/feedback",
+        "-s", f"store.checkpoint_dir={tmp_path}/ck",
+        "-s", f"oa.data_dir={tmp_path}/oa",
+        *extra,
+    ]
+
+
+def test_setup_idempotent(tmp_path):
+    assert cli_main(["setup", *_overrides(tmp_path)]) == 0
+    root = tmp_path / "store"
+    for t in ("flow", "dns", "proxy"):
+        assert (root / t).is_dir()
+    archived = json.loads((root / "onix.config.json").read_text())
+    assert archived["store"]["root"] == str(root)
+    # re-run is a no-op, not an error
+    assert cli_main(["setup", *_overrides(tmp_path)]) == 0
+
+
+@pytest.mark.slow
+def test_demo_end_to_end(tmp_path):
+    cfg = load_config(None, [
+        f"store.root={tmp_path}/store",
+        f"store.results_dir={tmp_path}/results",
+        f"store.feedback_dir={tmp_path}/feedback",
+        f"store.checkpoint_dir={tmp_path}/ck",
+        f"oa.data_dir={tmp_path}/oa",
+        "lda.n_sweeps=6", "lda.burn_in=2", "pipeline.max_results=200",
+    ])
+    assert run_demo(cfg, n_events=800) == 0
+    for t in ("flow", "dns", "proxy"):
+        day = tmp_path / "oa" / t / DEMO_DATE.replace("-", "")
+        assert (day / "suspicious.csv").is_file()
+        assert (day / "summary.json").is_file()
+        results = pathlib.Path(tmp_path / "results" /
+                               DEMO_DATE.replace("-", "") /
+                               f"{t}_results.csv")
+        assert results.is_file()
+        summary = json.loads((day / "summary.json").read_text())
+        assert summary["n_results"] > 0
+        assert summary["run"]["n_events"] == 800
+    # demo is resumable: store already loaded, scoring re-runs cleanly
+    assert run_demo(cfg, n_events=800) == 0
