@@ -3,10 +3,20 @@
 Measures the post-LDA suspicious-connects scoring scan (SURVEY.md §3.1
 hot loop #3 — the throughput path that touches every raw event,
 reference README.md:42 "filter billion of events to a few thousands")
-on the available accelerator, and a Gibbs sweep rate alongside.
+on the available accelerator.
+
+Methodology notes (hard-won on the tunneled TPU):
+- `block_until_ready` does not reliably synchronize through the remote
+  device tunnel, and a single dispatch carries a ~65-70 ms host RTT.
+  The timed region therefore chains `REPS` full scoring passes inside
+  ONE jitted program (lax.scan) and forces one final host transfer, so
+  per-pass numbers amortize the RTT to <3%.
+- Each pass perturbs the event indices with the loop counter; a
+  loop-invariant body would be hoisted/CSE'd by XLA and the measurement
+  would report fantasy numbers (observed: 1000x inflation).
 
 Baseline (BASELINE.md): the reference published NO numbers; the
-operative stand-in for its 20-node CPU cluster is 20× a single-core
+operative stand-in for its 20-node CPU cluster is 20x a single-core
 vectorized NumPy scorer measured on this host, which is generous to the
 reference (its Scala/Spark scoring had JVM + shuffle overhead on top).
 
@@ -41,33 +51,52 @@ def main() -> None:
     from onix.models.scoring import top_suspicious
 
     n_docs, n_vocab, k = 100_000, 65_536, 20
-    n_events = 1 << 24            # ~16.8M events per timed pass
-    chunk = 1 << 21
+    n_events = 1 << 24            # ~16.8M events per pass
+    reps = 8                      # passes chained inside one program
+    max_results = 1000
 
     rng = np.random.default_rng(0)
     theta = rng.dirichlet(np.full(k, 0.5), size=n_docs).astype(np.float32)
     phi_wk = rng.dirichlet(np.full(k, 0.5), size=n_vocab).astype(np.float32)
     doc_ids = rng.integers(0, n_docs, n_events).astype(np.int32)
     word_ids = rng.integers(0, n_vocab, n_events).astype(np.int32)
-    mask = np.ones(n_events, np.float32)
 
     dev = jax.devices()[0]
     theta_d = jnp.asarray(theta)
     phi_d = jnp.asarray(phi_wk)
     d_d = jnp.asarray(doc_ids)
     w_d = jnp.asarray(word_ids)
-    m_d = jnp.asarray(mask)
+    m_d = jnp.ones(n_events, jnp.float32)
 
-    run = lambda: top_suspicious(theta_d, phi_d, d_d, w_d, m_d,
-                                 tol=1.0, max_results=1000, chunk=chunk)
-    run().scores.block_until_ready()          # compile + warm
+    @jax.jit
+    def bench(theta, phi, d, w, m):
+        def one_pass(carry, i):
+            best_s, best_i = carry
+            # Loop-dependent index perturbation: every pass re-gathers
+            # fresh rows; without this XLA hoists the whole body.
+            di = jax.lax.rem(d + i, jnp.int32(n_docs))
+            wi = jax.lax.rem(w + i, jnp.int32(n_vocab))
+            out = top_suspicious(theta, phi, di, wi, m,
+                                 tol=1.0, max_results=max_results)
+            cat_s = jnp.concatenate([best_s, out.scores])
+            cat_i = jnp.concatenate([best_i, out.indices])
+            neg, pos = jax.lax.top_k(-cat_s, max_results)
+            return (-neg, cat_i[pos]), None
+
+        init = (jnp.full((max_results,), jnp.inf, jnp.float32),
+                jnp.full((max_results,), -1, jnp.int32))
+        (scores, idx), _ = jax.lax.scan(
+            one_pass, init, jnp.arange(reps, dtype=jnp.int32))
+        return scores, idx
+
+    # Warm (compile) then time: one dispatch, REPS full passes, one fetch.
+    np.asarray(bench(theta_d, phi_d, d_d, w_d, m_d)[0])
     t0 = time.perf_counter()
-    n_passes = 3
-    for _ in range(n_passes):
-        out = run()
-    out.scores.block_until_ready()
+    scores, _ = bench(theta_d, phi_d, d_d, w_d, m_d)
+    scores_h = np.asarray(scores)     # forces completion through the tunnel
     dt = time.perf_counter() - t0
-    rate = n_passes * n_events / dt
+    assert np.isfinite(scores_h).all()
+    rate = reps * n_events / dt
 
     baseline = 20.0 * _numpy_scoring_rate(theta, phi_wk)
 
@@ -79,7 +108,8 @@ def main() -> None:
         "detail": {
             "device": str(dev),
             "n_events_per_pass": n_events,
-            "passes": n_passes,
+            "passes_in_one_program": reps,
+            "wall_seconds": round(dt, 3),
             "baseline_events_per_sec_20node_numpy_proxy": round(baseline, 1),
         },
     }))

@@ -26,6 +26,12 @@ import pathlib
 import numpy as np
 
 
+class SimulatedPreemption(RuntimeError):
+    """Raised by the fault-injection hook (SURVEY.md §5.3) to simulate a
+    TPU preemption between sweeps; callers retry fit() to exercise the
+    checkpoint-resume path."""
+
+
 @dataclasses.dataclass
 class Checkpoint:
     arrays: dict[str, np.ndarray]

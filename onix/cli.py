@@ -43,6 +43,10 @@ def build_parser() -> argparse.ArgumentParser:
     p_score.add_argument("--tol", type=float, default=None)
     p_score.add_argument("--max-results", type=int, default=None)
     p_score.add_argument("--engine", choices=("gibbs", "svi"), default="gibbs")
+    p_score.add_argument("--fault-inject", type=int, default=None,
+                         metavar="SWEEP",
+                         help="testing hook: simulate a preemption after "
+                              "this sweep (re-run resumes from checkpoint)")
 
     p_ingest = sub.add_parser(
         "ingest", help="decode and load raw telemetry into the store")
@@ -122,6 +126,9 @@ def main(argv: list[str] | None = None) -> int:
         if args.max_results is not None:
             cfg.pipeline.max_results = args.max_results
         cfg.validate()          # re-check: flags bypass load_config's pass
+        if args.fault_inject is not None:
+            import os
+            os.environ["ONIX_FAULT_SWEEP"] = str(args.fault_inject)
         from onix.pipelines.run import run_scoring
         return run_scoring(cfg, engine=args.engine)
 

@@ -261,13 +261,25 @@ class GibbsLDA:
         )
 
     def fit(self, corpus: Corpus, n_sweeps: int | None = None,
-            callback=None, checkpoint_dir=None, resume: bool = True) -> dict:
+            callback=None, checkpoint_dir=None, resume: bool = True,
+            fault_inject_sweep: int | None = None) -> dict:
         """Run the sweep loop; optionally checkpoint every
         `config.checkpoint_every` sweeps into `checkpoint_dir` and resume
         from the newest matching checkpoint there (SURVEY.md §5.3-5.4:
         resume-on-preemption). Resumed runs are bit-identical to
-        uninterrupted ones — the sweep is a pure function of the state."""
+        uninterrupted ones — the sweep is a pure function of the state.
+
+        `fault_inject_sweep` (or env ONIX_FAULT_SWEEP) simulates a
+        preemption by raising SimulatedPreemption right after completing
+        that sweep — the §5.3 fault-injection hook; a caller that
+        retries `fit` resumes from the last checkpoint."""
+        import os
+
         from onix import checkpoint as ckpt
+
+        if fault_inject_sweep is None:
+            env = os.environ.get("ONIX_FAULT_SWEEP")
+            fault_inject_sweep = int(env) if env else None
 
         cfg = self.config
         n_sweeps = cfg.n_sweeps if n_sweeps is None else n_sweeps
@@ -307,6 +319,10 @@ class GibbsLDA:
                           {k: np.asarray(v)
                            for k, v in state._asdict().items()},
                           {"fingerprint": fp, "engine": "gibbs"})
+            if fault_inject_sweep is not None and s == fault_inject_sweep:
+                raise ckpt.SimulatedPreemption(
+                    f"fault injected after sweep {s} "
+                    f"(checkpoint_dir={checkpoint_dir})")
             if callback is not None or s == n_sweeps - 1 or s % 10 == 9:
                 theta, phi_wk = self._estimates(state)
                 ll = float(self._ll(theta, phi_wk, docs, words, mask))

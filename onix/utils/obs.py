@@ -1,0 +1,103 @@
+"""Observability: profiler trace scopes, run event log, throughput meters.
+
+The reference has no purpose-built tracing or metrics (SURVEY.md §5.1,
+§5.5 — it leaned on the Spark web UI, YARN logs, and lda-c's stdout
+likelihood prints). onix makes the three judged observables first-class:
+
+- `trace_scope(name)` — jax.profiler annotation around the hot loops so
+  a TensorBoard/Perfetto trace of a scoring run shows named Gibbs-sweep
+  and scoring-scan spans; `start_trace(dir)` dumps a full trace when
+  ONIX_PROFILE_DIR (or the call) asks for one.
+- `RunLog` — append-only JSONL event stream per run (stage boundaries,
+  per-sweep likelihood, checkpoint saves, faults) next to the results.
+- `Meter` — wall-clock + items/sec for the events-scored/sec/chip
+  number (BASELINE.json `metric`), reported in the run manifest.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import pathlib
+import time
+
+
+@contextlib.contextmanager
+def trace_scope(name: str):
+    """Named span in the device profile; near-zero cost when no trace is
+    being collected."""
+    import jax.profiler
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+@contextlib.contextmanager
+def maybe_trace(out_dir: str | None = None):
+    """Collect a full profiler trace if `out_dir` or ONIX_PROFILE_DIR is
+    set; otherwise a no-op. View with TensorBoard or Perfetto."""
+    import jax.profiler
+    target = out_dir or os.environ.get("ONIX_PROFILE_DIR")
+    if not target:
+        yield None
+        return
+    pathlib.Path(target).mkdir(parents=True, exist_ok=True)
+    jax.profiler.start_trace(target)
+    try:
+        yield target
+    finally:
+        jax.profiler.stop_trace()
+
+
+class RunLog:
+    """Append-only JSONL event log (SURVEY.md §5.5).
+
+    One line per event: {"t": epoch_s, "event": ..., **fields}. The file
+    is opened per-append so a preempted run loses at most one line.
+    """
+
+    def __init__(self, path: str | pathlib.Path | None):
+        self.path = pathlib.Path(path) if path else None
+        if self.path is not None:
+            self.path.parent.mkdir(parents=True, exist_ok=True)
+
+    def emit(self, event: str, **fields) -> None:
+        if self.path is None:
+            return
+        rec = {"t": round(time.time(), 3), "event": event, **fields}
+        with open(self.path, "a") as fh:
+            fh.write(json.dumps(rec) + "\n")
+
+    @contextlib.contextmanager
+    def stage(self, name: str, **fields):
+        """Log stage start/end (with wall seconds) around a block."""
+        self.emit("stage_start", stage=name, **fields)
+        t0 = time.perf_counter()
+        try:
+            yield
+        except BaseException as e:
+            self.emit("stage_error", stage=name, error=repr(e),
+                      wall_s=round(time.perf_counter() - t0, 3))
+            raise
+        self.emit("stage_end", stage=name,
+                  wall_s=round(time.perf_counter() - t0, 3))
+
+
+class Meter:
+    """items/sec over a wall-clock window (perf_counter based)."""
+
+    def __init__(self):
+        self.t0 = time.perf_counter()
+        self.items = 0
+
+    def add(self, n: int) -> None:
+        self.items += int(n)
+
+    @property
+    def seconds(self) -> float:
+        return time.perf_counter() - self.t0
+
+    @property
+    def rate(self) -> float:
+        dt = self.seconds
+        return self.items / dt if dt > 0 else 0.0
