@@ -127,6 +127,10 @@ def main(argv: list[str] | None = None) -> int:
             cfg.pipeline.max_results = args.max_results
         cfg.validate()          # re-check: flags bypass load_config's pass
         if args.fault_inject is not None:
+            if args.engine != "gibbs":
+                raise SystemExit(
+                    "--fault-inject is only wired to the gibbs engine; "
+                    f"a {args.engine} drill would silently do nothing")
             import os
             os.environ["ONIX_FAULT_SWEEP"] = str(args.fault_inject)
         from onix.pipelines.run import run_scoring

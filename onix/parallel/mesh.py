@@ -25,6 +25,11 @@ from jax.sharding import Mesh
 
 DP_AXIS = "dp"
 MP_AXIS = "mp"
+DCN_AXIS = "dcn"        # across-slice axis (data-center network)
+# Data-parallel collective axes for a multislice mesh: psum over both
+# rides ICI within a slice and DCN across slices; XLA decomposes the
+# collective hierarchically.
+DATA_AXES = (DCN_AXIS, DP_AXIS)
 
 
 def make_mesh(dp: int | None = None, mp: int = 1,
@@ -48,6 +53,43 @@ def make_mesh(dp: int | None = None, mp: int = 1,
         raise ValueError(f"mesh {dp}x{mp} needs {need} devices, have {n}")
     grid = np.asarray(devs[:need]).reshape(dp, mp)
     return Mesh(grid, (DP_AXIS, MP_AXIS))
+
+
+def make_multislice_mesh(dcn: int, dp: int | None = None, mp: int = 1,
+                         devices: list | None = None) -> Mesh:
+    """(dcn, dp, mp) mesh spanning `dcn` slices.
+
+    The reference's 20-node MPI job treats all ranks as one flat ring;
+    on multislice TPU the topology is two-tier — ICI within a slice, DCN
+    between slices (SURVEY.md §2.3) — so the slice axis is explicit and
+    OUTERMOST: psum over (dcn, dp) lets XLA reduce within each slice
+    over ICI first and exchange only the reduced K×V stats over DCN.
+
+    On real multislice hardware, pass `devices` grouped slice-major
+    (jax.devices() already is); for CPU/fake-device tests any ordering
+    works and the axis is purely logical.
+    """
+    devs = devices if devices is not None else jax.devices()
+    n = len(devs)
+    if n % dcn:
+        raise ValueError(f"{n} devices not divisible by dcn={dcn}")
+    per_slice = n // dcn
+    if dp is None:
+        if per_slice % mp:
+            raise ValueError(
+                f"{per_slice} devices/slice not divisible by mp={mp}")
+        dp = per_slice // mp
+    need = dcn * dp * mp
+    if need > n:
+        raise ValueError(f"mesh {dcn}x{dp}x{mp} needs {need} devices, "
+                         f"have {n}")
+    grid = np.asarray(devs[:need]).reshape(dcn, dp, mp)
+    return Mesh(grid, (DCN_AXIS, DP_AXIS, MP_AXIS))
+
+
+def data_axes_of(mesh: Mesh) -> tuple[str, ...]:
+    """The data-parallel axis names present in `mesh` (dcn first)."""
+    return tuple(a for a in DATA_AXES if a in mesh.shape)
 
 
 def multihost_init() -> None:

