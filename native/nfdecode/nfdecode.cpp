@@ -4,18 +4,22 @@
 // The reference carries a patched fork of the nfdump C tool to turn binary
 // netflow captures into text for the flow ingest path (SURVEY.md §3.2:
 // "subprocess: oni-nfdump binary decodes nfcapd → CSV"). onix implements
-// its own decoder for the OPEN protocol — Cisco NetFlow v5 export packets
-// (24-byte header + N×48-byte records, big-endian) — rather than porting
-// nfdump's proprietary internal nfcapd framing. A capture file here is a
-// concatenation of v5 export packets as received off the wire.
+// its own decoder for the OPEN protocols — Cisco NetFlow v5 export
+// packets (24-byte header + N×48-byte records) and template-based
+// NetFlow v9 (RFC 3954: template flowsets announce record layouts, data
+// flowsets carry them) — rather than porting nfdump's proprietary
+// internal nfcapd framing. A capture file here is a concatenation of
+// export packets as received off the wire; v5 and v9 may be mixed.
 //
 // Exposed as a C ABI for ctypes (onix/ingest/nfdecode.py): two-pass
 // (count, then fill caller-allocated SoA arrays — no ownership transfer
-// across the FFI), plus a CLI that streams CSV to stdout.
+// across the FFI; v9 templates learned in pass 1 are re-learned in pass
+// 2, so the passes are independent), plus a CLI that streams CSV.
 
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
+#include <map>
 #include <vector>
 
 namespace {
@@ -53,6 +57,169 @@ size_t parse_header(const uint8_t* p, size_t remaining, PacketView* out) {
   out->sys_uptime_ms = be32(p + 4);
   out->unix_secs = be32(p + 8);
   return need;
+}
+
+// ---------------------------------------------------------------------------
+// NetFlow v9 (RFC 3954)
+// ---------------------------------------------------------------------------
+
+constexpr size_t kV9HeaderLen = 20;
+constexpr uint16_t kV9Version = 9;
+
+// Field types we extract (RFC 3954 §8); everything else is skipped by
+// its declared length.
+enum V9Field : uint16_t {
+  kInBytes = 1,
+  kInPkts = 2,
+  kProtocol = 4,
+  kTcpFlags = 6,
+  kL4SrcPort = 7,
+  kIpv4Src = 8,
+  kL4DstPort = 11,
+  kIpv4Dst = 12,
+  kLastSwitched = 21,
+  kFirstSwitched = 22,
+};
+
+struct V9FieldSpec {
+  uint16_t type;
+  uint16_t len;
+  uint16_t offset;  // byte offset inside one data record
+};
+
+struct V9Template {
+  std::vector<V9FieldSpec> fields;
+  uint16_t record_len = 0;
+};
+
+// Key = (source_id << 16) | template_id; source ids are full 32-bit
+// (RFC 3954 §5.1), so the key must be 64-bit or distinct exporters
+// whose ids share low bits would collide and cross-decode.
+using V9Templates = std::map<uint64_t, V9Template>;
+
+// Read a big-endian unsigned field of 1/2/4/8 bytes (longer fields keep
+// the low 64 bits, like nfdump's sampling of oversized counters).
+uint64_t beN(const uint8_t* p, uint16_t len) {
+  uint64_t v = 0;
+  const uint16_t take = len > 8 ? 8 : len;
+  p += len - take;
+  for (uint16_t i = 0; i < take; ++i) v = (v << 8) | p[i];
+  return v;
+}
+
+struct V9Record {
+  uint32_t sip = 0, dip = 0, dpkts = 0, doctets = 0;
+  uint16_t sport = 0, dport = 0;
+  uint8_t proto = 0, tcp_flags = 0;
+  uint32_t first_ms = 0, last_ms = 0;
+  bool has_first = false, has_last = false;
+};
+
+// Sink receives each decoded record; returns false to abort (capacity).
+template <typename Sink>
+bool parse_v9_packet(const uint8_t* p, size_t pkt_len, V9Templates* tpls,
+                     Sink&& sink) {
+  const uint32_t sys_uptime_ms = be32(p + 4);
+  const uint32_t unix_secs = be32(p + 8);
+  const uint32_t source_id = be32(p + 16);
+  size_t off = kV9HeaderLen;
+  while (off + 4 <= pkt_len) {
+    const uint16_t set_id = be16(p + off);
+    const uint16_t set_len = be16(p + off + 2);
+    if (set_len < 4 || off + set_len > pkt_len) return false;
+    const uint8_t* body = p + off + 4;
+    const size_t body_len = set_len - 4;
+    if (set_id == 0) {  // template flowset (id 1 = options: skipped)
+      size_t t = 0;
+      while (t + 4 <= body_len) {
+        const uint16_t tpl_id = be16(body + t);
+        const uint16_t n_fields = be16(body + t + 2);
+        t += 4;
+        if (tpl_id < 256 || t + (size_t)n_fields * 4 > body_len)
+          return false;
+        V9Template tpl;
+        size_t rec_off = 0;   // size_t: field lengths are attacker data
+        for (uint16_t f = 0; f < n_fields; ++f) {
+          const uint16_t ftype = be16(body + t + f * 4);
+          const uint16_t flen = be16(body + t + f * 4 + 2);
+          // A record longer than a flowset can carry is malformed; the
+          // cap also prevents offset wrap-around (out-of-bounds reads
+          // in the data-record field loop).
+          if (flen == 0 || rec_off + flen > 0xFFFF) return false;
+          tpl.fields.push_back({ftype, flen, (uint16_t)rec_off});
+          rec_off += flen;
+        }
+        tpl.record_len = (uint16_t)rec_off;
+        (*tpls)[((uint64_t)source_id << 16) | tpl_id] = tpl;
+        t += (size_t)n_fields * 4;
+      }
+    } else if (set_id >= 256) {  // data flowset
+      auto it = tpls->find(((uint64_t)source_id << 16) | set_id);
+      if (it != tpls->end() && it->second.record_len > 0) {
+        const V9Template& tpl = it->second;
+        const size_t n_rec = body_len / tpl.record_len;  // tail = padding
+        const double boot =
+            (double)unix_secs - (double)sys_uptime_ms / 1000.0;
+        for (size_t r = 0; r < n_rec; ++r) {
+          const uint8_t* rec = body + r * tpl.record_len;
+          V9Record out;
+          for (const V9FieldSpec& f : tpl.fields) {
+            const uint64_t v = beN(rec + f.offset, f.len);
+            switch (f.type) {
+              case kIpv4Src: out.sip = (uint32_t)v; break;
+              case kIpv4Dst: out.dip = (uint32_t)v; break;
+              case kL4SrcPort: out.sport = (uint16_t)v; break;
+              case kL4DstPort: out.dport = (uint16_t)v; break;
+              case kProtocol: out.proto = (uint8_t)v; break;
+              case kTcpFlags: out.tcp_flags = (uint8_t)v; break;
+              case kInPkts: out.dpkts = (uint32_t)v; break;
+              case kInBytes: out.doctets = (uint32_t)v; break;
+              case kFirstSwitched:
+                out.first_ms = (uint32_t)v;
+                out.has_first = true;
+                break;
+              case kLastSwitched:
+                out.last_ms = (uint32_t)v;
+                out.has_last = true;
+                break;
+              default: break;  // skipped field
+            }
+          }
+          const double t0 = out.has_first
+                                ? boot + (double)out.first_ms / 1000.0
+                                : (double)unix_secs;
+          const double t1 = out.has_last
+                                ? boot + (double)out.last_ms / 1000.0
+                                : (double)unix_secs;
+          if (!sink(out, t0, t1)) return false;
+        }
+      }
+      // Unknown template: records are skipped (nfdump behavior) — the
+      // exporter re-sends templates periodically.
+    }
+    // set_id 1 (options template) and its data fall through: skipped.
+    off += set_len;
+  }
+  return off == pkt_len;
+}
+
+// v9 packets do not carry their own byte length; the header's `count`
+// field is the record/template count, not bytes. Walk the flowsets to
+// find the packet end. The framing is unambiguous: a flowset starts
+// with id 0, 1, or >=256 (2..255 are reserved, RFC 3954 §5.2), so a
+// 16-bit value of 5 or 9 at a flowset boundary can only be the next
+// packet's version marker.
+size_t v9_packet_extent(const uint8_t* p, size_t remaining) {
+  if (remaining < kV9HeaderLen || be16(p) != kV9Version) return 0;
+  size_t off = kV9HeaderLen;
+  while (off + 4 <= remaining) {
+    const uint16_t set_id = be16(p + off);
+    if (set_id == kVersion || set_id == kV9Version) break;  // next packet
+    const uint16_t set_len = be16(p + off + 2);
+    if (set_len < 4 || off + set_len > remaining) return 0;
+    off += set_len;
+  }
+  return off;
 }
 
 }  // namespace
@@ -120,6 +287,97 @@ int64_t nf5_decode(const uint8_t* buf, int64_t len, int64_t n,
   return i;
 }
 
+// Count records in a mixed v5/v9 stream. v9 data flowsets without a
+// known template are skipped (not errors) — matching nfdump; templates
+// learned from earlier packets apply to later ones. Returns -1 on
+// malformed framing.
+int64_t nfx_count(const uint8_t* buf, int64_t len) {
+  if (!buf || len < 0) return -1;
+  int64_t total = 0;
+  size_t off = 0;
+  V9Templates tpls;
+  while (off < (size_t)len) {
+    const uint16_t ver = ((size_t)len - off >= 2) ? be16(buf + off) : 0;
+    if (ver == kVersion) {
+      PacketView pv;
+      const size_t used = parse_header(buf + off, (size_t)len - off, &pv);
+      if (used == 0) return -1;
+      total += pv.count;
+      off += used;
+    } else if (ver == kV9Version) {
+      const size_t used = v9_packet_extent(buf + off, (size_t)len - off);
+      if (used == 0) return -1;
+      bool ok = parse_v9_packet(buf + off, used, &tpls,
+                                [&](const V9Record&, double, double) {
+                                  ++total;
+                                  return true;
+                                });
+      if (!ok) return -1;
+      off += used;
+    } else {
+      return -1;
+    }
+  }
+  return total;
+}
+
+// Decode a mixed v5/v9 stream into caller-allocated arrays of length
+// `n` (from nfx_count). Same output schema as nf5_decode. Returns the
+// number of records written, -1 on error.
+int64_t nfx_decode(const uint8_t* buf, int64_t len, int64_t n,
+                   uint32_t* sip, uint32_t* dip, uint16_t* sport,
+                   uint16_t* dport, uint8_t* proto, uint8_t* tcp_flags,
+                   uint32_t* dpkts, uint32_t* doctets, double* start_ts,
+                   double* end_ts) {
+  if (!buf || !sip || !dip || !sport || !dport || !proto || !tcp_flags ||
+      !dpkts || !doctets || !start_ts || !end_ts)
+    return -1;
+  int64_t i = 0;
+  size_t off = 0;
+  V9Templates tpls;
+  while (off < (size_t)len) {
+    const uint16_t ver = ((size_t)len - off >= 2) ? be16(buf + off) : 0;
+    if (ver == kVersion) {
+      PacketView pv;
+      const size_t used = parse_header(buf + off, (size_t)len - off, &pv);
+      if (used == 0) return -1;
+      const int64_t wrote = nf5_decode(buf + off, (int64_t)used, n - i,
+                                       sip + i, dip + i, sport + i,
+                                       dport + i, proto + i, tcp_flags + i,
+                                       dpkts + i, doctets + i, start_ts + i,
+                                       end_ts + i);
+      if (wrote < 0) return -1;
+      i += wrote;
+      off += used;
+    } else if (ver == kV9Version) {
+      const size_t used = v9_packet_extent(buf + off, (size_t)len - off);
+      if (used == 0) return -1;
+      bool ok = parse_v9_packet(
+          buf + off, used, &tpls,
+          [&](const V9Record& r, double t0, double t1) {
+            if (i >= n) return false;
+            sip[i] = r.sip;
+            dip[i] = r.dip;
+            sport[i] = r.sport;
+            dport[i] = r.dport;
+            proto[i] = r.proto;
+            tcp_flags[i] = r.tcp_flags;
+            dpkts[i] = r.dpkts;
+            doctets[i] = r.doctets;
+            start_ts[i] = t0;
+            end_ts[i] = t1;
+            ++i;
+            return true;
+          });
+      if (!ok) return -1;
+      off += used;
+    } else {
+      return -1;
+    }
+  }
+  return i;
+}
+
 }  // extern "C"
 
 // ---------------------------------------------------------------------------
@@ -149,16 +407,16 @@ int main(int argc, char** argv) {
   }
   std::fclose(f);
 
-  const int64_t n = nf5_count(buf.data(), sz);
+  const int64_t n = nfx_count(buf.data(), sz);
   if (n < 0) {
-    std::fprintf(stderr, "malformed netflow v5 stream\n");
+    std::fprintf(stderr, "malformed netflow v5/v9 stream\n");
     return 1;
   }
   std::vector<uint32_t> sip(n), dip(n), dpkts(n), doctets(n);
   std::vector<uint16_t> sport(n), dport(n);
   std::vector<uint8_t> proto(n), flags(n);
   std::vector<double> t0(n), t1(n);
-  if (nf5_decode(buf.data(), sz, n, sip.data(), dip.data(), sport.data(),
+  if (nfx_decode(buf.data(), sz, n, sip.data(), dip.data(), sport.data(),
                  dport.data(), proto.data(), flags.data(), dpkts.data(),
                  doctets.data(), t0.data(), t1.data()) != n) {
     std::fprintf(stderr, "decode error\n");

@@ -60,6 +60,12 @@ def load_library() -> ctypes.CDLL:
     lib.nf5_decode.restype = ctypes.c_int64
     lib.nf5_decode.argtypes = [u8, ctypes.c_int64, ctypes.c_int64,
                                u32, u32, u16, u16, u8, u8, u32, u32, f64, f64]
+    # Unified mixed v5/v9 entry points (template-based v9, RFC 3954).
+    lib.nfx_count.restype = ctypes.c_int64
+    lib.nfx_count.argtypes = [u8, ctypes.c_int64]
+    lib.nfx_decode.restype = ctypes.c_int64
+    lib.nfx_decode.argtypes = [u8, ctypes.c_int64, ctypes.c_int64,
+                               u32, u32, u16, u16, u8, u8, u32, u32, f64, f64]
     _lib = lib
     return lib
 
@@ -81,13 +87,14 @@ def str_to_ip(strs) -> np.ndarray:
 
 
 def decode_bytes(data: bytes) -> pd.DataFrame:
-    """Decode a v5 packet stream into the ingest flow table."""
+    """Decode a (possibly mixed) v5/v9 packet stream into the ingest
+    flow table."""
     lib = load_library()
     buf = np.frombuffer(data, np.uint8)
     bp = buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
-    n = lib.nf5_count(bp, len(data))
+    n = lib.nfx_count(bp, len(data))
     if n < 0:
-        raise ValueError("malformed netflow v5 stream")
+        raise ValueError("malformed netflow v5/v9 stream")
     arrays = {
         "sip": np.empty(n, np.uint32), "dip": np.empty(n, np.uint32),
         "sport": np.empty(n, np.uint16), "dport": np.empty(n, np.uint16),
@@ -99,7 +106,7 @@ def decode_bytes(data: bytes) -> pd.DataFrame:
     def p(name, ct):
         return arrays[name].ctypes.data_as(ctypes.POINTER(ct))
 
-    wrote = lib.nf5_decode(
+    wrote = lib.nfx_decode(
         bp, len(data), n,
         p("sip", ctypes.c_uint32), p("dip", ctypes.c_uint32),
         p("sport", ctypes.c_uint16), p("dport", ctypes.c_uint16),
@@ -139,25 +146,13 @@ def write_v5(table: pd.DataFrame, *, sys_uptime_ms: int = 3_600_000,
     ports/proto/counters, float start_ts/end_ts epoch seconds) as a
     NetFlow v5 packet stream."""
     n = len(table)
-    sip = table["sip"].to_numpy()
-    if sip.dtype.kind in ("U", "O", "S"):
-        sip = str_to_ip(table["sip"].astype(str))
-        dip = str_to_ip(table["dip"].astype(str))
-    else:
-        sip = sip.astype(np.uint32)
-        dip = table["dip"].to_numpy(np.uint32)
+    sip, dip, proto, flags = _numeric_cols(table)
     sport = table["sport"].to_numpy(np.int64)
     dport = table["dport"].to_numpy(np.int64)
-    proto = table["proto"].to_numpy()
-    if proto.dtype.kind in ("U", "O", "S"):
-        rev = {v: k for k, v in PROTO_NAMES.items()}
-        proto = np.array([rev.get(str(x).upper(), 6) for x in proto], np.int64)
     ipkt = table["ipkt"].to_numpy(np.int64)
     ibyt = table["ibyt"].to_numpy(np.int64)
     start = table["start_ts"].to_numpy(np.float64)
     end = table["end_ts"].to_numpy(np.float64)
-    flags = (table["tcp_flags"].to_numpy(np.int64)
-             if "tcp_flags" in table else np.zeros(n, np.int64))
 
     out = bytearray()
     seq = 0
@@ -182,4 +177,94 @@ def write_v5(table: pd.DataFrame, *, sys_uptime_ms: int = 3_600_000,
                 0, int(flags[i]) & 0xFF, int(proto[i]) & 0xFF, 0,
                 0, 0, 0, 0, 0)
         seq += cnt
+    return bytes(out)
+
+
+# -- v9 packet writer (RFC 3954; round-trip tests + synthetic captures) ----
+
+# (field_type, length) for the template the writer emits. Interleaved
+# with a 2-byte padding field (type 210) so the decoder's skip-by-length
+# path is exercised by every round-trip test.
+_V9_FIELDS = [(8, 4), (12, 4), (7, 2), (11, 2), (4, 1), (6, 1),
+              (210, 2), (2, 4), (1, 4), (22, 4), (21, 4)]
+_V9_TEMPLATE_ID = 300
+
+
+def _numeric_cols(table: pd.DataFrame):
+    n = len(table)
+    sip = table["sip"].to_numpy()
+    if sip.dtype.kind in ("U", "O", "S"):
+        sip = str_to_ip(table["sip"].astype(str))
+        dip = str_to_ip(table["dip"].astype(str))
+    else:
+        sip = sip.astype(np.uint32)
+        dip = table["dip"].to_numpy(np.uint32)
+    proto = table["proto"].to_numpy()
+    if proto.dtype.kind in ("U", "O", "S"):
+        rev = {v: k for k, v in PROTO_NAMES.items()}
+        proto = np.array([rev.get(str(x).upper(), 6) for x in proto], np.int64)
+    flags = (table["tcp_flags"].to_numpy(np.int64)
+             if "tcp_flags" in table else np.zeros(n, np.int64))
+    return sip, dip, proto, flags
+
+
+def write_v9(table: pd.DataFrame, *, sys_uptime_ms: int = 3_600_000,
+             records_per_packet: int = 20, source_id: int = 0,
+             template_every_packet: bool = False) -> bytes:
+    """Encode a flow table as a NetFlow v9 packet stream: a template
+    flowset in the first packet (or every packet), then data flowsets.
+    Same input schema as write_v5."""
+    n = len(table)
+    sip, dip, proto, flags = _numeric_cols(table)
+    sport = table["sport"].to_numpy(np.int64)
+    dport = table["dport"].to_numpy(np.int64)
+    ipkt = table["ipkt"].to_numpy(np.int64)
+    ibyt = table["ibyt"].to_numpy(np.int64)
+    start = table["start_ts"].to_numpy(np.float64)
+    end = table["end_ts"].to_numpy(np.float64)
+
+    tpl_body = struct.pack(">HH", _V9_TEMPLATE_ID, len(_V9_FIELDS))
+    for ftype, flen in _V9_FIELDS:
+        tpl_body += struct.pack(">HH", ftype, flen)
+    tpl_set = struct.pack(">HH", 0, 4 + len(tpl_body)) + tpl_body
+
+    out = bytearray()
+    seq = 0
+    first_packet = True
+    for lo in range(0, max(n, 1), records_per_packet):
+        hi = min(lo + records_per_packet, n)
+        cnt = hi - lo
+        if cnt == 0 and not first_packet:
+            break
+        unix_secs = int(start[lo]) if n else 0
+        boot = unix_secs - sys_uptime_ms / 1000.0
+        recs = bytearray()
+        for i in range(lo, hi):
+            first_ms = max(0, int(round((start[i] - boot) * 1000)))
+            last_ms = max(first_ms, int(round((end[i] - boot) * 1000)))
+            recs += struct.pack(
+                ">IIHHBBHIIII",
+                int(sip[i]), int(dip[i]),
+                int(sport[i]) & 0xFFFF, int(dport[i]) & 0xFFFF,
+                int(proto[i]) & 0xFF, int(flags[i]) & 0xFF,
+                0,                                  # padding field 210
+                int(ipkt[i]) & 0xFFFFFFFF, int(ibyt[i]) & 0xFFFFFFFF,
+                first_ms & 0xFFFFFFFF, last_ms & 0xFFFFFFFF)
+        pad = (-len(recs)) % 4
+        recs += b"\0" * pad
+        data_set = (struct.pack(">HH", _V9_TEMPLATE_ID, 4 + len(recs))
+                    + recs) if cnt else b""
+        sets = b""
+        n_items = cnt
+        if first_packet or template_every_packet:
+            sets += tpl_set
+            n_items += 1
+        sets += data_set
+        out += struct.pack(">HHIIII", 9, n_items, sys_uptime_ms, unix_secs,
+                           seq, source_id)
+        out += sets
+        seq += 1
+        first_packet = False
+        if n == 0:
+            break
     return bytes(out)

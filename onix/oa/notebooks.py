@@ -1,0 +1,107 @@
+"""Analyst scoring-notebook templates — the reference's `ipynb/` dir.
+
+The reference closes its feedback loop through per-datatype IPython
+notebooks served next to the dashboards (SURVEY.md §2.1 #14: "In-
+dashboard notebooks (edge/threat investigation) where the analyst labels
+results"; reference README.md:48,55). onix ships the same artifact:
+generated `.ipynb` templates that load the day's enriched results,
+summarize the top suspects, and write labels through
+`onix.oa.feedback.append_feedback` — the identical CSV contract the
+dashboard's Save button and `onix label` use, so all three label paths
+converge on one noise-filter input.
+
+`onix setup` installs the templates under `<oa.data_dir>/notebooks/`,
+which `onix serve` exposes at `/data/notebooks/` for download into any
+Jupyter instance.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+
+DATATYPES = ("flow", "dns", "proxy")
+
+_CELLS = [
+    ("markdown", """# onix — {datatype} threat investigation
+
+Score a day of surfaced **{datatype}** suspicious connects and feed your
+labels back to the model. Labels: `1` high threat, `2` medium, `3`
+benign — only *benign* labels bias the next run (duplicating a
+confirmed threat would teach the model to stop surfacing it)."""),
+    ("code", """import os
+import pandas as pd
+
+from onix.config import load_config
+from onix.oa.engine import oa_dir
+from onix.oa.feedback import append_feedback
+
+DATATYPE = "{datatype}"
+DATE = os.environ.get("ONIX_DATE", "2016-07-08")
+cfg = load_config(os.environ.get("ONIX_CONFIG") or None)
+
+day = oa_dir(cfg, DATATYPE, DATE)
+results = pd.read_csv(day / "suspicious.csv")
+print(f"{{len(results)}} suspicious {datatype} events for {{DATE}}")"""),
+    ("code", """# The most suspicious events, with enrichment columns.
+results.head(20)"""),
+    ("code", """# Label by dashboard rank, then run this cell to save.
+# Example: ranks 3 and 7 are benign, rank 1 is a confirmed threat.
+labels = {{
+    # rank: label,
+    # 3: 3,
+    # 7: 3,
+    # 1: 1,
+}}
+
+if labels:
+    rows = results[results["rank"].isin(labels)].copy()
+    rows["label"] = rows["rank"].map(labels)
+    path = append_feedback(cfg, DATATYPE, DATE,
+                           rows[["ip", "word", "rank", "score", "label"]])
+    print(f"wrote {{len(rows)}} labels -> {{path}}")
+else:
+    print("no labels staged")"""),
+]
+
+
+def _notebook(datatype: str) -> dict:
+    cells = []
+    for kind, src in _CELLS:
+        text = src.format(datatype=datatype)
+        cells.append({
+            "cell_type": kind,
+            "metadata": {},
+            "source": text.splitlines(keepends=True),
+            **({"outputs": [], "execution_count": None}
+               if kind == "code" else {}),
+        })
+    return {
+        "nbformat": 4,
+        "nbformat_minor": 5,
+        "metadata": {
+            "kernelspec": {"name": "python3", "display_name": "Python 3",
+                           "language": "python"},
+            "language_info": {"name": "python"},
+        },
+        "cells": cells,
+    }
+
+
+def write_notebooks(dest_dir: str | pathlib.Path) -> list[pathlib.Path]:
+    """Materialize the per-datatype templates; returns written paths."""
+    dest = pathlib.Path(dest_dir)
+    dest.mkdir(parents=True, exist_ok=True)
+    out = []
+    for t in DATATYPES:
+        path = dest / f"{t}_threat_investigation.ipynb"
+        path.write_text(json.dumps(_notebook(t), indent=1))
+        out.append(path)
+    return out
+
+
+def code_cells(path: str | pathlib.Path) -> list[str]:
+    """The notebook's code-cell sources (for tests and headless use)."""
+    nb = json.loads(pathlib.Path(path).read_text())
+    return ["".join(c["source"]) for c in nb["cells"]
+            if c["cell_type"] == "code"]

@@ -35,8 +35,13 @@ def run_setup(cfg: OnixConfig) -> int:
             created.append(str(d))
         d.mkdir(parents=True, exist_ok=True)
     cfg.archive(root / "onix.config.json")
+    # Analyst notebook templates (SURVEY.md §2.1 #14) next to the OA
+    # data so `onix serve` exposes them at /data/notebooks/.
+    from onix.oa.notebooks import write_notebooks
+    write_notebooks(pathlib.Path(cfg.oa.data_dir) / "notebooks")
     print(f"onix setup: store at {root} "
-          f"({len(created)} dirs created, config archived)")
+          f"({len(created)} dirs created, config archived, "
+          f"notebooks installed)")
     return 0
 
 

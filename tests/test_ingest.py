@@ -240,3 +240,155 @@ def test_ingested_flow_feeds_scoring_pipeline(tmp_path):
     day = store.read("flow", "2016-07-08")
     wt = flow_words(day)
     assert wt.n_rows == 2 * len(day)
+
+
+# ---------------------------------------------------------------------------
+# NetFlow v9 (RFC 3954) — template-based decode, SURVEY.md §2.1 #2
+# ---------------------------------------------------------------------------
+
+
+@needs_decoder
+def test_v9_roundtrip_exact():
+    table = _synth_flow_arrays(n=57, seed=3)   # partial last packet
+    blob = nfd.write_v9(table)
+    out = nfd.decode_bytes(blob)
+    assert len(out) == 57
+    np.testing.assert_array_equal(nfd.str_to_ip(out["sip"]),
+                                  table["sip"].to_numpy())
+    np.testing.assert_array_equal(nfd.str_to_ip(out["dip"]),
+                                  table["dip"].to_numpy())
+    np.testing.assert_array_equal(out["sport"].to_numpy(np.int64),
+                                  table["sport"].to_numpy())
+    np.testing.assert_array_equal(out["ipkt"].to_numpy(np.int64),
+                                  table["ipkt"].to_numpy())
+    np.testing.assert_array_equal(out["ibyt"].to_numpy(np.int64),
+                                  table["ibyt"].to_numpy())
+    np.testing.assert_array_equal(out["tcp_flags"].to_numpy(np.int64),
+                                  table["tcp_flags"].to_numpy())
+    got = (pd.to_datetime(out["treceived"]).to_numpy()
+           .astype("datetime64[s]").astype(np.int64).astype(np.float64))
+    assert np.abs(got - table["start_ts"].to_numpy()).max() < 1.0
+
+
+@needs_decoder
+def test_v9_template_in_every_packet():
+    table = _synth_flow_arrays(n=40, seed=4)
+    blob = nfd.write_v9(table, template_every_packet=True,
+                        records_per_packet=7)
+    out = nfd.decode_bytes(blob)
+    assert len(out) == 40
+
+
+@needs_decoder
+def test_v9_unknown_template_records_skipped():
+    """Data flowsets arriving before their template are dropped, not
+    errors — exporters re-send templates periodically (nfdump behavior)."""
+    table = _synth_flow_arrays(n=10, seed=5)
+    blob = nfd.write_v9(table, records_per_packet=5)
+    # The template lives in packet 1. Find packet 2's offset and splice
+    # the stream so packet 2 comes first: its 5 records are skipped.
+    ext = nfd.load_library()
+    import ctypes
+    buf = np.frombuffer(blob, np.uint8)
+    # packet 1 extent: header(20) + template set + data set
+    # recompute by decoding incrementally: count on growing prefixes
+    # until it yields 5 (packet 1 only).
+    cut = None
+    for end in range(20, len(blob) + 1):
+        bp = buf[:end].ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+        if ext.nfx_count(bp, end) == 5:
+            cut = end
+            break
+    assert cut is not None
+    spliced = blob[cut:] + blob[:cut]
+    out = nfd.decode_bytes(spliced)
+    # packet 2's records dropped (template unseen), packet 1's survive
+    assert len(out) == 5
+
+
+@needs_decoder
+def test_mixed_v5_v9_stream():
+    t5 = _synth_flow_arrays(n=31, seed=6)
+    t9 = _synth_flow_arrays(n=17, seed=7)
+    blob = nfd.write_v5(t5) + nfd.write_v9(t9)
+    out = nfd.decode_bytes(blob)
+    assert len(out) == 48
+    np.testing.assert_array_equal(
+        nfd.str_to_ip(out["sip"]),
+        np.concatenate([t5["sip"].to_numpy(), t9["sip"].to_numpy()]))
+
+
+@needs_decoder
+def test_v9_truncated_rejected():
+    table = _synth_flow_arrays(n=12, seed=8)
+    blob = nfd.write_v9(table)
+    with pytest.raises(ValueError, match="malformed"):
+        nfd.decode_bytes(blob[:-5])
+
+
+@needs_decoder
+@pytest.mark.slow
+def test_decoder_corruption_fuzz(tmp_path):
+    """Random corruption + truncation never crashes the decoder — it
+    either decodes or reports malformed (SURVEY.md §5.2; run the native
+    suite under `make SANITIZE=1` for the ASan/UBSan version of this)."""
+    import random
+    t9 = _synth_flow_arrays(n=50, seed=1)
+    t5 = _synth_flow_arrays(n=33, seed=2)
+    blob = bytearray(nfd.write_v9(t9, records_per_packet=7) +
+                     nfd.write_v5(t5))
+    random.seed(0)
+    for _ in range(60):
+        b = bytearray(blob)
+        for _ in range(random.randint(1, 8)):
+            b[random.randrange(len(b))] = random.randrange(256)
+        cut = random.randrange(1, len(b))
+        try:
+            out = nfd.decode_bytes(bytes(b[:cut]))
+            assert len(out) >= 0
+        except ValueError:
+            pass    # malformed is the expected failure mode
+
+
+@needs_decoder
+def test_v9_oversized_template_rejected():
+    """Field lengths summing past 64KiB must be rejected, not wrapped —
+    a wrapped record_len would let data records read out of bounds."""
+    import struct
+    tpl_body = struct.pack(">HH", 300, 3)
+    for flen in (30000, 30000, 5544):
+        tpl_body += struct.pack(">HH", 1, flen)
+    tpl_set = struct.pack(">HH", 0, 4 + len(tpl_body)) + tpl_body
+    data_set = struct.pack(">HH", 300, 4 + 8) + b"\0" * 8
+    pkt = struct.pack(">HHIIII", 9, 4, 0, 0, 0, 0) + tpl_set + data_set
+    with pytest.raises(ValueError, match="malformed"):
+        nfd.decode_bytes(pkt)
+
+
+@needs_decoder
+def test_v9_source_ids_do_not_collide():
+    """Templates are keyed by the FULL 32-bit source id: two exporters
+    whose ids share the low 16 bits must not cross-decode."""
+    table = _synth_flow_arrays(n=4, seed=9)
+    a = nfd.write_v9(table, source_id=0x00000001)
+    # exporter B announces NO template; same low bits, different id
+    b_data_only = nfd.write_v9(table, source_id=0x00010001)
+    # strip B's template set so its data records depend on key lookup:
+    # easiest: decode a stream where B's packets come before B's
+    # template would matter — B reuses A's template id but a different
+    # source id, so its records must be SKIPPED, not decoded via A's.
+    import struct
+    # Build B's stream manually without a template flowset.
+    sip, dip, proto, flags = nfd._numeric_cols(table)
+    recs = b""
+    for i in range(len(table)):
+        recs += struct.pack(
+            ">IIHHBBHIIII", int(sip[i]), int(dip[i]),
+            int(table["sport"].iloc[i]), int(table["dport"].iloc[i]),
+            int(proto[i]), int(flags[i]), 0,
+            int(table["ipkt"].iloc[i]), int(table["ibyt"].iloc[i]), 0, 0)
+    data_set = struct.pack(">HH", 300, 4 + len(recs)) + recs
+    b_pkt = struct.pack(">HHIIII", 9, len(table), 0, 0, 0,
+                        0x00010001) + data_set
+    out = nfd.decode_bytes(a + b_pkt)
+    assert len(out) == len(table)       # only A's records decode

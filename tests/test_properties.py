@@ -1,0 +1,107 @@
+"""Property-based tests (SURVEY.md §4.1: "pytest + hypothesis ...
+word-creation functions (bin edges, entropy, TLD parsing)").
+
+These pin the invariants the billion-event word-creation scan relies
+on: bin indices in range, fit/apply determinism, entropy bounds, and
+domain decomposition being a partition of the input name.
+"""
+
+import math
+
+import numpy as np
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+from onix.oa.components import cidr_to_range, ip_to_u32
+from onix.utils.features import (digitize, entropy_array, quantile_edges,
+                                 shannon_entropy, subdomain_split)
+
+settings.register_profile("ci", max_examples=60, deadline=None)
+settings.load_profile("ci")
+
+
+@given(st.text(max_size=64))
+def test_entropy_bounds(s):
+    h = shannon_entropy(s)
+    assert 0.0 <= h <= math.log2(max(len(set(s)), 2)) + 1e-9
+    assert h == 0.0 if len(set(s)) <= 1 else h > 0.0
+
+
+@given(st.lists(st.text(max_size=16), min_size=1, max_size=20))
+def test_entropy_array_matches_scalar(strs):
+    arr = entropy_array(np.asarray(strs, object))
+    want = [shannon_entropy(s) for s in strs]
+    np.testing.assert_allclose(arr, want, rtol=1e-6)
+
+
+@given(st.lists(st.floats(-1e12, 1e12, allow_nan=False), min_size=1,
+                max_size=200),
+       st.integers(2, 10))
+def test_quantile_bins_in_range_and_deterministic(vals, n_bins):
+    v = np.asarray(vals, np.float64)
+    edges = quantile_edges(v, n_bins)
+    # edges are sorted and refitting is deterministic
+    assert (np.diff(edges) >= 0).all()
+    np.testing.assert_array_equal(edges, quantile_edges(v, n_bins))
+    # applying to the fitted data stays within [0, len(edges)]
+    bins = digitize(v, edges)
+    assert bins.min() >= 0
+    assert bins.max() <= len(edges)
+    # applying to arbitrary other data also stays in range
+    other = np.asarray([-np.inf if False else -1e15, 0.0, 1e15])
+    b2 = digitize(other, edges)
+    assert b2.min() >= 0 and b2.max() <= len(edges)
+
+
+@given(st.from_regex(r"[a-z0-9.\-]{0,40}", fullmatch=True))
+def test_subdomain_split_partitions(name):
+    sub, sld, n_labels, _valid = subdomain_split(name)
+    stripped = name.rstrip(".").lower()
+    labels = stripped.split(".") if stripped else []
+    assert n_labels == len(labels)
+    if len(labels) >= 2:
+        # sub + sld are the original labels minus the TLD
+        rebuilt = (sub.split(".") if sub else []) + [sld]
+        assert rebuilt == labels[:-1]
+    elif len(labels) == 1:
+        assert sld == labels[0] and sub == ""
+
+
+@given(st.integers(0, 2**32 - 1))
+def test_ip_u32_roundtrip(ip):
+    s = f"{(ip >> 24) & 255}.{(ip >> 16) & 255}.{(ip >> 8) & 255}.{ip & 255}"
+    assert int(ip_to_u32([s])[0]) == ip
+
+
+@given(st.integers(0, 2**32 - 1), st.integers(0, 32))
+def test_cidr_range_contains_base_and_is_aligned(base, prefix):
+    s = f"{(base >> 24) & 255}.{(base >> 16) & 255}.{(base >> 8) & 255}.{base & 255}"
+    start, end = cidr_to_range(f"{s}/{prefix}")
+    span = 1 << (32 - prefix)
+    assert start <= base <= end
+    assert end - start == span - 1
+    assert start % span == 0
+
+
+@given(st.lists(st.tuples(st.integers(0, 50), st.text("abcde", min_size=1,
+                                                      max_size=3)),
+                min_size=1, max_size=100))
+def test_corpus_build_is_deterministic_partition(pairs):
+    """Vocabulary/doc-key mapping is a bijection onto sorted-unique and
+    the corpus preserves every (ip, word) pair."""
+    from onix.pipelines.corpus_build import build_corpus
+    from onix.pipelines.words import WordTable
+
+    ips = np.asarray([f"10.0.0.{d}" for d, _ in pairs], object)
+    words = np.asarray([w for _, w in pairs], object)
+    wt = WordTable(ip=ips, word=words,
+                   event_idx=np.arange(len(pairs)), edges={})
+    b1 = build_corpus(wt, None, 1)
+    b2 = build_corpus(wt, None, 1)
+    np.testing.assert_array_equal(b1.corpus.doc_ids, b2.corpus.doc_ids)
+    np.testing.assert_array_equal(b1.vocab.words, b2.vocab.words)
+    # round-trip: every token maps back to its original (ip, word)
+    got_ips = b1.doc_keys[b1.corpus.doc_ids]
+    got_words = b1.vocab.words[b1.corpus.word_ids]
+    np.testing.assert_array_equal(got_ips, ips)
+    np.testing.assert_array_equal(got_words, words)
