@@ -114,6 +114,14 @@ def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
     cfg = load_config(args.config, args.overrides)
 
+    if args.command in ("score", "stream", "demo"):
+        # Device-touching commands: persist compiled programs so daily
+        # runs never re-pay cold-compile (obs.enable_compile_cache).
+        from onix.utils.obs import enable_compile_cache
+        import pathlib
+        enable_compile_cache(
+            pathlib.Path(cfg.store.checkpoint_dir) / "jax_cache")
+
     if args.command == "config":
         print(cfg.to_json())
         return 0

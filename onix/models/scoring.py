@@ -150,14 +150,37 @@ def top_suspicious(
 
 _score_events_jit = jax.jit(score_events)
 
+# Dedup pays once the device scan shrinks enough to cover the host-side
+# np.unique sort; real telemetry is Zipf over (ip, word) pairs, so the
+# unique-pair count is typically a small fraction of the event count
+# (docs/PERF.md lever #1). Uniform-random data dedups to ~nothing and
+# takes the direct path.
+_DEDUP_THRESHOLD = 0.7
 
-def score_all(theta, phi_wk, doc_ids, word_ids, chunk: int = 1 << 22) -> np.ndarray:
-    """Score every event, chunked on host to bound device memory."""
+
+def score_all(theta, phi_wk, doc_ids, word_ids, chunk: int = 1 << 22,
+              dedup: bool = True) -> np.ndarray:
+    """Score every event, chunked on host to bound device memory.
+
+    With `dedup`, duplicate (doc, word) pairs are scored once on device
+    and broadcast back through the inverse index — same scores
+    bit-for-bit (scoring is a pure function of the pair)."""
     doc_ids = np.asarray(doc_ids)
     word_ids = np.asarray(word_ids)
-    out = np.empty(doc_ids.shape[0], np.float32)
-    for lo in range(0, doc_ids.shape[0], chunk):
-        hi = min(lo + chunk, doc_ids.shape[0])
+    n = doc_ids.shape[0]
+    if dedup and n:
+        n_vocab = int(np.asarray(phi_wk).shape[-2])
+        key = doc_ids.astype(np.int64) * n_vocab + word_ids
+        uniq, inv = np.unique(key, return_inverse=True)
+        if uniq.shape[0] <= _DEDUP_THRESHOLD * n:
+            pair_scores = score_all(
+                theta, phi_wk, (uniq // n_vocab).astype(doc_ids.dtype),
+                (uniq % n_vocab).astype(word_ids.dtype), chunk=chunk,
+                dedup=False)
+            return pair_scores[inv]
+    out = np.empty(n, np.float32)
+    for lo in range(0, n, chunk):
+        hi = min(lo + chunk, n)
         out[lo:hi] = np.asarray(_score_events_jit(theta, phi_wk,
                                                   jnp.asarray(doc_ids[lo:hi]),
                                                   jnp.asarray(word_ids[lo:hi])))

@@ -14,12 +14,11 @@ import json
 import pathlib
 import time
 
-import jax.numpy as jnp
 import numpy as np
 import pandas as pd
 
 from onix.config import OnixConfig
-from onix.models.scoring import bottom_k, score_all
+from onix.models.scoring import score_all
 from onix.pipelines.corpus_build import CorpusBundle, build_corpus, event_scores
 from onix.pipelines.words import WORD_FNS
 from onix.store import Store, feedback_path, results_path
@@ -162,21 +161,22 @@ def run_scoring(cfg: OnixConfig, engine: str = "gibbs",
         ev_scores = event_scores(bundle, tok_scores, n_events)
 
         # Filter < TOL, ascending, top MAXRESULTS (SURVEY.md §3.1
-        # POST-LDA) — through the fused device selection scan, the same
-        # path the 1B-event benchmark exercises.
-        # bottom_k pads and sentinels unfilled slots itself, so
-        # max_results needs no clamping to n_events (and an empty day
-        # yields an empty CSV).
-        sel = bottom_k(jnp.asarray(ev_scores.astype(np.float32)),
-                       tol=cfg.pipeline.tol,
-                       max_results=cfg.pipeline.max_results)
-        sel_idx = np.asarray(sel.indices)
+        # POST-LDA). Event scores are already host-side here, so select
+        # with argpartition: the fused device scan (scoring.bottom_k /
+        # top_suspicious — the 1B-event benchmark path) pays a ~25s
+        # cold compile through the device tunnel for zero benefit when
+        # the array is already on the host.
+        cand = np.flatnonzero(ev_scores < cfg.pipeline.tol)
+        if cand.size > cfg.pipeline.max_results:
+            part = np.argpartition(ev_scores[cand],
+                                   cfg.pipeline.max_results - 1)
+            cand = cand[part[:cfg.pipeline.max_results]]
+        top = cand[np.argsort(ev_scores[cand], kind="stable")]
         meter.add(n_events)
     # Snapshot now: the judged events/sec must not absorb the result-
     # frame assembly and CSV write below.
     scoring_seconds = meter.seconds
     events_per_sec = meter.items / scoring_seconds if scoring_seconds else 0.0
-    top = sel_idx[sel_idx >= 0]
 
     results = table.iloc[top].copy()
     results.insert(0, "score", ev_scores[top])

@@ -23,6 +23,17 @@ import pathlib
 import time
 
 
+def enable_compile_cache(cache_dir: str | pathlib.Path) -> None:
+    """Persistent XLA compilation cache. First compiles through the
+    device tunnel cost 5-30s per program; caching them on disk makes
+    every later cold process warm-start (safe to call repeatedly)."""
+    import jax
+    path = pathlib.Path(cache_dir)
+    path.mkdir(parents=True, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", str(path))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+
 @contextlib.contextmanager
 def trace_scope(name: str):
     """Named span in the device profile; near-zero cost when no trace is

@@ -98,3 +98,28 @@ def test_bottom_k_fewer_qualifying_than_k():
     scores = jnp.asarray(np.array([0.5, 0.1, 0.9, 0.2], np.float32))
     out = bottom_k(scores, tol=0.3, max_results=4, chunk=2)
     np.testing.assert_array_equal(np.asarray(out.indices), [1, 3, -1, -1])
+
+
+def test_score_all_dedup_matches_direct():
+    """Deduped scoring is bit-identical to the direct scan — duplicates
+    share the same pure pair score (docs/PERF.md lever #1)."""
+    import jax.numpy as jnp
+
+    from onix.models.scoring import score_all
+
+    rng = np.random.default_rng(0)
+    d_docs, v, k = 50, 40, 5
+    theta = rng.dirichlet(np.full(k, 0.5), size=d_docs).astype(np.float32)
+    phi = rng.dirichlet(np.full(k, 0.5), size=v).astype(np.float32)
+    # Zipf-ish: heavy duplication of a few pairs
+    d = rng.choice(8, 5000).astype(np.int32)
+    w = rng.choice(6, 5000).astype(np.int32)
+    got = score_all(theta, phi, d, w, dedup=True)
+    want = score_all(theta, phi, d, w, dedup=False)
+    np.testing.assert_array_equal(got, want)
+    # multi-chain estimates flow through the dedup path too
+    theta3 = np.stack([theta, theta[::-1]])
+    phi3 = np.stack([phi, phi[::-1]])
+    got3 = score_all(theta3, phi3, d, w, dedup=True)
+    want3 = score_all(theta3, phi3, d, w, dedup=False)
+    np.testing.assert_array_equal(got3, want3)
