@@ -42,7 +42,12 @@ def build_parser() -> argparse.ArgumentParser:
     p_score.add_argument("datatype", choices=("flow", "dns", "proxy"))
     p_score.add_argument("--tol", type=float, default=None)
     p_score.add_argument("--max-results", type=int, default=None)
-    p_score.add_argument("--engine", choices=("gibbs", "svi"), default="gibbs")
+    p_score.add_argument("--engine", choices=("gibbs", "svi", "sharded"),
+                         default="gibbs",
+                         help="gibbs: single-device batched collapsed "
+                              "Gibbs; svi: online VB; sharded: multi-"
+                              "chip doc/vocab-sharded Gibbs over the "
+                              "mesh.dp x mesh.mp mesh")
     p_score.add_argument("--fault-inject", type=int, default=None,
                          metavar="SWEEP",
                          help="testing hook: simulate a preemption after "

@@ -64,17 +64,29 @@ def init_state_keyed(
     n_vocab: int,
     n_topics: int,
 ) -> GibbsState:
-    """Random topic init + exact count build via one scatter pass."""
+    """Random topic init + exact count build, blockwise.
+
+    Counts are scattered one token block at a time under `lax.scan`: a
+    flat one-hot over the whole corpus would materialize an
+    [N, K]-padded temp that OOMs HBM past ~10M tokens (hit at 40M)."""
     key, zkey = jax.random.split(key)
     shape = doc_blocks.shape
     z = jax.random.randint(zkey, shape, 0, n_topics, dtype=jnp.int32)
     z = jnp.where(mask_blocks > 0, z, n_topics)   # sentinel for padding
-    flat_oh = _one_hot(z, n_topics).reshape(-1, n_topics)
-    n_dk = jnp.zeros((n_docs, n_topics), jnp.int32).at[
-        doc_blocks.reshape(-1)].add(flat_oh)
-    n_wk = jnp.zeros((n_vocab, n_topics), jnp.int32).at[
-        word_blocks.reshape(-1)].add(flat_oh)
-    n_k = flat_oh.sum(axis=0, dtype=jnp.int32)
+
+    def count_block(carry, xs):
+        n_dk, n_wk, n_k = carry
+        d, w, zb = xs
+        oh = _one_hot(zb, n_topics)               # [B, K]; padding -> 0
+        return (n_dk.at[d].add(oh), n_wk.at[w].add(oh),
+                n_k + oh.sum(axis=0, dtype=jnp.int32)), None
+
+    (n_dk, n_wk, n_k), _ = jax.lax.scan(
+        count_block,
+        (jnp.zeros((n_docs, n_topics), jnp.int32),
+         jnp.zeros((n_vocab, n_topics), jnp.int32),
+         jnp.zeros((n_topics,), jnp.int32)),
+        (doc_blocks, word_blocks, z))
     return GibbsState(
         z=z, n_dk=n_dk, n_wk=n_wk, n_k=n_k, key=key,
         acc_ndk=jnp.zeros((n_docs, n_topics), jnp.float32),
@@ -202,10 +214,19 @@ def log_likelihood(
     doc_blocks: jax.Array, word_blocks: jax.Array, mask_blocks: jax.Array,
 ) -> jax.Array:
     """Mean per-token log p(w|d) — the convergence series the reference
-    prints to likelihood.dat (SURVEY.md §5.4)."""
-    p = jnp.sum(theta[doc_blocks] * phi_wk[word_blocks], axis=-1)
-    lp = jnp.log(jnp.maximum(p, 1e-30)) * mask_blocks
-    return lp.sum() / jnp.maximum(mask_blocks.sum(), 1.0)
+    prints to likelihood.dat (SURVEY.md §5.4). Accumulated block by
+    block: gathering theta/phi rows for the whole corpus at once
+    materializes an [N, K]-padded temp that OOMs HBM past ~10M tokens."""
+    def block(carry, xs):
+        d, w, m = xs
+        p = jnp.sum(theta[d] * phi_wk[w], axis=-1)
+        lp = jnp.log(jnp.maximum(p, 1e-30)) * m
+        return (carry[0] + lp.sum(), carry[1] + m.sum()), None
+
+    (total, n), _ = jax.lax.scan(
+        block, (jnp.float32(0.0), jnp.float32(0.0)),
+        (doc_blocks, word_blocks, mask_blocks))
+    return total / jnp.maximum(n, 1.0)
 
 
 class GibbsLDA:
