@@ -134,6 +134,9 @@ bool parse_v9_packet(const uint8_t* p, size_t pkt_len, V9Templates* tpls,
       while (t + 4 <= body_len) {
         const uint16_t tpl_id = be16(body + t);
         const uint16_t n_fields = be16(body + t + 2);
+        // RFC 3954 §5.2 permits trailing zero padding inside a template
+        // flowset; an all-zero "header" is padding, not a template.
+        if (tpl_id == 0 && n_fields == 0) break;
         t += 4;
         if (tpl_id < 256 || t + (size_t)n_fields * 4 > body_len)
           return false;

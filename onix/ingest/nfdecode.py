@@ -210,10 +210,15 @@ def _numeric_cols(table: pd.DataFrame):
 
 def write_v9(table: pd.DataFrame, *, sys_uptime_ms: int = 3_600_000,
              records_per_packet: int = 20, source_id: int = 0,
-             template_every_packet: bool = False) -> bytes:
+             template_every_packet: bool = False,
+             pad_template_flowset: bool = False) -> bytes:
     """Encode a flow table as a NetFlow v9 packet stream: a template
     flowset in the first packet (or every packet), then data flowsets.
-    Same input schema as write_v5."""
+    Same input schema as write_v5.
+
+    pad_template_flowset appends RFC 3954 §5.2 zero padding after the
+    template — real exporters do this; the decoder must treat it as
+    padding, not as a malformed template header."""
     n = len(table)
     sip, dip, proto, flags = _numeric_cols(table)
     sport = table["sport"].to_numpy(np.int64)
@@ -226,6 +231,8 @@ def write_v9(table: pd.DataFrame, *, sys_uptime_ms: int = 3_600_000,
     tpl_body = struct.pack(">HH", _V9_TEMPLATE_ID, len(_V9_FIELDS))
     for ftype, flen in _V9_FIELDS:
         tpl_body += struct.pack(">HH", ftype, flen)
+    if pad_template_flowset:
+        tpl_body += b"\0" * 4
     tpl_set = struct.pack(">HH", 0, 4 + len(tpl_body)) + tpl_body
 
     out = bytearray()

@@ -41,11 +41,21 @@ def ip_to_u32(ips) -> np.ndarray:
 
 
 def cidr_to_range(cidr: str) -> tuple[int, int]:
-    """'10.0.0.0/8' -> (start, end) inclusive uint32 bounds."""
+    """'10.0.0.0/8' -> (start, end) inclusive uint32 bounds.
+
+    Raises on a malformed network part: ip_to_u32's lenient invalid→0
+    mapping is right for event enrichment, but a bad *database* row would
+    silently claim address space based at 0.0.0.0 and mislabel unrelated
+    IPs — fail loudly at load time instead.
+    """
     net, _, bits = cidr.partition("/")
     prefix = int(bits) if bits else 32
     if not 0 <= prefix <= 32:
         raise ValueError(f"bad prefix in {cidr!r}")
+    parts = net.split(".")
+    if (len(parts) != 4
+            or not all(p.isdigit() and int(p) <= 255 for p in parts)):
+        raise ValueError(f"bad network address in {cidr!r}")
     base = int(ip_to_u32([net])[0])
     span = 1 << (32 - prefix)
     start = base & ~(span - 1) & 0xFFFFFFFF

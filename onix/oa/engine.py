@@ -151,13 +151,22 @@ def _summary(df: pd.DataFrame, datatype: str, date: str,
 
 
 def _update_dates_index(base: pathlib.Path, date: str) -> None:
+    # flock the read-modify-write: two concurrent `onix oa` runs for
+    # different dates of the same datatype must not drop each other's
+    # entry from the picker index. The final write is tmp+rename so the
+    # (lockless) HTTP GET path never observes a truncated file.
+    from onix.oa.feedback import locked
+
     y, mo, d = parse_date(date)
     idx_path = base / "dates.json"
-    dates = set()
-    if idx_path.exists():
-        dates = set(json.loads(idx_path.read_text()))
-    dates.add(f"{y}-{mo}-{d}")
-    idx_path.write_text(json.dumps(sorted(dates)))
+    with locked(idx_path):
+        dates = set()
+        if idx_path.exists():
+            dates = set(json.loads(idx_path.read_text()))
+        dates.add(f"{y}-{mo}-{d}")
+        tmp = idx_path.with_suffix(".json.tmp")
+        tmp.write_text(json.dumps(sorted(dates)))
+        tmp.replace(idx_path)
 
 
 def run_oa(cfg: OnixConfig, date: str, datatype: str) -> int:

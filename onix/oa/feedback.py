@@ -25,7 +25,7 @@ VALID_LABELS = (1, 2, 3)        # 1 high threat, 2 medium, 3 benign
 
 
 @contextlib.contextmanager
-def _locked(path: pathlib.Path):
+def locked(path: pathlib.Path):
     """Advisory exclusive lock on a sidecar file — serializes the
     read-modify-write across the threaded serve handlers AND a
     concurrently-running `onix label` process."""
@@ -63,7 +63,7 @@ def append_feedback(cfg: OnixConfig, datatype: str, date: str,
 
     path = feedback_path(cfg.store.feedback_dir, datatype, date)
     path.parent.mkdir(parents=True, exist_ok=True)
-    with _locked(path):
+    with locked(path):
         if path.exists():
             old = pd.read_csv(path, dtype=str)
             rows = pd.concat([old, rows.astype(str)], ignore_index=True)

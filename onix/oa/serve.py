@@ -87,9 +87,41 @@ class OAHandler(SimpleHTTPRequestHandler):
         self.send_header("Content-Length", str(target.stat().st_size))
         self.end_headers()
 
+    def _reject_cross_site(self) -> bool:
+        """CSRF guard for /feedback (model-poisoning vector: a benign
+        label injected cross-site gets duplicated ×DUPFACTOR by the next
+        run). The server binds localhost, but any web page the analyst
+        visits can still fire a no-preflight POST at it — so require a
+        same-origin Origin (when the browser sends one), a Host matching
+        the bound address, and an application/json Content-Type (which
+        forces a CORS preflight for cross-site senders)."""
+        host = self.headers.get("Host", "")
+        origin = self.headers.get("Origin")
+        ctype = self.headers.get("Content-Type", "")
+        if ctype.split(";", 1)[0].strip().lower() != "application/json":
+            self.send_error(415, "Content-Type must be application/json")
+            return True
+        if origin is not None and origin != f"http://{host}":
+            self.send_error(403, "cross-origin feedback rejected")
+            return True
+        # DNS rebinding needs an attacker-controlled DNS *name* resolving
+        # to this server — so accept IP-literal Hosts (any bind address,
+        # e.g. `onix serve --host 0.0.0.0` reached as http://10.1.2.3:8889)
+        # and localhost/the bound name, reject other DNS names.
+        hostname = host.rsplit(":", 1)[0] if ":" in host else host
+        is_ip_literal = (hostname.startswith("[")          # IPv6
+                         or hostname.replace(".", "").isdigit())
+        if not is_ip_literal and hostname not in (
+                "localhost", self.server.server_name):
+            self.send_error(403, "unexpected Host header")
+            return True
+        return False
+
     def do_POST(self):
         if self.path.split("?", 1)[0] != "/feedback":
             self.send_error(404)
+            return
+        if self._reject_cross_site():
             return
         try:
             n = int(self.headers.get("Content-Length", 0))

@@ -158,7 +158,9 @@ function renderTable(rows, date) {
       });
       document.getElementById("save").disabled = labels.size === 0;
     });
-    tr.append(el("td")).lastChild.append(sel);
+    const labelTd = el("td");
+    labelTd.append(sel);
+    tr.append(labelTd);
     tbody.append(tr);
   }
   const table = document.getElementById("sus-table");

@@ -280,6 +280,19 @@ def test_v9_template_in_every_packet():
 
 
 @needs_decoder
+def test_v9_padded_template_flowset():
+    """RFC 3954 §5.2: trailing zero padding in a template flowset is
+    legal; it must decode as padding, not a malformed template header."""
+    table = _synth_flow_arrays(n=23, seed=11)
+    blob = nfd.write_v9(table, pad_template_flowset=True,
+                        records_per_packet=9)
+    out = nfd.decode_bytes(blob)
+    assert len(out) == 23
+    np.testing.assert_array_equal(nfd.str_to_ip(out["sip"]),
+                                  table["sip"].to_numpy())
+
+
+@needs_decoder
 def test_v9_unknown_template_records_skipped():
     """Data flowsets arriving before their template are dropped, not
     errors — exporters re-send templates periodically (nfdump behavior)."""

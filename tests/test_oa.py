@@ -40,6 +40,22 @@ def test_cidr_to_range():
     assert end == start + 0xFFFF
 
 
+def test_cidr_to_range_rejects_malformed_network():
+    """A bad DB row must fail loudly, not claim space based at 0.0.0.0."""
+    for bad in ("bogus/8", "1.2.3.999/24", "1.2.3/8", "", "10.0.0.0/33"):
+        with pytest.raises(ValueError):
+            cidr_to_range(bad)
+
+
+def test_geoip_load_rejects_malformed_rows(tmp_path):
+    db_csv = tmp_path / "geo.csv"
+    db_csv.write_text(
+        "network,country,city,latitude,longitude,isp\n"
+        "not-an-ip/24,XX,Nowhere,0,0,BadNet\n")
+    with pytest.raises(ValueError, match="network"):
+        GeoIPDB.load(db_csv)
+
+
 def test_geoip_builtin_and_custom(tmp_path):
     db_csv = tmp_path / "geo.csv"
     db_csv.write_text(

@@ -192,6 +192,60 @@ def test_concurrent_feedback_writes_do_not_lose_labels(cfg):
     assert len(got) == 16
 
 
+def test_feedback_rejects_cross_site(cfg):
+    """CSRF guard: a web page the analyst visits must not be able to
+    inject benign labels (model-poisoning via the ×DUPFACTOR path)."""
+    _seed_oa_output(cfg)
+    server, port = serve_background(cfg)
+    payload = json.dumps({
+        "datatype": "flow", "date": "2016-07-08",
+        "rows": [{"ip": "10.0.0.9", "word": "w9", "rank": 1,
+                  "score": 1e-4, "label": 3}]}).encode()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+
+        def post(headers):
+            conn.request("POST", "/feedback", body=payload, headers=headers)
+            r = conn.getresponse()
+            r.read()
+            return r.status
+
+        # no-preflight content type (form/fetch text-plain) -> 415
+        assert post({"Content-Type": "text/plain"}) == 415
+        assert post({}) == 415
+        # cross-origin browser POST -> 403
+        assert post({"Content-Type": "application/json",
+                     "Origin": "http://evil.example"}) == 403
+        # DNS-rebinding shape: foreign Host header -> 403
+        conn2 = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+        conn2.putrequest("POST", "/feedback", skip_host=True)
+        conn2.putheader("Host", "evil.example")
+        conn2.putheader("Content-Type", "application/json")
+        conn2.putheader("Content-Length", str(len(payload)))
+        conn2.endheaders()
+        conn2.send(payload)
+        assert conn2.getresponse().status == 403
+        # non-loopback IP-literal Host (e.g. --host 0.0.0.0 reached by
+        # LAN IP) is fine — rebinding needs a DNS name, not an IP
+        conn3 = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+        conn3.putrequest("POST", "/feedback", skip_host=True)
+        conn3.putheader("Host", "10.1.2.3:8889")
+        conn3.putheader("Content-Type", "application/json")
+        conn3.putheader("Content-Length", str(len(payload)))
+        conn3.endheaders()
+        conn3.send(payload)
+        assert conn3.getresponse().status == 200
+        # same-origin with explicit Origin -> accepted
+        assert post({"Content-Type": "application/json",
+                     "Origin": f"http://127.0.0.1:{port}"}) == 200
+        fb = pd.read_csv(feedback_path(cfg.store.feedback_dir, "flow",
+                                       "2016-07-08"))
+        assert fb["ip"].tolist() == ["10.0.0.9"]
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
 def test_serve_head_and_malformed_post(cfg):
     _seed_oa_output(cfg)
     server, port = serve_background(cfg)

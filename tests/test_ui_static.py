@@ -1,0 +1,60 @@
+"""Static invariants of the analyst dashboard JS/HTML.
+
+There is no JS engine in this image (no node, no jsdom, no embeddable
+engine), so onix.js cannot be *executed* under pytest. These checks pin
+the contract between onix.js and the HTML/served data instead — they
+would have caught the round-1 renderTable crash class (a DOM-API misuse
+pattern) and catch drift between the JS and the pages/server.
+"""
+
+import re
+
+from onix.oa.serve import UI_ROOT
+
+JS = (UI_ROOT / "onix.js").read_text()
+PAGES = {rel: (UI_ROOT / rel).read_text()
+         for rel in ("index.html", "flow/suspicious.html",
+                     "dns/suspicious.html", "proxy/suspicious.html")}
+DASHBOARDS = {k: v for k, v in PAGES.items() if k != "index.html"}
+
+
+def test_no_append_chain_on_undefined():
+    """ParentNode.append() returns undefined — chaining off it (the
+    round-1 `tr.append(el("td")).lastChild` crash) is banned."""
+    assert not re.search(r"\.append\([^)]*\)\s*\.", JS)
+    # same class of bug: appendChild returns the child, but chaining
+    # .lastChild off append is always wrong
+    assert ".lastChild" not in JS
+
+
+def test_every_dom_id_exists_in_dashboard_pages():
+    ids = set(re.findall(r'getElementById\("([^"]+)"\)', JS))
+    assert ids, "expected getElementById uses in onix.js"
+    for rel, html in DASHBOARDS.items():
+        present = set(re.findall(r'id="([^"]+)"', html))
+        missing = ids - present
+        assert not missing, f"{rel} missing ids used by onix.js: {missing}"
+
+
+def test_datatype_columns_cover_all_dashboards():
+    cols = set(re.findall(r"^\s+(flow|dns|proxy):", JS, re.M))
+    assert cols == {"flow", "dns", "proxy"}
+    for rel, html in DASHBOARDS.items():
+        t = rel.split("/")[0]
+        assert f'ONIX_TYPE = "{t}"' in html
+
+
+def test_js_endpoints_match_server_contract():
+    # every fetched URL shape must be one the server actually mounts
+    assert "/feedback" in JS
+    # dir-relative fetches must come from a `dir` rooted under /data/
+    assert re.search(r'const dir = `/data/\$\{TYPE\}', JS)
+    for path in re.findall(r'getJSON\(`([^`]+)`\)', JS):
+        assert path.startswith(("/data/", "${dir}/")), path
+
+
+def test_js_braces_and_parens_balanced():
+    """Cheap parse-health check: unbalanced delimiters mean a syntax
+    error that would kill the whole dashboard silently."""
+    for open_c, close_c in ("{}", "()", "[]"):
+        assert JS.count(open_c) == JS.count(close_c), (open_c, close_c)
