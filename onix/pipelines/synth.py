@@ -129,6 +129,94 @@ def synth_flow_day(n_events: int = 20000, n_hosts: int = 120,
     return _shuffle(table, n_bg, n_events, rng)
 
 
+FLOW_PROTO_CLASSES = ["ICMP", "TCP", "UDP"]    # id table for numeric path
+
+
+def synth_flow_day_arrays(n_events: int, n_hosts: int = 100_000,
+                          n_anomalies: int | None = None, seed: int = 0,
+                          chunk: int = 10_000_000) -> dict:
+    """Columnar NUMERIC flow day for the 10⁸–10⁹-row configs
+    (BASELINE.json configs[3]): same role-mixture background and
+    exfil-shaped anomalies as `synth_flow_day`, but zero Python-object
+    columns — uint32 IPs, small-int ports/protocols, float hours —
+    generated in chunks so peak memory stays bounded.
+
+    Returns a dict of arrays (sip_u32, dip_u32, sport, dport, proto_id,
+    hour, ipkt, ibyt, anomaly_idx, proto_classes). Rows are NOT shuffled
+    (background first, anomalies last — `anomaly_idx` says where); the
+    Gibbs engine shuffles tokens itself and the corpus build is
+    order-insensitive.
+    """
+    if n_anomalies is None:
+        n_anomalies = max(30, n_events // 10_000)
+    rng = np.random.default_rng(seed)
+    n_prof = len(_FLOW_PROFILES)
+    mix_cum = _host_mixture(rng, n_hosts, n_prof).cumsum(axis=1)
+    mix_cum = mix_cum.astype(np.float32)
+
+    proto_of = np.array([FLOW_PROTO_CLASSES.index(p[1])
+                         for p in _FLOW_PROFILES], np.int8)
+    dport_of = np.array([p[0] for p in _FLOW_PROFILES], np.int32)
+    peak_of = np.array([p[2] for p in _FLOW_PROFILES], np.float32)
+    hsd_of = np.array([p[3] for p in _FLOW_PROFILES], np.float32)
+    lpkt_of = np.array([p[4] for p in _FLOW_PROFILES], np.float32)
+    lbpp_of = np.array([p[5] for p in _FLOW_PROFILES], np.float32)
+    # 10.x.y.z host space; 192.168.p.i per-profile server pools.
+    host_base = np.uint32(10 << 24)
+    srv_base = np.uint32((192 << 24) | (168 << 16))
+
+    n_bg = n_events - n_anomalies
+    out = {
+        "sip_u32": np.empty(n_events, np.uint32),
+        "dip_u32": np.empty(n_events, np.uint32),
+        "sport": np.empty(n_events, np.int32),
+        "dport": np.empty(n_events, np.int32),
+        "proto_id": np.empty(n_events, np.int8),
+        "hour": np.empty(n_events, np.float32),
+        "ipkt": np.empty(n_events, np.int64),
+        "ibyt": np.empty(n_events, np.int64),
+    }
+    for lo in range(0, n_bg, chunk):
+        hi = min(lo + chunk, n_bg)
+        m = hi - lo
+        h_idx = rng.integers(0, n_hosts, m)
+        u = rng.random(m, np.float32)
+        prof = (mix_cum[h_idx] < u[:, None]).sum(axis=1)
+        prof = np.clip(prof, 0, n_prof - 1)
+        out["sip_u32"][lo:hi] = host_base + h_idx.astype(np.uint32)
+        out["dip_u32"][lo:hi] = (srv_base
+                                 + (prof.astype(np.uint32) << 8)
+                                 + rng.integers(1, 5, m).astype(np.uint32))
+        out["sport"][lo:hi] = rng.integers(1025, 65535, m)
+        out["dport"][lo:hi] = dport_of[prof]
+        out["proto_id"][lo:hi] = proto_of[prof]
+        out["hour"][lo:hi] = np.clip(
+            rng.normal(peak_of[prof], hsd_of[prof]), 0, 23.99)
+        ipkt = np.exp(rng.normal(lpkt_of[prof], 0.6)).astype(np.int64) + 1
+        bpp = np.exp(rng.normal(lbpp_of[prof], 0.3)).astype(np.int64) + 40
+        out["ipkt"][lo:hi] = ipkt
+        out["ibyt"][lo:hi] = ipkt * bpp
+
+    # Anomalies: exfil-shaped (ephemeral↔ephemeral, rare external peers,
+    # off-hours, outsized transfers) — same recipe as synth_flow_day.
+    a = slice(n_bg, n_events)
+    out["sip_u32"][a] = host_base + rng.integers(
+        0, n_hosts, n_anomalies).astype(np.uint32)
+    out["dip_u32"][a] = ((np.uint32(203 << 24))
+                         + (rng.integers(0, 16, n_anomalies) << 8).astype(np.uint32)
+                         + rng.integers(1, 255, n_anomalies).astype(np.uint32))
+    out["sport"][a] = rng.integers(1025, 65535, n_anomalies)
+    out["dport"][a] = rng.integers(31337, 65535, n_anomalies)
+    out["proto_id"][a] = FLOW_PROTO_CLASSES.index("TCP")
+    out["hour"][a] = rng.uniform(0, 6, n_anomalies)
+    a_ipkt = np.exp(rng.normal(7, 1.5, n_anomalies)).astype(np.int64) + 1
+    out["ipkt"][a] = a_ipkt
+    out["ibyt"][a] = a_ipkt * rng.integers(900, 1460, n_anomalies)
+    out["anomaly_idx"] = np.arange(n_bg, n_events, dtype=np.int64)
+    out["proto_classes"] = list(FLOW_PROTO_CLASSES)
+    return out
+
+
 # ---------------------------------------------------------------------------
 # dns
 # ---------------------------------------------------------------------------

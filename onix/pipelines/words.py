@@ -9,6 +9,14 @@ encoding] — the mount carries no oni-ml code (SURVEY.md §0), so the
 load-bearing property is the reconstructed CONTRACT: low-probability
 (word | IP) events under the topic model are surfaced as suspicious.
 
+Words are PACKED INTEGERS, not strings: every word is a tuple of small
+integer fields (bins, class ids), packed into one int64 with vectorized
+shifts. Display strings are rendered lazily and only for the UNIQUE
+vocabulary entries (V is small), never per event row — per-row Python
+string formatting was the 10⁹-row bottleneck of the first design. The
+rendered strings keep the original `a_b_c` format, so vocab dumps and
+the analyst-feedback CSV contract are unchanged.
+
 All transforms are vectorized over pandas/NumPy columns; the fitted
 quantile edges are returned as explicit metadata so (a) a later
 scoring-only run can re-apply identical binning and (b) the run manifest
@@ -33,24 +41,136 @@ from onix.utils.features import (digitize, entropy_array, quantile_edges,
 N_BINS_DEFAULT = 5
 _IP_RE = re.compile(r"^\d{1,3}\.\d{1,3}\.\d{1,3}\.\d{1,3}$")
 
+# Reserved categorical codes.
+_PROTO_UNK = 255          # proto not in the fitted table (apply mode)
+_PCLASS_HH = 65536        # ephemeral<->ephemeral marker ("HH")
+_UA_RARE = 1023           # user-agent outside the fitted common set
 
-@dataclasses.dataclass
+
+@dataclasses.dataclass(frozen=True)
+class WordSpec:
+    """Bit layout of a packed word key, LSB-first: (field, bits)."""
+
+    datatype: str
+    fields: tuple[tuple[str, int], ...]
+
+    def pack(self, cols: dict[str, np.ndarray]) -> np.ndarray:
+        out = np.zeros(len(next(iter(cols.values()))), np.int64)
+        shift = 0
+        for name, bits in self.fields:
+            v = np.asarray(cols[name], np.int64) & ((1 << bits) - 1)
+            out |= v << shift
+            shift += bits
+        assert shift < 63, "word key overflows int64"
+        return out
+
+    def unpack(self, keys: np.ndarray) -> dict[str, np.ndarray]:
+        keys = np.asarray(keys, np.int64)
+        out = {}
+        shift = 0
+        for name, bits in self.fields:
+            out[name] = (keys >> shift) & ((1 << bits) - 1)
+            shift += bits
+        return out
+
+
+FLOW_SPEC = WordSpec("flow", (("pbin", 6), ("bbin", 6), ("hbin", 6),
+                              ("pclass", 17), ("proto", 8)))
+DNS_SPEC = WordSpec("dns", (("tld", 1), ("rcode", 8), ("qtype", 16),
+                            ("nlabels", 3), ("ebin", 6), ("slbin", 6),
+                            ("hbin", 6), ("flbin", 6)))
+PROXY_SPEC = WordSpec("proxy", (("hbin", 6), ("uebin", 6), ("ulbin", 6),
+                                ("hostip", 1), ("ua", 10), ("cclass", 4)))
+
+
+def render_words(spec: WordSpec, keys: np.ndarray, edges: dict) -> np.ndarray:
+    """Display strings for (typically unique) packed keys — identical
+    format to the original per-row f-strings."""
+    f = spec.unpack(keys)
+    if spec.datatype == "flow":
+        protos = list(edges.get("proto_classes", ()))
+        pr = [protos[p] if p < len(protos) else "UNK" for p in f["proto"]]
+        pc = ["HH" if c == _PCLASS_HH else str(c) for c in f["pclass"]]
+        it = zip(pr, pc, f["hbin"], f["bbin"], f["pbin"])
+        return np.array([f"{a}_{b}_{c}_{d}_{e}" for a, b, c, d, e in it],
+                        dtype=object)
+    if spec.datatype == "dns":
+        it = zip(f["flbin"], f["hbin"], f["slbin"], f["ebin"], f["nlabels"],
+                 f["qtype"], f["rcode"], f["tld"])
+        return np.array(
+            [f"{fl}_{h}_{sl}_{e}_{nl}_{qt}_{rc}_{tv}"
+             for fl, h, sl, e, nl, qt, rc, tv in it], dtype=object)
+    if spec.datatype == "proxy":
+        ua = ["R" if u == _UA_RARE else f"C{u}" for u in f["ua"]]
+        it = zip(f["cclass"], ua, f["hostip"], f["ulbin"], f["uebin"],
+                 f["hbin"])
+        return np.array([f"{cc}_{u}_{hi}_{ul}_{ue}_{h}"
+                         for cc, u, hi, ul, ue, h in it], dtype=object)
+    raise ValueError(f"unknown datatype {spec.datatype!r}")
+
+
+def u32_to_ips(vals: np.ndarray) -> np.ndarray:
+    """uint32 -> dotted-quad object strings (display path; call on
+    uniques). Delegates to the decoder module's vectorized converter."""
+    from onix.ingest.nfdecode import ip_to_str
+    return ip_to_str(vals).astype(object)
+
+
 class WordTable:
     """(document, word) rows with provenance back to source events.
+
+    Canonical storage is integer: `word_key` (packed int64 per the
+    table's `spec`) and, when the producer had numeric IPs, `ip_u32`.
+    `word` / `ip` are lazily-rendered string views (rendered per UNIQUE
+    value then broadcast — never per-row Python formatting), kept for
+    display, vocab dumps, and the feedback CSV contract.
 
     `event_idx[i]` is the source row of pair i — flow events contribute
     two rows (src-IP doc and dst-IP doc), dns/proxy one. `edges` holds
     the fitted binning metadata needed to reproduce the words.
     """
 
-    ip: np.ndarray          # object [n_rows] document key (IP string)
-    word: np.ndarray        # object [n_rows] word string
-    event_idx: np.ndarray   # int64 [n_rows] source event row
-    edges: dict
+    def __init__(self, *, event_idx: np.ndarray, edges: dict,
+                 spec: WordSpec | None = None,
+                 word_key: np.ndarray | None = None,
+                 word: np.ndarray | None = None,
+                 ip: np.ndarray | None = None,
+                 ip_u32: np.ndarray | None = None):
+        if ip is None and ip_u32 is None:
+            raise ValueError("need ip strings or ip_u32")
+        if word is None and word_key is None:
+            raise ValueError("need word strings or (word_key, spec)")
+        if word is None and spec is None:
+            raise ValueError("word_key needs a spec to render strings")
+        self.event_idx = event_idx
+        self.edges = edges
+        self.spec = spec
+        self.word_key = word_key
+        self.ip_u32 = ip_u32
+        self._ip = ip
+        self._word = word
 
     @property
     def n_rows(self) -> int:
-        return int(self.ip.shape[0])
+        arr = self.word_key if self.word_key is not None else self._word
+        return int(arr.shape[0])
+
+    @property
+    def ip(self) -> np.ndarray:
+        if self._ip is None:
+            uniq, inv = np.unique(self.ip_u32, return_inverse=True)
+            self._ip = u32_to_ips(uniq)[inv]
+        return self._ip
+
+    @property
+    def word(self) -> np.ndarray:
+        if self._word is None:
+            uniq, inv = np.unique(self.word_key, return_inverse=True)
+            self._word = render_words(self.spec, uniq, self.edges)[inv]
+        return self._word
+
+    def render_keys(self, keys: np.ndarray) -> np.ndarray:
+        return render_words(self.spec, keys, self.edges)
 
 
 def _bins(values: np.ndarray, name: str, n_bins: int, edges: dict) -> np.ndarray:
@@ -60,27 +180,79 @@ def _bins(values: np.ndarray, name: str, n_bins: int, edges: dict) -> np.ndarray
     return digitize(values, edges[name])
 
 
+def _categorical(values: np.ndarray, name: str, edges: dict,
+                 unk_code: int) -> np.ndarray:
+    """Map strings to ids via a fitted sorted table; unseen -> unk_code."""
+    if name not in edges:
+        edges[name] = sorted(np.unique(values).tolist())
+    table = np.asarray(edges[name], dtype=object)
+    idx = np.searchsorted(table, values)
+    idx = np.clip(idx, 0, max(len(table) - 1, 0))
+    ok = table[idx] == values if len(table) else np.zeros(len(values), bool)
+    return np.where(ok, idx, unk_code).astype(np.int64)
+
+
 # ---------------------------------------------------------------------------
 # flow (SURVEY.md §2.1 #5: "protocol + src/dst port class + quantile-binned
 # bytes, packets, and time-of-day; one document per IP address")
 # ---------------------------------------------------------------------------
 
 
-def _port_class(sport: np.ndarray, dport: np.ndarray) -> np.ndarray:
+def _port_class_codes(sport: np.ndarray, dport: np.ndarray) -> np.ndarray:
     """Collapse the port pair to the service port that identifies the
     conversation: the privileged (<=1024) side when exactly one side is
-    privileged, the smaller port when both are, and a single high-high
-    marker when neither is (ephemeral↔ephemeral — the interesting class)."""
+    privileged, the smaller port when both are, and the high-high marker
+    when neither is (ephemeral↔ephemeral — the interesting class)."""
     sport = np.asarray(sport, np.int64)
     dport = np.asarray(dport, np.int64)
     both_low = (sport <= 1024) & (dport <= 1024)
     s_low = (sport <= 1024) & (dport > 1024)
     d_low = (dport <= 1024) & (sport > 1024)
-    out = np.full(sport.shape, "HH", dtype=object)       # high-high
-    out[both_low] = np.minimum(sport, dport)[both_low].astype(str)
-    out[s_low] = sport[s_low].astype(str)
-    out[d_low] = dport[d_low].astype(str)
+    out = np.full(sport.shape, _PCLASS_HH, np.int64)
+    np.copyto(out, np.minimum(sport, dport), where=both_low)
+    np.copyto(out, sport, where=s_low)
+    np.copyto(out, dport, where=d_low)
     return out
+
+
+def flow_words_from_arrays(
+        *, sip_u32: np.ndarray, dip_u32: np.ndarray, sport: np.ndarray,
+        dport: np.ndarray, proto_id: np.ndarray, hour: np.ndarray,
+        ibyt: np.ndarray, ipkt: np.ndarray, proto_classes: list[str],
+        n_bins: int = N_BINS_DEFAULT, edges: dict | None = None) -> WordTable:
+    """Numeric fast path: flow words straight from columnar arrays —
+    zero per-row Python, the 10⁹-row ingest contract (BASELINE.json
+    configs[3]). `proto_id` indexes `proto_classes` (uppercase names)."""
+    edges = dict(edges) if edges else {}
+    edges.setdefault("proto_classes", sorted(proto_classes))
+    # proto_id refers to caller order; remap to the sorted fitted table,
+    # sending names absent from the fitted table (apply mode with new
+    # protocols) to the UNK code — same contract as the string path's
+    # _categorical, never a silent wrong class.
+    table = np.asarray(edges["proto_classes"], dtype=object)
+    names = np.asarray(proto_classes, dtype=object)
+    pos = np.searchsorted(table, names)
+    pos_c = np.clip(pos, 0, max(len(table) - 1, 0))
+    remap = np.where(len(table) and table[pos_c] == names,
+                     pos_c, _PROTO_UNK).astype(np.int64)
+    n = sip_u32.shape[0]
+    hbin = _bins(np.asarray(hour, np.float64), "hour", n_bins, edges)
+    bbin = _bins(np.log1p(np.asarray(ibyt, np.float64)), "log_ibyt",
+                 n_bins, edges)
+    pbin = _bins(np.log1p(np.asarray(ipkt, np.float64)), "log_ipkt",
+                 n_bins, edges)
+    key = FLOW_SPEC.pack({
+        "proto": remap[np.asarray(proto_id, np.int64)],
+        "pclass": _port_class_codes(sport, dport),
+        "hbin": hbin, "bbin": bbin, "pbin": pbin,
+    })
+    return WordTable(
+        ip_u32=np.concatenate([np.asarray(sip_u32, np.uint32),
+                               np.asarray(dip_u32, np.uint32)]),
+        word_key=np.concatenate([key, key]),
+        event_idx=np.concatenate([np.arange(n), np.arange(n)]).astype(np.int64),
+        edges=edges, spec=FLOW_SPEC,
+    )
 
 
 def flow_words(table: pd.DataFrame, n_bins: int = N_BINS_DEFAULT,
@@ -94,17 +266,19 @@ def flow_words(table: pd.DataFrame, n_bins: int = N_BINS_DEFAULT,
                  "log_ibyt", n_bins, edges)
     pbin = _bins(np.log1p(table["ipkt"].to_numpy(np.float64)),
                  "log_ipkt", n_bins, edges)
-    pclass = _port_class(table["sport"].to_numpy(), table["dport"].to_numpy())
+    pclass = _port_class_codes(table["sport"].to_numpy(),
+                               table["dport"].to_numpy())
     proto = table["proto"].astype(str).str.upper().to_numpy()
-    word = np.array([f"{pr}_{pc}_{h}_{b}_{p}" for pr, pc, h, b, p
-                     in zip(proto, pclass, hbin, bbin, pbin)], dtype=object)
+    proto_id = _categorical(proto, "proto_classes", edges, _PROTO_UNK)
+    key = FLOW_SPEC.pack({"proto": proto_id, "pclass": pclass,
+                          "hbin": hbin, "bbin": bbin, "pbin": pbin})
     sip = table["sip"].astype(str).to_numpy()
     dip = table["dip"].astype(str).to_numpy()
     return WordTable(
         ip=np.concatenate([sip, dip]),
-        word=np.concatenate([word, word]),
+        word_key=np.concatenate([key, key]),
         event_idx=np.concatenate([np.arange(n), np.arange(n)]).astype(np.int64),
-        edges=edges,
+        edges=edges, spec=FLOW_SPEC,
     )
 
 
@@ -135,16 +309,15 @@ def dns_words(table: pd.DataFrame, n_bins: int = N_BINS_DEFAULT,
     qtype = table["dns_qry_type"].to_numpy(np.int64)
     rcode = table["dns_qry_rcode"].to_numpy(np.int64)
 
-    word = np.array(
-        [f"{fl}_{h}_{sl}_{e}_{nl}_{qt}_{rc}_{tv}" for
-         fl, h, sl, e, nl, qt, rc, tv in
-         zip(flbin, hbin, slbin, ebin, n_labels, qtype, rcode, tld_ok)],
-        dtype=object)
+    key = DNS_SPEC.pack({
+        "flbin": flbin, "hbin": hbin, "slbin": slbin, "ebin": ebin,
+        "nlabels": n_labels, "qtype": qtype, "rcode": rcode, "tld": tld_ok,
+    })
     return WordTable(
         ip=table["ip_dst"].astype(str).to_numpy(),   # reply → client IP
-        word=word,
+        word_key=key,
         event_idx=np.arange(n, dtype=np.int64),
-        edges=edges,
+        edges=edges, spec=DNS_SPEC,
     )
 
 
@@ -154,18 +327,17 @@ def dns_words(table: pd.DataFrame, n_bins: int = N_BINS_DEFAULT,
 # ---------------------------------------------------------------------------
 
 
-def _ua_classes(agents: np.ndarray, edges: dict,
-                min_frac: float = 0.01) -> np.ndarray:
-    """User-agent class: common agents keep their identity, rare ones
-    collapse to 'RARE' (rarity is the signal). The common set is fitted
-    metadata so apply-mode runs reproduce the classes."""
+def _ua_codes(agents: np.ndarray, edges: dict,
+              min_frac: float = 0.01) -> np.ndarray:
+    """User-agent class id: common agents keep their identity (index into
+    the fitted common table), rare ones collapse to _UA_RARE (rarity is
+    the signal). The common set is fitted metadata so apply-mode runs
+    reproduce the classes."""
     if "ua_common" not in edges:
         vals, counts = np.unique(agents, return_counts=True)
         keep = vals[counts >= max(2, int(min_frac * agents.size))]
-        edges["ua_common"] = sorted(keep.tolist())
-    common = set(edges["ua_common"])
-    return np.array([a if a in common else "RARE" for a in agents],
-                    dtype=object)
+        edges["ua_common"] = sorted(keep.tolist())[:_UA_RARE]
+    return _categorical(agents, "ua_common", edges, _UA_RARE)
 
 
 def proxy_words(table: pd.DataFrame, n_bins: int = N_BINS_DEFAULT,
@@ -185,21 +357,18 @@ def proxy_words(table: pd.DataFrame, n_bins: int = N_BINS_DEFAULT,
 
     host = table["host"].astype(str).to_numpy()
     host_is_ip = np.array([int(bool(_IP_RE.match(h))) for h in host], np.int64)
-    ua = _ua_classes(table["useragent"].astype(str).to_numpy(), edges)
-    # Compact UA class id for the word string (single O(n) map pass).
-    ua_code = {a: f"C{i}" for i, a in enumerate(edges["ua_common"])}
-    ua_id = np.array([ua_code.get(a, "R") for a in ua], dtype=object)
+    ua_id = _ua_codes(table["useragent"].astype(str).to_numpy(), edges)
     code_class = (table["respcode"].to_numpy(np.int64) // 100)
 
-    word = np.array(
-        [f"{cc}_{u}_{hi}_{ul}_{ue}_{h}" for cc, u, hi, ul, ue, h in
-         zip(code_class, ua_id, host_is_ip, ulbin, uebin, hbin)],
-        dtype=object)
+    key = PROXY_SPEC.pack({
+        "cclass": code_class, "ua": ua_id, "hostip": host_is_ip,
+        "ulbin": ulbin, "uebin": uebin, "hbin": hbin,
+    })
     return WordTable(
         ip=table["clientip"].astype(str).to_numpy(),
-        word=word,
+        word_key=key,
         event_idx=np.arange(n, dtype=np.int64),
-        edges=edges,
+        edges=edges, spec=PROXY_SPEC,
     )
 
 

@@ -7,20 +7,92 @@ import pytest
 
 from onix.pipelines import synth
 from onix.pipelines.corpus_build import Vocabulary, build_corpus, event_scores
-from onix.pipelines.words import (WORD_FNS, _port_class, dns_words,
-                                  flow_words, proxy_words)
+from onix.pipelines.words import (WORD_FNS, _PCLASS_HH, _port_class_codes,
+                                  dns_words, flow_words, proxy_words)
 
 
 def test_port_class_hand_examples():
     sport = np.array([44123, 80, 443, 22, 55555])
     dport = np.array([443, 51234, 80, 1024, 44444])
-    out = _port_class(sport, dport)
-    assert out.tolist() == ["443", "80", "80", "22", "HH"]
+    out = _port_class_codes(sport, dport)
+    assert out.tolist() == [443, 80, 80, 22, _PCLASS_HH]
 
 
 @pytest.fixture(scope="module")
 def flow_day():
     return synth.synth_flow_day(n_events=2000, n_anomalies=10, seed=1)
+
+
+def test_flow_words_numeric_path_equivalent(flow_day):
+    """flow_words_from_arrays (the 10⁸-row zero-object path) must build
+    the exact same corpus as the string path on the same data."""
+    from onix.ingest.nfdecode import str_to_ip
+    from onix.pipelines.words import flow_words_from_arrays
+    from onix.store import hour_of
+
+    table, _ = flow_day
+    ref = build_corpus(flow_words(table))
+
+    protos = sorted(table["proto"].astype(str).str.upper().unique().tolist())
+    pmap = {p: i for i, p in enumerate(protos)}
+    got = build_corpus(flow_words_from_arrays(
+        sip_u32=str_to_ip(table["sip"].astype(str)),
+        dip_u32=str_to_ip(table["dip"].astype(str)),
+        sport=table["sport"].to_numpy(),
+        dport=table["dport"].to_numpy(),
+        proto_id=table["proto"].astype(str).str.upper().map(pmap).to_numpy(),
+        hour=hour_of(table["treceived"]),
+        ibyt=table["ibyt"].to_numpy(),
+        ipkt=table["ipkt"].to_numpy(),
+        proto_classes=protos))
+
+    np.testing.assert_array_equal(ref.vocab.words, got.vocab.words)
+    np.testing.assert_array_equal(ref.doc_keys, got.doc_keys)
+    np.testing.assert_array_equal(ref.corpus.doc_ids, got.corpus.doc_ids)
+    np.testing.assert_array_equal(ref.corpus.word_ids, got.corpus.word_ids)
+
+
+def test_flow_arrays_unseen_proto_maps_to_unk(flow_day):
+    """Apply mode with a protocol missing from the fitted table must
+    render UNK (unknown word downstream), never a silently wrong class."""
+    from onix.ingest.nfdecode import str_to_ip
+    from onix.pipelines.words import flow_words_from_arrays
+    from onix.store import hour_of
+
+    table, _ = flow_day
+    fitted = flow_words(table)           # fits proto_classes etc.
+    sub = table.head(64)
+    wt = flow_words_from_arrays(
+        sip_u32=str_to_ip(sub["sip"].astype(str)),
+        dip_u32=str_to_ip(sub["dip"].astype(str)),
+        sport=sub["sport"].to_numpy(), dport=sub["dport"].to_numpy(),
+        proto_id=np.zeros(len(sub), np.int64),
+        hour=hour_of(sub["treceived"]),
+        ibyt=sub["ibyt"].to_numpy(), ipkt=sub["ipkt"].to_numpy(),
+        proto_classes=["GRE"],           # not in the fitted table
+        edges=fitted.edges)
+    assert all(w.startswith("UNK_") for w in wt.word)
+
+
+def test_synth_flow_arrays_generator_scales():
+    """Columnar generator: sane shapes/dtypes, planted anomalies last,
+    and the packed word path consumes it without object arrays."""
+    from onix.pipelines.corpus_build import build_corpus
+    from onix.pipelines.words import flow_words_from_arrays
+
+    cols = synth.synth_flow_day_arrays(50_000, n_hosts=500, seed=3)
+    assert cols["sip_u32"].dtype == np.uint32
+    assert len(cols["anomaly_idx"]) == max(30, 50_000 // 10_000)
+    wt = flow_words_from_arrays(
+        **{k: cols[k] for k in ("sip_u32", "dip_u32", "sport", "dport",
+                                "proto_id", "hour", "ibyt", "ipkt")},
+        proto_classes=cols["proto_classes"])
+    assert wt.n_rows == 2 * 50_000
+    bundle = build_corpus(wt)
+    assert bundle.corpus.n_docs > 500        # hosts + servers + externals
+    assert 50 < bundle.corpus.n_vocab < 5000
+    # Anomaly destinations (203.0.x.y) appear among the doc keys.
+    assert any(k.startswith("203.0.") for k in bundle.doc_keys)
 
 
 def test_flow_words_shape_and_docs(flow_day):
