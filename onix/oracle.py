@@ -139,6 +139,31 @@ def vem(counts: SparseCounts, *, n_topics: int, alpha: float, eta: float,
     return {"theta": theta, "phi": phi, "ll": ll}
 
 
+def gibbs_ensemble_scores(counts: SparseCounts, doc_ids: np.ndarray,
+                          word_ids: np.ndarray, *, n_topics: int,
+                          alpha: float, eta: float, n_sweeps: int = 300,
+                          n_runs: int = 8, seed: int = 0,
+                          n_threads: int = 1) -> np.ndarray:
+    """Geometric-mean event scores over `n_runs` independent Gibbs runs.
+
+    Event scores are invariant to topic relabeling, so averaging them
+    across restarts is a legitimate posterior-predictive estimate; the
+    geometric mean is the rank-stable choice for the suspicious tail
+    (an event must be low under EVERY run to stay in the bottom-k).
+    This is the oracle side of the judged top-1k overlap harness — the
+    stand-in for "lda-c's suspicious set" (BASELINE.json metric).
+    """
+    acc = None
+    for r in range(n_runs):
+        out = gibbs(counts, n_topics=n_topics, alpha=alpha, eta=eta,
+                    n_sweeps=n_sweeps, burn_in=n_sweeps // 2,
+                    seed=seed + 1000 * r, n_threads=n_threads)
+        s = score_events_np(out["theta"], out["phi"], doc_ids, word_ids)
+        logs = np.log(np.maximum(s, 1e-300))
+        acc = logs if acc is None else acc + logs
+    return np.exp(acc / n_runs)
+
+
 # -- the judged comparison metric -----------------------------------------
 
 

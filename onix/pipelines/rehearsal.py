@@ -1,0 +1,140 @@
+"""Judged-metric rehearsal: top-1k suspicious-connect overlap vs oracle.
+
+BASELINE.json's fidelity metric is "top-1k suspicious-connect overlap vs
+lda-c >= 0.95". The reference binary is absent from the mount, so the
+C++ `onix-lda-ref` engine stands in for lda-c (SURVEY.md §2.4 #1). This
+module runs the full pairing on a realistic role-structured flow day and
+records every number that contextualizes the bar:
+
+  * jax_vs_oracle      — the judged number: JAX multi-chain Gibbs
+                         (geometric score-average over chains) vs an
+                         oracle restart-ensemble.
+  * oracle_vs_oracle   — the achievable ceiling: two disjoint oracle
+                         ensembles against each other. Run-to-run
+                         posterior noise bounds ANY engine's agreement.
+  * single_run_floor   — one oracle run vs another: what the metric
+                         looks like without ensemble averaging (the
+                         round-1 design measured ~0.85 here).
+  * gibbs_vs_vem       — the inter-algorithm gap SURVEY.md §7.3.2 asks
+                         to quantify (lda-c lineage is VEM; BASELINE
+                         calls it a Gibbs sampler — the truth is the
+                         band between them).
+
+Method notes in docs/OVERLAP.md. Reproduce with:
+    python -m onix.pipelines.rehearsal --events 100000 --out <path>
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import time
+
+import numpy as np
+
+JUDGED_K = 1000
+JUDGED_BAR = 0.95
+
+
+def run_rehearsal(n_events: int = 100_000, n_sweeps: int = 300,
+                  n_chains: int = 8, n_oracle_runs: int = 8,
+                  n_topics: int = 20, alpha: float = 0.5, eta: float = 0.05,
+                  seed: int = 5, out_path=None) -> dict:
+    from onix import oracle
+    from onix.config import LDAConfig
+    from onix.models.lda_gibbs import GibbsLDA
+    from onix.models.scoring import score_all
+    from onix.pipelines.corpus_build import build_corpus
+    from onix.pipelines.synth import synth_flow_day
+    from onix.pipelines.words import flow_words
+
+    day, _planted = synth_flow_day(
+        n_events=n_events, n_hosts=max(120, n_events // 250),
+        n_anomalies=max(30, n_events // 650), seed=seed)
+    bundle = build_corpus(flow_words(day))
+    corpus = bundle.corpus
+    sc = corpus.to_doc_word_counts()
+
+    walls = {}
+    t = time.monotonic()
+    ora_a = oracle.gibbs_ensemble_scores(
+        sc, corpus.doc_ids, corpus.word_ids, n_topics=n_topics, alpha=alpha,
+        eta=eta, n_sweeps=n_sweeps, n_runs=n_oracle_runs, seed=100)
+    ora_b = oracle.gibbs_ensemble_scores(
+        sc, corpus.doc_ids, corpus.word_ids, n_topics=n_topics, alpha=alpha,
+        eta=eta, n_sweeps=n_sweeps, n_runs=n_oracle_runs, seed=500)
+    walls["oracle_ensembles"] = round(time.monotonic() - t, 1)
+
+    t = time.monotonic()
+    g1 = oracle.gibbs(sc, n_topics=n_topics, alpha=alpha, eta=eta,
+                      n_sweeps=n_sweeps, burn_in=n_sweeps // 2, seed=31)
+    g2 = oracle.gibbs(sc, n_topics=n_topics, alpha=alpha, eta=eta,
+                      n_sweeps=n_sweeps, burn_in=n_sweeps // 2, seed=32)
+    s1 = oracle.score_events_np(g1["theta"], g1["phi"],
+                                corpus.doc_ids, corpus.word_ids)
+    s2 = oracle.score_events_np(g2["theta"], g2["phi"],
+                                corpus.doc_ids, corpus.word_ids)
+    vem = oracle.vem(sc, n_topics=n_topics, alpha=alpha, eta=eta,
+                     em_max_iter=80, seed=31)
+    sv = oracle.score_events_np(vem["theta"], vem["phi"],
+                                corpus.doc_ids, corpus.word_ids)
+    walls["oracle_singles_and_vem"] = round(time.monotonic() - t, 1)
+
+    t = time.monotonic()
+    cfg = LDAConfig(n_topics=n_topics, alpha=alpha, eta=eta,
+                    n_sweeps=n_sweeps, burn_in=n_sweeps // 2,
+                    block_size=8192, seed=0, n_chains=n_chains)
+    fit = GibbsLDA(cfg, corpus.n_docs, corpus.n_vocab).fit(corpus)
+    jx = np.asarray(score_all(fit["theta"], fit["phi_wk"],
+                              corpus.doc_ids, corpus.word_ids))
+    walls["jax_fit_and_score"] = round(time.monotonic() - t, 1)
+
+    k = JUDGED_K
+    result = {
+        "metric": f"top-{k} suspicious-connect overlap vs oracle",
+        "bar": JUDGED_BAR,
+        "jax_vs_oracle": round(oracle.topk_overlap(jx, ora_a, k), 4),
+        "jax_vs_oracle_b": round(oracle.topk_overlap(jx, ora_b, k), 4),
+        "oracle_vs_oracle": round(oracle.topk_overlap(ora_a, ora_b, k), 4),
+        "single_run_floor": round(oracle.topk_overlap(s1, s2, k), 4),
+        "gibbs_vs_vem": round(oracle.topk_overlap(s1, sv, k), 4),
+        "jax_vs_vem": round(oracle.topk_overlap(jx, sv, k), 4),
+        "overlap_at_k": {
+            str(kk): round(oracle.topk_overlap(jx, ora_a, kk), 4)
+            for kk in (100, 500, 1000, 2000)},
+        "config": {
+            "n_events": n_events, "n_docs": int(corpus.n_docs),
+            "n_vocab": int(corpus.n_vocab),
+            "n_tokens": int(corpus.n_tokens), "n_topics": n_topics,
+            "alpha": alpha, "eta": eta, "n_sweeps": n_sweeps,
+            "n_chains": n_chains, "n_oracle_runs": n_oracle_runs,
+            "seed": seed},
+        "walls_seconds": walls,
+    }
+    result["passes_bar"] = bool(result["jax_vs_oracle"] >= JUDGED_BAR)
+    if out_path is not None:
+        out_path = pathlib.Path(out_path)
+        out_path.parent.mkdir(parents=True, exist_ok=True)
+        out_path.write_text(json.dumps(result, indent=2) + "\n")
+    return result
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(description="judged overlap rehearsal")
+    ap.add_argument("--events", type=int, default=100_000)
+    ap.add_argument("--sweeps", type=int, default=300)
+    ap.add_argument("--chains", type=int, default=8)
+    ap.add_argument("--oracle-runs", type=int, default=8)
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args(argv)
+    r = run_rehearsal(n_events=args.events, n_sweeps=args.sweeps,
+                      n_chains=args.chains, n_oracle_runs=args.oracle_runs,
+                      out_path=args.out)
+    print(json.dumps(r, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -6,13 +6,14 @@ BASELINE.json `metric`)." The oracle stands in for oni-lda-c
 (reference README.md:84), whose binary is absent from the mount.
 """
 
+import os
 import subprocess
 
 import numpy as np
 import pytest
 
 from onix.config import LDAConfig
-from onix.corpus import anomaly_corpus, synthetic_lda_corpus
+from onix.corpus import synthetic_lda_corpus
 from onix.models.lda_gibbs import GibbsLDA
 
 oracle = pytest.importorskip("onix.oracle")
@@ -85,38 +86,66 @@ def test_multithread_gibbs_matches_quality(corpus5):
 
 
 def test_judged_overlap_jax_vs_oracle():
-    """The headline harness: identical anomaly corpus through the JAX
-    batched-Gibbs engine and the C++ oracle; bottom-k suspicious sets must
-    overlap. Small-scale rehearsal of BASELINE.json's top-1k ≥ 0.95."""
-    corpus, planted = anomaly_corpus(n_docs=250, n_vocab=300, n_topics=8,
-                                     mean_doc_len=250, n_anomalies=40, seed=2)
-    k_topics, alpha, eta = 8, 0.5, 0.05
+    """The headline harness at CI speed: a role-structured flow day
+    through the JAX multi-chain engine (geometric score-averaging) and
+    an oracle restart-ensemble — the exact estimator pairing that clears
+    the judged bar at full scale (docs/OVERLAP.md). CI scale: 20k
+    events, 4 chains vs ens-4, k=500, bar 0.90 (measured ~0.95 with the
+    full 8×300 config; 0.90 leaves seed margin at the reduced one)."""
+    from onix.models.scoring import score_all
+    from onix.pipelines.corpus_build import build_corpus
+    from onix.pipelines.synth import synth_flow_day
+    from onix.pipelines.words import flow_words
 
-    cfg = LDAConfig(n_topics=k_topics, alpha=alpha, eta=eta, n_sweeps=80,
-                    burn_in=40, block_size=4096, seed=0)
-    model = GibbsLDA(cfg, corpus.n_docs, corpus.n_vocab)
-    jax_fit = model.fit(corpus)
+    day, planted = synth_flow_day(n_events=20_000, n_hosts=120,
+                                  n_anomalies=30, seed=5)
+    bundle = build_corpus(flow_words(day))
+    corpus = bundle.corpus
+    k_topics, alpha, eta, sweeps = 20, 0.5, 0.05, 200
+
+    cfg = LDAConfig(n_topics=k_topics, alpha=alpha, eta=eta,
+                    n_sweeps=sweeps, burn_in=sweeps // 2, block_size=8192,
+                    seed=0, n_chains=4)
+    jax_fit = GibbsLDA(cfg, corpus.n_docs, corpus.n_vocab).fit(corpus)
     # Score through the PRODUCTION scorer so the harness exercises the
     # shipped metric path, not a reimplementation.
-    from onix.models.scoring import score_all
-    jax_scores = score_all(jax_fit["theta"], jax_fit["phi_wk"],
-                           corpus.doc_ids, corpus.word_ids)
+    jax_scores = np.asarray(score_all(jax_fit["theta"], jax_fit["phi_wk"],
+                                      corpus.doc_ids, corpus.word_ids))
 
-    ora = oracle.gibbs(corpus.to_doc_word_counts(), n_topics=k_topics,
-                       alpha=alpha, eta=eta, n_sweeps=80, burn_in=40, seed=3)
-    # Score the SAME token stream with the oracle model.
-    ora_scores = oracle.score_events_np(
-        ora["theta"], ora["phi"], corpus.doc_ids, corpus.word_ids)
+    ora_scores = oracle.gibbs_ensemble_scores(
+        corpus.to_doc_word_counts(), corpus.doc_ids, corpus.word_ids,
+        n_topics=k_topics, alpha=alpha, eta=eta, n_sweeps=sweeps,
+        n_runs=4, seed=100)
 
-    k = 100
+    k = 500
     ov = oracle.topk_overlap(jax_scores, ora_scores, k)
-    assert ov >= 0.8, f"top-{k} overlap vs oracle too low: {ov:.3f}"
+    assert ov >= 0.90, f"top-{k} overlap vs oracle too low: {ov:.3f}"
 
-    # Both engines must surface the planted anomalies near the bottom.
+    # Both engines must surface the planted exfil anomalies: every
+    # anomaly event has BOTH its tokens (src + dst doc) scored; the
+    # per-event score is the min over the event's tokens.
+    n = len(day)
     for scores, name in ((jax_scores, "jax"), (ora_scores, "oracle")):
-        bottom = set(np.argsort(scores)[:200].tolist())
+        ev = np.minimum(scores[:n], scores[n:])
+        bottom = set(np.argsort(ev)[:200].tolist())
         hit = len(bottom & set(planted.tolist())) / len(planted)
         assert hit >= 0.8, f"{name} missed planted anomalies: {hit:.2f}"
+
+
+@pytest.mark.skipif(not os.environ.get("ONIX_JUDGED"),
+                    reason="full judged rehearsal (~15 min 1-core CPU): "
+                           "set ONIX_JUDGED=1")
+def test_judged_overlap_full_rehearsal():
+    """The judged configuration itself: top-1k ≥ 0.95 at 100k events,
+    8 chains vs oracle ens-8, 300 sweeps — the committed artifact
+    docs/OVERLAP_r02.json is this run's output."""
+    from onix.pipelines.rehearsal import JUDGED_BAR, run_rehearsal
+
+    r = run_rehearsal(n_events=100_000)
+    assert r["jax_vs_oracle"] >= JUDGED_BAR, r
+    # The ceiling contextualizes the bar: the JAX engine must not trail
+    # the oracle's self-agreement by more than noise.
+    assert r["jax_vs_oracle"] >= r["oracle_vs_oracle"] - 0.02, r
 
 
 def test_cli_file_contract(tmp_path, corpus5):
