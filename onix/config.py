@@ -44,6 +44,8 @@ class LDAConfig:
     svi_kappa: float = 0.7
     svi_batch_size: int = 4096  # documents per SVI minibatch
     svi_local_iters: int = 30   # local E-step fixed-point iterations
+    svi_max_epochs: int = 30    # batch-mode epoch cap (streaming: n/a)
+    svi_epoch_tol: float = 1e-3  # stop when relative ll gain drops below
     checkpoint_every: int = 0   # sweeps between sampler checkpoints (0=off)
     # Independent Gibbs chains, batched on device via vmap; event scores
     # average over chains. Single chains are rank-unstable (recall on the
@@ -60,6 +62,10 @@ class LDAConfig:
             raise ValueError("block_size must be >=1")
         if not (0.5 < self.svi_kappa <= 1.0):
             raise ValueError("svi_kappa must be in (0.5, 1] for convergence")
+        if self.svi_max_epochs < 1:
+            raise ValueError("svi_max_epochs must be >= 1")
+        if self.svi_epoch_tol < 0:
+            raise ValueError("svi_epoch_tol must be >= 0")
         if self.checkpoint_every < 0:
             raise ValueError("checkpoint_every must be >= 0")
         if self.n_chains < 1:

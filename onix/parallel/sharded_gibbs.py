@@ -231,8 +231,47 @@ class ShardedGibbsLDA:
                 n_acc=state.n_acc + jnp.int32(accumulate),
             )
 
+        def ll_fn(state: ShardedGibbsState, docs, words, mask):
+            """Predictive mean log-likelihood from the CURRENT counts,
+            computed where the data lives: per-shard token sums, then a
+            psum — the convergence series the reference reads from
+            lda-c's likelihood.dat (SURVEY.md §5.4–5.5), without
+            gathering θ or the corpus to the host."""
+            def shard_fn(n_dk, n_wk, n_k, d, w, m):
+                n_k_v = jax.lax.pcast(n_k, both, to="varying")
+                ndk = n_dk[0].astype(jnp.float32)
+                theta = ((ndk + config.alpha)
+                         / (ndk.sum(-1, keepdims=True) + k * config.alpha))
+                nwk = n_wk[0].astype(jnp.float32)
+                phi = ((nwk + config.eta)
+                       / (n_k_v.astype(jnp.float32) + n_vocab * config.eta))
+
+                def block(carry, xs):
+                    s, t = carry
+                    db, wb, mb = xs
+                    p = jnp.sum(theta[db] * phi[wb], axis=-1)
+                    p = jnp.maximum(p, 1e-30)
+                    s = s + jnp.sum(mb * jnp.log(p))
+                    return (s, t + jnp.sum(mb)), None
+
+                zero = jax.lax.pcast(jnp.float32(0), both, to="varying")
+                (s, t), _ = jax.lax.scan(
+                    block, (zero, zero), (d[0, 0], w[0, 0], m[0, 0]))
+                return (jax.lax.psum(s, both)[None],
+                        jax.lax.psum(t, both)[None])
+
+            mp_spec = (M,) if M else ()
+            s, t = jax.shard_map(
+                shard_fn, mesh=self.mesh,
+                in_specs=(P(D), P(*mp_spec), P(),
+                          P(D, *mp_spec), P(D, *mp_spec), P(D, *mp_spec)),
+                out_specs=(P(), P()),
+            )(state.n_dk, state.n_wk, state.n_k, docs, words, mask)
+            return s[0] / jnp.maximum(t[0], 1.0)
+
         self._sweep = jax.jit(sweep_fn, static_argnames=("accumulate",),
                               donate_argnums=(0,))
+        self._ll = jax.jit(ll_fn)
         self._mp_axis = M
 
     # -- sharding specs ----------------------------------------------------
@@ -348,6 +387,8 @@ class ShardedGibbsLDA:
                 start = saved.sweep + 1
         if state is None:
             state = self.init_state(sc)
+        ll_history = [(start - 1,
+                       float(self._ll(state, docs, words, mask)))]
         for s in range(start, n_sweeps):
             state = self._sweep(state, docs, words, mask,
                                 accumulate=s >= cfg.burn_in)
@@ -357,11 +398,15 @@ class ShardedGibbsLDA:
                           {k: np.asarray(v)
                            for k, v in state._asdict().items()},
                           {"fingerprint": fp, "engine": "sharded_gibbs"})
+            if s == n_sweeps - 1 or s % 10 == 9:
+                ll_history.append(
+                    (s, float(self._ll(state, docs, words, mask))))
             if callback is not None:
                 callback(s, state)
         theta, phi_wk = self.estimates(state, sc, corpus.n_docs)
         return {"state": state, "sharded_corpus": sc,
-                "theta": theta, "phi_wk": phi_wk}
+                "theta": theta, "phi_wk": phi_wk,
+                "ll_history": ll_history}
 
     def estimates(self, state: ShardedGibbsState, sc: ShardedCorpus,
                   n_docs: int) -> tuple[np.ndarray, np.ndarray]:

@@ -99,7 +99,18 @@ def fit_engine(cfg: OnixConfig, bundle: CorpusBundle, engine: str) -> dict:
         pad_to = max(int(s.size) for s in tok_sel)
         gamma_by_doc = np.full((corpus.n_docs, cfg.lda.n_topics),
                                cfg.lda.alpha, np.float32)
-        for _ in range(max(1, cfg.lda.n_sweeps // 10)):
+        # Epochs run until the predictive mean log-likelihood stops
+        # improving (relative gain < svi_epoch_tol), capped at
+        # svi_max_epochs — the convergence criterion lda-c applies to its
+        # EM loop (SURVEY.md §2.1 #10 "iterate to convergence"), which
+        # the first design replaced with a magic sweep-count fraction.
+        ll_history: list[tuple[int, float]] = []
+        prev_ll = -np.inf
+        # SVI is stochastic: an epoch can regress the full-corpus ll.
+        # Keep the best-ll parameters so a regressed final epoch is
+        # never what gets returned.
+        best = None
+        for epoch in range(cfg.lda.svi_max_epochs):
             for sel in tok_sel:
                 if sel.size == 0:
                     continue
@@ -110,9 +121,19 @@ def fit_engine(cfg: OnixConfig, bundle: CorpusBundle, engine: str) -> dict:
                 dm = np.asarray(batch.doc_map)
                 real = dm >= 0
                 gamma_by_doc[dm[real]] = gm[real]
-        theta = gamma_by_doc / gamma_by_doc.sum(1, keepdims=True)
-        return {"theta": theta, "phi_wk": np.asarray(phi_estimate(state)),
-                "ll_history": []}
+            theta = gamma_by_doc / gamma_by_doc.sum(1, keepdims=True)
+            phi_wk = np.asarray(phi_estimate(state))
+            tok_p = score_all(theta, phi_wk, corpus.doc_ids, corpus.word_ids)
+            ll = float(np.log(np.maximum(tok_p, 1e-30)).mean())
+            ll_history.append((epoch, ll))
+            if best is None or ll > best[0]:
+                best = (ll, theta, phi_wk)
+            if ll - prev_ll < cfg.lda.svi_epoch_tol * abs(prev_ll):
+                break
+            prev_ll = ll
+        _, theta, phi_wk = best
+        return {"theta": theta, "phi_wk": phi_wk,
+                "ll_history": ll_history}
     raise ValueError(f"unknown engine {engine!r}")
 
 

@@ -110,6 +110,15 @@ def test_svi_engine_runs_end_to_end(tmp_path):
         results_path(cfg.store.results_dir, "dns", cfg.pipeline.date))
     hit = len(set(results["event_idx"]) & set(anomalies.tolist())) / len(anomalies)
     assert hit >= 0.6, f"svi surfaced only {hit:.0%}"
+    # The SVI engine's manifest must carry a convergence series that
+    # actually converged (epochs stop on relative-gain, not a magic count).
+    man = json.loads(results_path(
+        cfg.store.results_dir, "dns",
+        cfg.pipeline.date).with_suffix(".manifest.json").read_text())
+    hist = man["ll_history"]
+    assert 2 <= len(hist) <= cfg.lda.svi_max_epochs
+    lls = [ll for _, ll in hist]
+    assert lls[-1] >= lls[0]
 
 
 def test_store_partition_layout(tmp_path):

@@ -66,6 +66,20 @@ def test_sharded_count_invariants(eight_devices, corpus_and_truth):
     np.testing.assert_array_equal(lengths, corpus.doc_lengths())
 
 
+def test_sharded_ll_history_improves(eight_devices, corpus_and_truth):
+    """The flagship engine must expose its convergence series (SURVEY.md
+    §5.5; lda-c's likelihood.dat) — device-side, psum-reduced."""
+    corpus, _, _ = corpus_and_truth
+    model = ShardedGibbsLDA(_cfg(n_sweeps=25, burn_in=10), corpus.n_vocab,
+                            mesh=make_mesh(dp=4, mp=2))
+    result = model.fit(corpus, n_sweeps=25)
+    hist = result["ll_history"]
+    assert len(hist) >= 3                       # init + every 10 + final
+    lls = [ll for _, ll in hist]
+    assert all(np.isfinite(lls))
+    assert lls[-1] > lls[0] + 0.05, f"no improvement: {lls}"
+
+
 def test_sharded_topic_recovery_matches_single_device(eight_devices,
                                                       corpus_and_truth):
     corpus, _, phi_true = corpus_and_truth
