@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import dataclasses
 import hashlib
+import pathlib
 
 import numpy as np
 import pandas as pd
@@ -124,7 +125,8 @@ class StreamingScorer:
     under `tol`."""
 
     def __init__(self, cfg: OnixConfig, datatype: str,
-                 n_buckets: int = 1 << 15):
+                 n_buckets: int = 1 << 15,
+                 checkpoint_dir: str | None = None, resume: bool = True):
         cfg.validate()
         self.cfg = cfg
         self.datatype = datatype
@@ -137,6 +139,72 @@ class StreamingScorer:
         k = cfg.lda.n_topics
         self._gamma = np.full((_next_pow2(1), k), cfg.lda.alpha, np.float32)
         self.pad_shapes: set[tuple[int, int]] = set()   # compile accounting
+        self._batch_no = 0
+        self.checkpoint_dir = (pathlib.Path(checkpoint_dir)
+                               if checkpoint_dir else None)
+        if self.checkpoint_dir is not None and resume:
+            self._restore_latest()
+
+    # -- checkpoint / resume (SURVEY.md §5.3-5.4) -------------------------
+    #
+    # A preempted stream must not lose the model: round 1 held SVIState,
+    # hashed-vocab params, DocTable, gamma, and the frozen edges purely
+    # in memory — the exact failure checkpointing exists to prevent.
+    # Everything needed to continue (and to score identically) persists
+    # every `lda.checkpoint_every` batches.
+
+    def _fingerprint(self) -> str:
+        from onix import checkpoint as ckpt
+
+        # checkpoint.fingerprint's sampling fields are Gibbs-oriented;
+        # the SVI schedule knobs change what this engine computes, so a
+        # checkpoint under a different schedule must not be adopted.
+        lda = self.cfg.lda
+        return ckpt.fingerprint(
+            lda, 0, self.vocab.n_buckets, 0,
+            extra={"stream_datatype": self.datatype,
+                   "n_buckets": self.vocab.n_buckets,
+                   "svi": [lda.svi_tau0, lda.svi_kappa,
+                           lda.svi_local_iters],
+                   "layout": 1})
+
+    def save_checkpoint(self) -> None:
+        from onix import checkpoint as ckpt
+        if self.checkpoint_dir is None:
+            return
+        edges = None
+        if self.edges is not None:
+            edges = {k: (v if isinstance(v, list) else np.asarray(v).tolist())
+                     for k, v in self.edges.items()}
+        ckpt.save(
+            self.checkpoint_dir / self._fingerprint(), self._batch_no,
+            {"lam": np.asarray(self.state.lam),
+             "step": np.asarray(self.state.step),
+             "gamma": self._gamma},
+            {"fingerprint": self._fingerprint(), "engine": "streaming",
+             "datatype": self.datatype,
+             "doc_keys": list(self.docs.keys),
+             "edges": edges})
+
+    def _restore_latest(self) -> bool:
+        import jax.numpy as jnp
+
+        from onix import checkpoint as ckpt
+        saved = ckpt.load_latest(self.checkpoint_dir / self._fingerprint())
+        if saved is None or saved.meta.get("fingerprint") != self._fingerprint():
+            return False
+        self.state = SVIState(lam=jnp.asarray(saved.arrays["lam"]),
+                              step=jnp.asarray(saved.arrays["step"]))
+        self._gamma = saved.arrays["gamma"].copy()
+        for ip in saved.meta["doc_keys"]:
+            self.docs.ids(np.array([ip], dtype=object))
+        edges = saved.meta.get("edges")
+        self.edges = ({k: (v if isinstance(v, list) and v
+                           and isinstance(v[0], str) else np.asarray(v))
+                       for k, v in edges.items()}
+                      if edges is not None else None)
+        self._batch_no = saved.sweep
+        return True
 
     # -- internals --------------------------------------------------------
 
@@ -209,6 +277,12 @@ class StreamingScorer:
         alerts.insert(0, "score", ev_scores[hit])
         alerts.insert(1, "event_idx", hit)
 
+        self._batch_no += 1
+        every = self.cfg.lda.checkpoint_every
+        if (self.checkpoint_dir is not None and every > 0
+                and self._batch_no % every == 0):
+            self.save_checkpoint()
+
         return BatchResult(scores=ev_scores, alerts=alerts,
                            n_events=n_events,
                            n_new_docs=self.docs.n_docs - docs_before,
@@ -225,11 +299,26 @@ def run_stream(cfg: OnixConfig, datatype: str, paths: list[str],
     from onix.ingest.run import decode
     from onix.store import results_path
 
-    scorer = StreamingScorer(cfg, datatype, n_buckets=n_buckets)
+    ck_dir = None
+    if cfg.lda.checkpoint_every > 0:
+        ck_dir = (pathlib.Path(cfg.store.checkpoint_dir) / datatype
+                  / "stream")
+    scorer = StreamingScorer(cfg, datatype, n_buckets=n_buckets,
+                             checkpoint_dir=ck_dir)
     total_events = 0
     total_alerts = 0
+    # Resume skips batches the restored checkpoint already consumed —
+    # re-processing them would double-train the model AND re-append
+    # their alert rows to the per-day CSVs.
+    done = scorer._batch_no
+    if done:
+        print(f"stream resume: skipping {done} already-processed batches")
+    batch_idx = 0
     for epoch in range(epochs):
         for p in paths:
+            batch_idx += 1
+            if batch_idx <= done:
+                continue
             table = decode(datatype, p)
             res = scorer.process(table)
             total_events += res.n_events

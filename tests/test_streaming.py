@@ -3,6 +3,8 @@ one batch vs minibatches, assert bounded score divergence") — judged
 config 4, BASELINE.json "streaming online-VB LDA over oni-ingest
 minibatches (incremental scoring)"."""
 
+import dataclasses
+
 import numpy as np
 import pandas as pd
 
@@ -114,3 +116,91 @@ def test_run_stream_cli_writes_alert_files(tmp_path):
     assert out, "no streaming alerts written"
     alerts = pd.concat([pd.read_csv(p) for p in out])
     assert "score" in alerts.columns and len(alerts) > 0
+
+
+def test_streaming_checkpoint_resume_identical_scores(tmp_path):
+    """Kill-and-resume: a stream checkpointed every batch, killed after
+    batch 4, and resumed in a FRESH process-equivalent scorer must score
+    the remaining batches identically to an uninterrupted stream
+    (SURVEY.md §5.3-5.4 for the streaming path)."""
+    table, _ = synth_flow_day(n_events=4000, n_hosts=80, n_anomalies=15,
+                              seed=11)
+    chunks = [table.iloc[i:i + 500].reset_index(drop=True)
+              for i in range(0, 4000, 500)]
+    cfg = _cfg(checkpoint_every=1)
+    ck = tmp_path / "ck"
+
+    # Uninterrupted reference (no checkpointing side effects on math).
+    ref = StreamingScorer(cfg, "flow", n_buckets=1 << 12)
+    ref_scores = [ref.process(ch).scores for ch in chunks]
+
+    # Interrupted: process 4 batches, checkpoint each, then "die".
+    first = StreamingScorer(cfg, "flow", n_buckets=1 << 12,
+                            checkpoint_dir=ck)
+    for ch in chunks[:4]:
+        first.process(ch)
+    del first
+
+    # Fresh scorer resumes from the checkpoint and continues.
+    resumed = StreamingScorer(cfg, "flow", n_buckets=1 << 12,
+                              checkpoint_dir=ck)
+    assert resumed._batch_no == 4
+    assert resumed.docs.n_docs > 0
+    assert resumed.edges is not None        # frozen edges survived
+    for i, ch in enumerate(chunks[4:], start=4):
+        got = resumed.process(ch).scores
+        np.testing.assert_allclose(got, ref_scores[i], rtol=1e-5,
+                                   err_msg=f"batch {i} diverged")
+
+
+def test_streaming_checkpoint_rejects_other_config(tmp_path):
+    """A checkpoint from different sampling hyperparams must not be
+    adopted (fingerprint mismatch -> fresh model)."""
+    table, _ = synth_flow_day(n_events=1000, n_hosts=40, n_anomalies=5,
+                              seed=3)
+    ck = tmp_path / "ck"
+    a = StreamingScorer(_cfg(checkpoint_every=1), "flow",
+                        n_buckets=1 << 12, checkpoint_dir=ck)
+    a.process(table)
+    b = StreamingScorer(_cfg(checkpoint_every=1, n_topics=7), "flow",
+                        n_buckets=1 << 12, checkpoint_dir=ck)
+    assert b._batch_no == 0                 # nothing adopted
+    # The SVI schedule is part of the streaming identity too.
+    c = StreamingScorer(_cfg(checkpoint_every=1, svi_kappa=0.9), "flow",
+                        n_buckets=1 << 12, checkpoint_dir=ck)
+    assert c._batch_no == 0
+
+
+def test_run_stream_resume_skips_processed_files(tmp_path):
+    """A restarted run_stream must not double-train on (or re-alert for)
+    files its checkpoint already consumed."""
+    from onix.ingest.nfdecode import write_v5
+    from onix.pipelines.streaming import run_stream
+
+    table, _ = synth_flow_day(n_events=900, n_hosts=40, n_anomalies=5,
+                              seed=2)
+    epoch = (pd.to_datetime(table["treceived"]).astype(np.int64)
+             / 1e9).to_numpy()
+    table = table.assign(start_ts=epoch, end_ts=epoch + 10.0)
+    paths = []
+    for i in range(3):
+        p = tmp_path / f"chunk{i}.nf5"
+        p.write_bytes(write_v5(
+            table.iloc[i * 300:(i + 1) * 300].reset_index(drop=True)))
+        paths.append(str(p))
+    cfg = _cfg(checkpoint_every=1)
+    cfg = dataclasses.replace(
+        cfg, store=dataclasses.replace(
+            cfg.store, checkpoint_dir=str(tmp_path / "ck"),
+            results_dir=str(tmp_path / "res")))
+    run_stream(cfg, "flow", paths[:2])      # "crash" after 2 files
+    scorer_probe = StreamingScorer(cfg, "flow",
+                                   checkpoint_dir=tmp_path / "ck" / "flow"
+                                   / "stream")
+    assert scorer_probe._batch_no == 2
+    run_stream(cfg, "flow", paths)          # restart with the full list
+    final = StreamingScorer(cfg, "flow",
+                            checkpoint_dir=tmp_path / "ck" / "flow"
+                            / "stream")
+    # 2 from the first run + only the 1 unseen file from the second.
+    assert final._batch_no == 3
