@@ -1,0 +1,192 @@
+// onix-pcapdns — minimal pcap -> DNS-reply field extractor.
+//
+// The reference's DNS ingest runs tshark field-extraction over pcaps
+// (SURVEY.md §3.2; reference README.md:30-33 "DNS pcaps"). tshark is a
+// heavyweight dependency; this native extractor emits the exact same
+// tab-separated field rows tshark would with
+//   -T fields -e frame.time_epoch -e frame.len -e ip.src -e ip.dst
+//   -e dns.qry.name -e dns.qry.type -e dns.flags.rcode
+// for the packets the pipeline consumes: UDP/IPv4 DNS *responses*
+// (QR=1 — "analysis of network flows and DNS replies", README.md:25).
+// The ingest path drives real tshark when installed and falls back to
+// this binary, so the TSV contract is identical either way
+// (onix/ingest/pcap.py).
+//
+// Format coverage: classic pcap (magic a1b2c3d4 / d4c3b2a1, plus the
+// a1b23c4d nanosecond variant), Ethernet II with optional single
+// 802.1Q VLAN tag, IPv4 (any IHL, non-fragmented), UDP src or dst port
+// 53. Question-section names are plain label sequences per RFC 1035
+// §4.1.2 (compression pointers, legal but rare in questions, terminate
+// the name defensively). Malformed packets are skipped, never fatal —
+// a capture with junk in the middle still yields its good rows
+// (tshark's behavior too).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+uint16_t be16(const uint8_t* p) { return (uint16_t)((p[0] << 8) | p[1]); }
+uint32_t rd32(const uint8_t* p, bool swap) {
+  uint32_t v;
+  std::memcpy(&v, p, 4);
+  if (swap) v = __builtin_bswap32(v);
+  return v;
+}
+uint16_t rd16(const uint8_t* p, bool swap) {
+  uint16_t v;
+  std::memcpy(&v, p, 2);
+  if (swap) v = __builtin_bswap16(v);
+  return v;
+}
+
+void ip_str(uint32_t ip, char* out) {
+  std::snprintf(out, 16, "%u.%u.%u.%u", (ip >> 24) & 255, (ip >> 16) & 255,
+                (ip >> 8) & 255, ip & 255);
+}
+
+// Parse the first question name at `off`; returns false on malformed.
+bool qname(const uint8_t* dns, size_t dns_len, size_t* off,
+           std::string* out) {
+  out->clear();
+  size_t o = *off;
+  while (true) {
+    if (o >= dns_len) return false;
+    const uint8_t len = dns[o];
+    if (len == 0) { ++o; break; }
+    if ((len & 0xC0) == 0xC0) {       // compression pointer: stop here
+      o += 2;
+      break;
+    }
+    if (len > 63 || o + 1 + len > dns_len) return false;
+    if (!out->empty()) out->push_back('.');
+    for (size_t i = 0; i < len; ++i) {
+      const char c = (char)dns[o + 1 + i];
+      // control chars would corrupt the TSV contract
+      out->push_back((c >= 0x20 && c != 0x7f && c != '\t') ? c : '?');
+    }
+    o += 1 + (size_t)len;
+    if (out->size() > 1024) return false;
+  }
+  *off = o;
+  return true;
+}
+
+}  // namespace
+
+extern "C" int64_t pcapdns_extract(const uint8_t* buf, int64_t len,
+                                   FILE* out) {
+  if (len < 24) return -1;
+  const uint32_t magic_raw = rd32(buf, false);
+  bool swap, nanos;
+  switch (magic_raw) {
+    case 0xA1B2C3D4u: swap = false; nanos = false; break;
+    case 0xD4C3B2A1u: swap = true;  nanos = false; break;
+    case 0xA1B23C4Du: swap = false; nanos = true;  break;
+    case 0x4D3CB2A1u: swap = true;  nanos = true;  break;
+    default: return -1;
+  }
+  const uint32_t linktype = rd32(buf + 20, swap);
+  if (linktype != 1) return -1;       // DLT_EN10MB only
+  int64_t emitted = 0;
+  size_t off = 24;
+  while (off + 16 <= (size_t)len) {
+    const uint32_t ts_sec = rd32(buf + off, swap);
+    const uint32_t ts_frac = rd32(buf + off + 4, swap);
+    const uint32_t incl = rd32(buf + off + 8, swap);
+    const uint32_t orig = rd32(buf + off + 12, swap);
+    off += 16;
+    if (incl > 1 << 22 || off + incl > (size_t)len) return -1;  // torn file
+    const uint8_t* pkt = buf + off;
+    off += incl;
+
+    // Ethernet II (+ optional one 802.1Q tag)
+    if (incl < 14) continue;
+    size_t l2 = 12;
+    uint16_t etype = be16(pkt + l2);
+    l2 += 2;
+    if (etype == 0x8100) {
+      if (incl < l2 + 4) continue;
+      etype = be16(pkt + l2 + 2);
+      l2 += 4;
+    }
+    if (etype != 0x0800) continue;    // IPv4 only
+
+    if (incl < l2 + 20) continue;
+    const uint8_t* ip = pkt + l2;
+    if ((ip[0] >> 4) != 4) continue;
+    const size_t ihl = (size_t)(ip[0] & 0x0F) * 4;
+    if (ihl < 20 || incl < l2 + ihl + 8) continue;
+    if (ip[9] != 17) continue;        // UDP
+    const uint16_t frag = be16(ip + 6);
+    if (frag & 0x1FFF) continue;      // non-first fragment
+    const uint32_t src = ((uint32_t)ip[12] << 24) | (ip[13] << 16) |
+                         (ip[14] << 8) | ip[15];
+    const uint32_t dst = ((uint32_t)ip[16] << 24) | (ip[17] << 16) |
+                         (ip[18] << 8) | ip[19];
+
+    const uint8_t* udp = ip + ihl;
+    const uint16_t sport = be16(udp);
+    const uint16_t dport = be16(udp + 2);
+    if (sport != 53 && dport != 53) continue;
+    const size_t udp_len = be16(udp + 4);
+    if (udp_len < 8 || l2 + ihl + udp_len > incl) continue;
+
+    const uint8_t* dns = udp + 8;
+    const size_t dns_len = udp_len - 8;
+    if (dns_len < 12) continue;
+    const uint16_t flags = be16(dns + 2);
+    if (!(flags & 0x8000)) continue;  // responses (QR=1) only
+    const uint16_t qdcount = be16(dns + 4);
+    if (qdcount < 1) continue;
+    size_t qoff = 12;
+    std::string name;
+    if (!qname(dns, dns_len, &qoff, &name)) continue;
+    if (qoff + 4 > dns_len) continue;
+    const uint16_t qtype = be16(dns + qoff);
+    const uint16_t rcode = flags & 0x000F;
+
+    char a[16], b[16];
+    ip_str(src, a);
+    ip_str(dst, b);
+    const double ts = (double)ts_sec +
+                      (double)ts_frac / (nanos ? 1e9 : 1e6);
+    std::fprintf(out, "%.6f\t%u\t%s\t%s\t%s\t%u\t%u\n", ts, orig, a, b,
+                 name.c_str(), qtype, rcode);
+    ++emitted;
+  }
+  return emitted;
+}
+
+#ifndef ONIX_PCAPDNS_NO_MAIN
+int main(int argc, char** argv) {
+  if (argc != 2) {
+    std::fprintf(stderr, "usage: %s <capture.pcap>\n", argv[0]);
+    return 2;
+  }
+  FILE* f = std::fopen(argv[1], "rb");
+  if (!f) {
+    std::perror(argv[1]);
+    return 1;
+  }
+  std::fseek(f, 0, SEEK_END);
+  const long sz = std::ftell(f);
+  std::fseek(f, 0, SEEK_SET);
+  std::vector<uint8_t> buf((size_t)(sz > 0 ? sz : 0));
+  if (sz > 0 && std::fread(buf.data(), 1, (size_t)sz, f) != (size_t)sz) {
+    std::fclose(f);
+    std::fprintf(stderr, "short read\n");
+    return 1;
+  }
+  std::fclose(f);
+  const int64_t n = pcapdns_extract(buf.data(), sz, stdout);
+  if (n < 0) {
+    std::fprintf(stderr, "not a pcap file (or torn/unsupported capture)\n");
+    return 1;
+  }
+  return 0;
+}
+#endif

@@ -1,0 +1,134 @@
+"""DNS pcap ingest: tshark when installed, the native extractor always.
+
+The reference ingests DNS *pcaps* via tshark field-extraction
+(SURVEY.md §3.2; reference README.md:30-33). onix accepts a `.pcap`
+directly: `extract_dns_tsv` drives real tshark as a subprocess when it
+exists on PATH (same field list the reference used), otherwise the
+native `onix-pcapdns` binary — both emit identical TSV, parsed by the
+one `parse_tshark_dns` contract. `write_dns_pcap` synthesizes captures
+for round-trip tests (the environment ships no pcap fixtures).
+"""
+
+from __future__ import annotations
+
+import pathlib
+import shutil
+import struct
+import subprocess
+
+import numpy as np
+import pandas as pd
+
+_NATIVE_DIR = pathlib.Path(__file__).parent.parent.parent / "native" / "pcapdns"
+_BIN_PATH = _NATIVE_DIR / "build" / "pcapdns"
+
+TSHARK_ARGS = [
+    "-T", "fields", "-e", "frame.time_epoch", "-e", "frame.len",
+    "-e", "ip.src", "-e", "ip.dst", "-e", "dns.qry.name",
+    "-e", "dns.qry.type", "-e", "dns.flags.rcode",
+    "-Y", "dns.flags.response == 1 && ip && udp",
+]
+
+
+class PcapUnavailable(RuntimeError):
+    pass
+
+
+def _build_native() -> None:
+    src = _NATIVE_DIR / "pcapdns.cpp"
+    if (_BIN_PATH.exists()
+            and _BIN_PATH.stat().st_mtime >= src.stat().st_mtime):
+        return
+    try:
+        subprocess.run(["make", "-C", str(_NATIVE_DIR)], check=True,
+                       capture_output=True)
+    except (OSError, subprocess.CalledProcessError) as e:
+        raise PcapUnavailable(f"cannot build onix-pcapdns: {e}") from e
+
+
+def extract_dns_tsv(pcap_path: str | pathlib.Path) -> str:
+    """pcap -> tshark-format TSV rows (DNS responses only)."""
+    pcap_path = str(pcap_path)
+    tshark = shutil.which("tshark")
+    if tshark:
+        p = subprocess.run([tshark, "-r", pcap_path, *TSHARK_ARGS],
+                           capture_output=True, text=True, timeout=600)
+        if p.returncode == 0:
+            return p.stdout
+        # fall through: a tshark that cannot read the file gets the
+        # native decoder's (stricter) error instead
+    _build_native()
+    p = subprocess.run([str(_BIN_PATH), pcap_path], capture_output=True,
+                       text=True, timeout=600)
+    if p.returncode != 0:
+        raise ValueError(f"{pcap_path}: {p.stderr.strip() or 'decode failed'}")
+    return p.stdout
+
+
+def parse_dns_pcap(pcap_path: str | pathlib.Path) -> pd.DataFrame:
+    """pcap -> the dns table schema (via the shared TSV contract)."""
+    import tempfile
+
+    from onix.ingest.parsers import parse_tshark_dns
+
+    tsv = extract_dns_tsv(pcap_path)
+    with tempfile.NamedTemporaryFile("w", suffix=".tsv", delete=False) as f:
+        f.write(tsv)
+        tmp = f.name
+    try:
+        return parse_tshark_dns(tmp)
+    finally:
+        pathlib.Path(tmp).unlink(missing_ok=True)
+
+
+# -- synthesized captures for round-trip tests ------------------------------
+
+
+def _dns_response(qname: str, qtype: int, rcode: int) -> bytes:
+    flags = 0x8000 | (rcode & 0xF)           # QR=1
+    hdr = struct.pack(">HHHHHH", 0x1234, flags, 1, 0, 0, 0)
+    q = b""
+    for label in qname.strip(".").split("."):
+        enc = label.encode()
+        q += bytes([len(enc)]) + enc
+    q += b"\x00" + struct.pack(">HH", qtype, 1)
+    return hdr + q
+
+
+def _ip_u32(s: str) -> int:
+    a, b, c, d = (int(x) for x in s.split("."))
+    return (a << 24) | (b << 16) | (c << 8) | d
+
+
+def write_dns_pcap(table: pd.DataFrame, nanos: bool = False) -> bytes:
+    """Encode dns rows (ip_src?, ip_dst, dns_qry_name, dns_qry_type,
+    dns_qry_rcode, frame_time or epoch) as an Ethernet/IPv4/UDP pcap of
+    DNS responses. frame_len in the OUTPUT equals the synthesized
+    packet's length (self-consistent round trip)."""
+    magic = 0xA1B23C4D if nanos else 0xA1B2C3D4
+    out = bytearray(struct.pack("<IHHiIII", magic, 2, 4, 0, 0, 1 << 16, 1))
+    if "frame_time_epoch" in table:
+        epochs = table["frame_time_epoch"].to_numpy(np.float64)
+    else:
+        epochs = (pd.to_datetime(table["frame_time"]).astype(np.int64)
+                  / 1e9).to_numpy()
+    srcs = (table["ip_src"] if "ip_src" in table
+            else pd.Series(["192.0.2.53"] * len(table)))
+    for i in range(len(table)):
+        dns = _dns_response(str(table["dns_qry_name"].iloc[i]),
+                            int(table["dns_qry_type"].iloc[i]),
+                            int(table["dns_qry_rcode"].iloc[i]))
+        udp = struct.pack(">HHHH", 53, 33333, 8 + len(dns), 0) + dns
+        total = 20 + len(udp)
+        ip = struct.pack(">BBHHHBBHII", 0x45, 0, total, 0, 0, 64, 17, 0,
+                         _ip_u32(str(srcs.iloc[i])),
+                         _ip_u32(str(table["ip_dst"].iloc[i])))
+        eth = b"\x02" * 6 + b"\x04" * 6 + struct.pack(">H", 0x0800)
+        pkt = eth + ip + udp
+        sec = int(epochs[i])
+        frac = epochs[i] - sec
+        out += struct.pack("<IIII", sec,
+                           int(frac * (1e9 if nanos else 1e6)),
+                           len(pkt), len(pkt))
+        out += pkt
+    return bytes(out)

@@ -26,6 +26,11 @@ def decode(datatype: str, path: str | pathlib.Path) -> pd.DataFrame:
         from onix.ingest.nfdecode import decode_file
         return decode_file(path)
     if datatype == "dns":
+        # .pcap goes through tshark-or-native extraction (SURVEY.md
+        # §3.2 DNS variant); anything else is pre-extracted tshark TSV.
+        if str(path).endswith((".pcap", ".pcapng", ".cap")):
+            from onix.ingest.pcap import parse_dns_pcap
+            return parse_dns_pcap(path)
         from onix.ingest.parsers import parse_tshark_dns
         return parse_tshark_dns(path)
     if datatype == "proxy":

@@ -1,0 +1,116 @@
+"""DNS pcap ingest round-trip (SURVEY.md §3.2 DNS variant).
+
+No pcap fixtures ship with the environment, so captures are synthesized
+by onix.ingest.pcap.write_dns_pcap and round-tripped through the
+extractor (native binary here; real tshark follows the identical TSV
+contract when installed)."""
+
+import pathlib
+import shutil
+import struct
+
+import numpy as np
+import pandas as pd
+import pytest
+
+pcap = pytest.importorskip("onix.ingest.pcap")
+
+try:
+    pcap._build_native()
+    HAVE = True
+except pcap.PcapUnavailable:
+    HAVE = False
+
+pytestmark = pytest.mark.skipif(not HAVE, reason="g++/make unavailable")
+
+
+def _table(n=25, seed=4):
+    rng = np.random.default_rng(seed)
+    names = [f"host{i}.example.com" for i in range(n)]
+    names[3] = "deep.sub.domain.test.org"
+    return pd.DataFrame({
+        "frame_time_epoch": 1467936000.0 + np.arange(n) * 7.25,
+        "ip_src": [f"192.0.2.{i % 4 + 1}" for i in range(n)],
+        "ip_dst": [f"10.0.0.{i % 9 + 1}" for i in range(n)],
+        "dns_qry_name": names,
+        "dns_qry_type": rng.choice([1, 28, 15], n),
+        "dns_qry_rcode": rng.choice([0, 0, 0, 3], n),
+    })
+
+
+def test_pcap_roundtrip(tmp_path):
+    t = _table()
+    p = tmp_path / "dns.pcap"
+    p.write_bytes(pcap.write_dns_pcap(t))
+    out = pcap.parse_dns_pcap(p)
+    assert len(out) == len(t)
+    assert out["dns_qry_name"].tolist() == t["dns_qry_name"].tolist()
+    assert out["ip_dst"].tolist() == t["ip_dst"].tolist()
+    np.testing.assert_array_equal(out["dns_qry_type"].to_numpy(),
+                                  t["dns_qry_type"].to_numpy())
+    np.testing.assert_array_equal(out["dns_qry_rcode"].to_numpy(),
+                                  t["dns_qry_rcode"].to_numpy())
+    # frame_time preserved to the second
+    assert out["frame_time"].iloc[0] == "2016-07-08 00:00:00"
+
+
+def test_pcap_nanosecond_variant(tmp_path):
+    t = _table(n=5)
+    p = tmp_path / "dns_ns.pcap"
+    p.write_bytes(pcap.write_dns_pcap(t, nanos=True))
+    out = pcap.parse_dns_pcap(p)
+    assert len(out) == 5
+
+
+def test_pcap_skips_non_dns_and_queries(tmp_path):
+    t = _table(n=6)
+    blob = bytearray(pcap.write_dns_pcap(t))
+    # Flip one packet's DNS QR bit to 0 (a query): find the first DNS
+    # header = after global(24) + rec(16) + eth(14) + ip(20) + udp(8),
+    # flags at +2.
+    off = 24 + 16 + 14 + 20 + 8 + 2
+    blob[off] &= 0x7F
+    p = tmp_path / "mixed.pcap"
+    p.write_bytes(bytes(blob))
+    out = pcap.parse_dns_pcap(p)
+    assert len(out) == 5                     # the query is filtered out
+
+
+def test_pcap_torn_file_rejected(tmp_path):
+    t = _table(n=4)
+    blob = pcap.write_dns_pcap(t)
+    p = tmp_path / "torn.pcap"
+    p.write_bytes(blob[: len(blob) - 11])
+    with pytest.raises(ValueError):
+        pcap.parse_dns_pcap(p)
+    q = tmp_path / "not.pcap"
+    q.write_bytes(b"\x00" * 64)
+    with pytest.raises(ValueError):
+        pcap.parse_dns_pcap(q)
+
+
+def test_ingest_decode_dispatches_pcap(tmp_path):
+    from onix.ingest.run import decode
+
+    t = _table(n=8)
+    p = tmp_path / "day.pcap"
+    p.write_bytes(pcap.write_dns_pcap(t))
+    out = decode("dns", p)
+    assert len(out) == 8
+    assert set(out.columns) >= {"frame_time", "frame_len", "ip_dst",
+                                "dns_qry_name", "dns_qry_type",
+                                "dns_qry_rcode"}
+
+
+def test_pcap_dns_feeds_word_pipeline(tmp_path):
+    """pcap -> table -> dns words: the full DNS variant path."""
+    from onix.pipelines.corpus_build import build_corpus
+    from onix.pipelines.words import dns_words
+
+    t = _table(n=40)
+    p = tmp_path / "day.pcap"
+    p.write_bytes(pcap.write_dns_pcap(t))
+    table = pcap.parse_dns_pcap(p)
+    bundle = build_corpus(dns_words(table))
+    assert bundle.corpus.n_tokens == 40
+    assert bundle.corpus.n_docs == 9         # distinct client IPs
