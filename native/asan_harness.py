@@ -1,0 +1,167 @@
+#!/usr/bin/env python3
+"""Sanitized-suite harness (SURVEY.md §5.2): drives BOTH native CLI
+binaries built with -fsanitize=address,undefined through their happy
+paths and their malformed-input paths. ASan/UBSan findings abort the
+process with a nonzero exit and a report on stderr, so "exit code is
+what the contract says and stderr carries no sanitizer report" IS the
+assertion.
+
+Run via `make -C native asan-test` (also wrapped by
+tests/test_native_asan.py). Stdlib only — the harness must not depend
+on the repo's Python package (it tests the binaries, not the wrappers).
+"""
+
+import pathlib
+import struct
+import subprocess
+import sys
+import tempfile
+
+HERE = pathlib.Path(__file__).parent
+LDA = HERE / "lda_ref" / "build-asan" / "lda_ref"
+NFD = HERE / "nfdecode" / "build-asan" / "nfdecode"
+PCD = HERE / "pcapdns" / "build-asan" / "pcapdns"
+FAILED = []
+
+
+def run(binary, args, expect_rc, tag, stdin_ok_empty=True):
+    p = subprocess.run([str(binary), *map(str, args)], capture_output=True,
+                       text=True, timeout=300)
+    sanitizer = ("ERROR: AddressSanitizer" in p.stderr
+                 or "runtime error:" in p.stderr
+                 or "ERROR: LeakSanitizer" in p.stderr)
+    ok = (p.returncode == expect_rc) and not sanitizer
+    print(f"[{'ok' if ok else 'FAIL'}] {tag}: rc={p.returncode} "
+          f"(want {expect_rc}){' SANITIZER REPORT' if sanitizer else ''}")
+    if not ok:
+        sys.stderr.write(p.stderr[-2000:] + "\n")
+        FAILED.append(tag)
+    return p
+
+
+def v5_blob(n=7):
+    """Minimal valid NetFlow v5 export packet stream."""
+    out = b""
+    hdr = struct.pack(">HHIIIIBBH", 5, n, 3_600_000, 1467936000, 0, 0, 0, 0, 0)
+    recs = b""
+    for i in range(n):
+        recs += struct.pack(">IIIHHIIIIHHBBBBHHBBH",
+                            (10 << 24) | i, (192 << 24) | i, 0, 0, 0,
+                            5 + i, 1000 + i, 3_500_000, 3_590_000,
+                            1024 + i, 443, 0, 0x18, 6, 0, 0, 0, 24, 24, 0)
+    return hdr + recs
+
+
+def v9_blob(pad_template=False):
+    """One v9 packet: template (optionally zero-padded) + 2 records."""
+    fields = [(8, 4), (12, 4), (7, 2), (11, 2), (4, 1), (6, 1),
+              (2, 4), (1, 4), (22, 4), (21, 4)]
+    tpl = struct.pack(">HH", 256, len(fields))
+    for t, ln in fields:
+        tpl += struct.pack(">HH", t, ln)
+    if pad_template:
+        tpl += b"\0" * 4
+    tpl_set = struct.pack(">HH", 0, 4 + len(tpl)) + tpl
+    rec = struct.pack(">IIHHBBIIII", 10 << 24, 192 << 24, 1024, 443, 6,
+                      0x18, 5, 1000, 3_500_000, 3_590_000)
+    data_set = struct.pack(">HH", 256, 4 + 2 * len(rec)) + rec + rec
+    hdr = struct.pack(">HHIIII", 9, 3, 3_600_000, 1467936000, 0, 0)
+    return hdr + tpl_set + data_set
+
+
+def dns_pcap_blob(truncate=0):
+    """One-response DNS pcap (Ethernet/IPv4/UDP), optionally torn."""
+    name = b"\x03www\x07example\x03com\x00"
+    dns = struct.pack(">HHHHHH", 0x1234, 0x8180, 1, 0, 0, 0) + name + \
+        struct.pack(">HH", 1, 1)
+    udp = struct.pack(">HHHH", 53, 40000, 8 + len(dns), 0) + dns
+    ip = struct.pack(">BBHHHBBHII", 0x45, 0, 20 + len(udp), 0, 0, 64, 17,
+                     0, 0xC0000235, 0x0A000001)
+    eth = b"\x02" * 6 + b"\x04" * 6 + struct.pack(">H", 0x0800)
+    pkt = eth + ip + udp
+    hdr = struct.pack("<IHHiIII", 0xA1B2C3D4, 2, 4, 0, 0, 1 << 16, 1)
+    rec = struct.pack("<IIII", 1467936000, 0, len(pkt), len(pkt))
+    blob = hdr + rec + pkt
+    return blob[: len(blob) - truncate] if truncate else blob
+
+
+def main() -> int:
+    for b in (LDA, NFD, PCD):
+        if not b.exists():
+            print(f"missing sanitized binary {b} — run `make asan` first")
+            return 2
+    tmp = pathlib.Path(tempfile.mkdtemp(prefix="onix-asan-"))
+
+    # -- pcapdns ----------------------------------------------------------
+    for name, blob, rc in [
+        ("dns response", dns_pcap_blob(), 0),
+        ("torn record", dns_pcap_blob(truncate=9), 1),
+        ("not a pcap", b"\x00" * 48, 1),
+        ("header only", dns_pcap_blob()[:24], 0),   # empty capture is fine
+        ("tiny file", b"\xa1", 1),
+    ]:
+        p = tmp / "cap.pcap"
+        p.write_bytes(blob)
+        run(PCD, [p], rc, f"pcapdns: {name}")
+    run(PCD, [], 2, "pcapdns: no args")
+
+    # -- nfdecode ---------------------------------------------------------
+    for name, blob, rc in [
+        ("v5 happy path", v5_blob(), 0),
+        ("v9 happy path", v9_blob(), 0),
+        ("v9 padded template (RFC 3954 §5.2)", v9_blob(pad_template=True), 0),
+        # contract: an empty capture is malformed (matches nfdump; a
+        # zero-byte file at ingest means a broken exporter, not a quiet day)
+        ("empty file", b"", 1),
+        ("truncated v5", v5_blob()[:31], 1),
+        ("truncated v9 set", v9_blob()[:-7], 1),
+        ("garbage", b"\xff" * 97, 1),
+        ("v9 oversized template count",
+         struct.pack(">HHIIII", 9, 1, 0, 0, 0, 0)
+         + struct.pack(">HH", 0, 12) + struct.pack(">HH", 256, 60000), 1),
+    ]:
+        p = tmp / "cap.bin"
+        p.write_bytes(blob)
+        run(NFD, [p], rc, f"nfdecode: {name}")
+    run(NFD, [tmp / "does-not-exist"], 1, "nfdecode: missing file")
+    run(NFD, [], 2, "nfdecode: no args")
+
+    # -- lda_ref ----------------------------------------------------------
+    corpus = tmp / "corpus.ldac"
+    import random
+    rng = random.Random(7)
+    lines = []
+    for _ in range(40):
+        n_terms = rng.randint(1, 12)
+        pairs = {rng.randrange(60): rng.randint(1, 4) for _ in range(n_terms)}
+        lines.append(f"{len(pairs)} " +
+                     " ".join(f"{w}:{c}" for w, c in pairs.items()))
+    corpus.write_text("\n".join(lines) + "\n")
+    for mode in ("gibbs", "vem"):
+        out = tmp / mode
+        out.mkdir()
+        run(LDA, [mode, 5, 0.5, 0.05, 15, 1, corpus, out, 60],
+            0, f"lda_ref: {mode} happy path")
+        assert (out / "final.gamma").exists()
+
+    bad = tmp / "bad.ldac"
+    bad.write_text("1 -3:2\n")
+    run(LDA, ["gibbs", 5, 0.5, 0.05, 5, 1, bad, tmp], 1,
+        "lda_ref: negative word id rejected")
+    bad2 = tmp / "bad2.ldac"
+    bad2.write_text("2 1:1\n")          # count promises 2 pairs, has 1
+    run(LDA, ["gibbs", 5, 0.5, 0.05, 5, 1, bad2, tmp], 1,
+        "lda_ref: short line rejected")
+    run(LDA, ["nope", 5, 0.5, 0.05, 5, 1, corpus, tmp], 1,
+        "lda_ref: unknown mode")
+    run(LDA, [], 2, "lda_ref: no args")
+
+    if FAILED:
+        print(f"\n{len(FAILED)} sanitized checks FAILED: {FAILED}")
+        return 1
+    print("\nall sanitized checks passed")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

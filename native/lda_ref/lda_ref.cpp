@@ -510,6 +510,10 @@ int main(int argc, char** argv) {
     return 2;
   }
   const std::string mode = argv[1];
+  if (mode != "gibbs" && mode != "vem") {
+    std::fprintf(stderr, "unknown mode %s (want gibbs|vem)\n", mode.c_str());
+    return 1;
+  }
   const int K = std::atoi(argv[2]);
   const double alpha = std::atof(argv[3]);
   const double eta = std::atof(argv[4]);

@@ -1,0 +1,23 @@
+"""Sanitizer discipline (SURVEY.md §5.2): both native components build
+with ASan/UBSan and their CLI binaries survive the malformed-input
+harness under the sanitizers. The harness itself lives in
+native/asan_harness.py so `make -C native asan-test` runs identically
+outside pytest."""
+
+import pathlib
+import shutil
+import subprocess
+
+import pytest
+
+NATIVE = pathlib.Path(__file__).parent.parent / "native"
+
+pytestmark = pytest.mark.skipif(shutil.which("g++") is None,
+                                reason="g++ unavailable")
+
+
+def test_asan_suite_passes():
+    p = subprocess.run(["make", "-C", str(NATIVE), "asan-test"],
+                       capture_output=True, text=True, timeout=900)
+    assert p.returncode == 0, p.stdout[-3000:] + p.stderr[-2000:]
+    assert "all sanitized checks passed" in p.stdout
