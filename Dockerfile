@@ -1,0 +1,40 @@
+# onix demo image — parity with the reference's `oni-demo` container
+# (reference README.md:50-62: a self-contained image with precomputed
+# example data served on :8889).
+#
+#   docker build -t onix-demo .
+#   docker run -p 8889:8889 onix-demo
+#
+# then open http://localhost:8889/flow/suspicious.html#date=2016-07-08
+#
+# The build synthesizes the demo day at image-build time (the modern
+# rendering of the reference's canned 2016-07-08 dataset), so `docker
+# run` serves instantly. CPU-only JAX: the demo is small; TPU wheels are
+# for real deployments. NOTE: built/tested in a network-enabled
+# environment; this repo's CI sandbox has no egress, so the image build
+# is exercised out-of-band.
+
+FROM python:3.12-slim
+
+RUN apt-get update \
+ && apt-get install -y --no-install-recommends g++ make \
+ && rm -rf /var/lib/apt/lists/*
+
+WORKDIR /opt/onix
+COPY pyproject.toml ./
+RUN pip install --no-cache-dir \
+    "jax[cpu]" numpy pandas pyarrow
+
+COPY onix ./onix
+COPY native ./native
+COPY docs ./docs
+RUN make -C native
+
+# Precompute the demo day (flow+dns+proxy scored and OA-enriched).
+ENV JAX_PLATFORMS=cpu PYTHONPATH=/opt/onix
+RUN python -m onix.cli demo -s store.root=/opt/onix/data
+
+EXPOSE 8889
+CMD ["python", "-m", "onix.cli", "serve", \
+     "-s", "store.root=/opt/onix/data", "--port", "8889", \
+     "--host", "0.0.0.0"]

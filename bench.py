@@ -1,19 +1,30 @@
 """onix benchmark — judged metric: netflow events scored/sec/chip.
 
-Measures the post-LDA suspicious-connects scoring scan (SURVEY.md §3.1
+Headline: the post-LDA suspicious-connects scoring scan (SURVEY.md §3.1
 hot loop #3 — the throughput path that touches every raw event,
-reference README.md:42 "filter billion of events to a few thousands")
-on the available accelerator.
+reference README.md:42 "filter billion of events to a few thousands"),
+uniform-random worst case, identical shape to round 1 for
+round-over-round comparability.
+
+detail carries the rest of the judged story:
+  * gibbs_sweep       — hot loop #2, tokens sampled/s/chip (the sweep
+                        was unmeasured before round 2)
+  * scoring_zipf_table — realistic Zipf telemetry at product vocabulary
+                        size, through the PRODUCT score_all path (the
+                        θ·φᵀ-table MXU strategy engages)
+  * scoring_zipf_dedup — Zipf telemetry at a table-too-big shape, where
+                        the unique-pair dedup strategy engages
 
 Methodology notes (hard-won on the tunneled TPU):
 - `block_until_ready` does not reliably synchronize through the remote
   device tunnel, and a single dispatch carries a ~65-70 ms host RTT.
-  The timed region therefore chains `REPS` full scoring passes inside
-  ONE jitted program (lax.scan) and forces one final host transfer, so
-  per-pass numbers amortize the RTT to <3%.
-- Each pass perturbs the event indices with the loop counter; a
-  loop-invariant body would be hoisted/CSE'd by XLA and the measurement
-  would report fantasy numbers (observed: 1000x inflation).
+  Device-side rates therefore chain `REPS` full passes inside ONE
+  jitted program (lax.scan) and force one final host transfer, so
+  per-pass numbers amortize the RTT to <3%. Host-inclusive rates
+  (the product-path variants) are plain wall-clock.
+- Each pass perturbs its inputs with the loop counter; a loop-invariant
+  body would be hoisted/CSE'd by XLA and the measurement would report
+  fantasy numbers (observed: 1000x inflation).
 
 Baseline (BASELINE.md): the reference published NO numbers; the
 operative stand-in for its 20-node CPU cluster is 20x a single-core
@@ -21,7 +32,7 @@ vectorized NumPy scorer measured on this host, which is generous to the
 reference (its Scala/Spark scoring had JVM + shuffle overhead on top).
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "detail": {...}}
 """
 
 from __future__ import annotations
@@ -44,28 +55,26 @@ def _numpy_scoring_rate(theta, phi_wk, n_events=1 << 21, seed=1) -> float:
     return n_events / dt
 
 
-def main() -> None:
-    import jax
-    import jax.numpy as jnp
+def _dirichlet(rng, k, n):
+    return rng.dirichlet(np.full(k, 0.5), size=n).astype(np.float32)
 
+
+def bench_scoring_uniform(jax, jnp):
+    """Headline: uniform-random events, fused scan+top-k, r01 shape."""
     from onix.models.scoring import top_suspicious
 
     n_docs, n_vocab, k = 100_000, 65_536, 20
-    n_events = 1 << 24            # ~16.8M events per pass
-    reps = 8                      # passes chained inside one program
+    n_events = 1 << 24
+    reps = 8
     max_results = 1000
 
     rng = np.random.default_rng(0)
-    theta = rng.dirichlet(np.full(k, 0.5), size=n_docs).astype(np.float32)
-    phi_wk = rng.dirichlet(np.full(k, 0.5), size=n_vocab).astype(np.float32)
-    doc_ids = rng.integers(0, n_docs, n_events).astype(np.int32)
-    word_ids = rng.integers(0, n_vocab, n_events).astype(np.int32)
-
-    dev = jax.devices()[0]
+    theta = _dirichlet(rng, k, n_docs)
+    phi_wk = _dirichlet(rng, k, n_vocab)
+    d_d = jnp.asarray(rng.integers(0, n_docs, n_events).astype(np.int32))
+    w_d = jnp.asarray(rng.integers(0, n_vocab, n_events).astype(np.int32))
     theta_d = jnp.asarray(theta)
     phi_d = jnp.asarray(phi_wk)
-    d_d = jnp.asarray(doc_ids)
-    w_d = jnp.asarray(word_ids)
     m_d = jnp.ones(n_events, jnp.float32)
 
     @jax.jit
@@ -89,16 +98,118 @@ def main() -> None:
             one_pass, init, jnp.arange(reps, dtype=jnp.int32))
         return scores, idx
 
-    # Warm (compile) then time: one dispatch, REPS full passes, one fetch.
-    np.asarray(bench(theta_d, phi_d, d_d, w_d, m_d)[0])
+    np.asarray(bench(theta_d, phi_d, d_d, w_d, m_d)[0])   # compile
     t0 = time.perf_counter()
     scores, _ = bench(theta_d, phi_d, d_d, w_d, m_d)
     scores_h = np.asarray(scores)     # forces completion through the tunnel
     dt = time.perf_counter() - t0
     assert np.isfinite(scores_h).all()
     rate = reps * n_events / dt
-
     baseline = 20.0 * _numpy_scoring_rate(theta, phi_wk)
+    return rate, baseline, {
+        "n_events_per_pass": n_events,
+        "passes_in_one_program": reps,
+        "wall_seconds": round(dt, 3),
+    }
+
+
+def bench_gibbs_sweep(jax, jnp):
+    """Hot loop #2: tokens sampled per second per chip, full sweeps
+    chained inside one program (state evolves — nothing to hoist)."""
+    from onix.models import lda_gibbs
+
+    n_docs, n_vocab, k = 200_000, 4_096, 20
+    n_tokens = 1 << 23            # 8.4M tokens ~ a large day per chip
+    block = 1 << 16
+    reps = 4
+
+    rng = np.random.default_rng(0)
+    nb = n_tokens // block
+    docs = jnp.asarray(rng.integers(0, n_docs, n_tokens)
+                       .astype(np.int32).reshape(nb, block))
+    words = jnp.asarray(rng.integers(0, n_vocab, n_tokens)
+                        .astype(np.int32).reshape(nb, block))
+    mask = jnp.ones((nb, block), jnp.float32)
+    state = lda_gibbs.init_state(docs, words, mask, n_docs, n_vocab, k,
+                                 seed=0)
+
+    @jax.jit
+    def bench(state):
+        def one_sweep(st, _):
+            return lda_gibbs.sweep(st, docs, words, mask, alpha=1.2,
+                                   eta=0.01, n_vocab=n_vocab,
+                                   accumulate=False), None
+        state, _ = jax.lax.scan(one_sweep, state, jnp.arange(reps))
+        return state
+
+    np.asarray(bench(state).n_k)      # compile + settle
+    t0 = time.perf_counter()
+    out = bench(state)
+    nk = np.asarray(out.n_k)          # forces completion
+    dt = time.perf_counter() - t0
+    assert int(nk.sum()) == n_tokens
+    return {
+        "tokens_sampled_per_sec_per_chip": round(reps * n_tokens / dt, 1),
+        "n_tokens": n_tokens, "sweeps_in_one_program": reps,
+        "n_docs": n_docs, "n_vocab": n_vocab, "n_topics": k,
+        "wall_seconds": round(dt, 3),
+    }
+
+
+def _zipf_pairs(rng, n_events, n_docs, n_vocab, a=1.3):
+    """Zipf-distributed (doc, word) pairs — real telemetry duplication."""
+    n_pairs = min(n_docs * n_vocab, 1 << 22)
+    ranks = (rng.zipf(a, n_events).astype(np.int64) - 1) % n_pairs
+    # map rank -> scattered pair id so hot pairs aren't doc-contiguous
+    pair_ids = (ranks * 2654435761) % (n_docs * n_vocab)
+    d = (pair_ids // n_vocab).astype(np.int32)
+    w = (pair_ids % n_vocab).astype(np.int32)
+    return d, w
+
+
+def bench_scoring_zipf(jax, jnp, n_docs, n_vocab, tag):
+    """Product-path scoring (score_all strategy selection + host
+    selection exactly as run_scoring does) on Zipf telemetry.
+    Host-inclusive wall — this is the honest end-to-end number."""
+    from onix.models.scoring import score_all, select_suspicious
+
+    k = 20
+    n_events = 1 << 24
+    rng = np.random.default_rng(1)
+    theta = _dirichlet(rng, k, n_docs)
+    phi_wk = _dirichlet(rng, k, n_vocab)
+    d, w = _zipf_pairs(rng, n_events, n_docs, n_vocab)
+    uniq_frac = len(np.unique(d.astype(np.int64) * n_vocab + w)) / n_events
+
+    # Warm with the IDENTICAL call so every shape the timed run uses is
+    # compiled (a smaller warmup would leave the real chunk shapes cold
+    # and charge ~25 s of tunnel compile time to the measurement).
+    score_all(theta, phi_wk, d, w)
+    t0 = time.perf_counter()
+    scores = score_all(theta, phi_wk, d, w)
+    top = select_suspicious(scores, tol=1.0, max_results=1000)
+    dt = time.perf_counter() - t0
+    assert np.isfinite(scores).all() and len(top) == 1000
+    return {
+        "events_per_sec_host_inclusive": round(n_events / dt, 1),
+        "n_events": n_events, "n_docs": n_docs, "n_vocab": n_vocab,
+        "unique_pair_fraction": round(uniq_frac, 4),
+        "strategy": tag,
+        "wall_seconds": round(dt, 3),
+    }
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+    rate, baseline, uniform_detail = bench_scoring_uniform(jax, jnp)
+    sweep_detail = bench_gibbs_sweep(jax, jnp)
+    # table strategy engages: D*V = 5.2e7 <= TABLE_MAX_ELEMS
+    zipf_table = bench_scoring_zipf(jax, jnp, 100_000, 512, "theta_phi_table")
+    # dedup strategy engages: D*V = 2.1e9 too big for a table
+    zipf_dedup = bench_scoring_zipf(jax, jnp, 1_000_000, 2_048, "pair_dedup")
 
     print(json.dumps({
         "metric": "netflow_events_scored_per_sec_per_chip",
@@ -107,10 +218,14 @@ def main() -> None:
         "vs_baseline": round(rate / baseline, 3),
         "detail": {
             "device": str(dev),
-            "n_events_per_pass": n_events,
-            "passes_in_one_program": reps,
-            "wall_seconds": round(dt, 3),
-            "baseline_events_per_sec_20node_numpy_proxy": round(baseline, 1),
+            "scoring_uniform": {
+                **uniform_detail,
+                "baseline_events_per_sec_20node_numpy_proxy":
+                    round(baseline, 1),
+            },
+            "gibbs_sweep": sweep_detail,
+            "scoring_zipf_table": zipf_table,
+            "scoring_zipf_dedup": zipf_dedup,
         },
     }))
 

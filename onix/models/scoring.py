@@ -154,6 +154,36 @@ def top_suspicious(
 
 _score_events_jit = jax.jit(score_events)
 
+
+@jax.jit
+def score_table(theta: jax.Array, phi_wk: jax.Array) -> jax.Array:
+    """The full [D, V] score matrix θ·φᵀ as ONE matmul.
+
+    Product vocabularies are small by construction (packed words, coarse
+    bins — V is hundreds to a few thousand), so D×V usually fits HBM
+    comfortably; a single MXU matmul replaces per-event gather-dot pairs
+    and per-event scoring degrades to a flat 4-byte gather (docs/PERF.md:
+    the gather runs ~250 GB/s while the gathered-operand dot wastes
+    108/128 lanes). Multi-chain inputs combine with the geometric mean,
+    matching score_events."""
+    if theta.ndim == 2:
+        return theta @ phi_wk.T
+    per_chain = jnp.einsum("cdk,cvk->cdv", theta, phi_wk)
+    return jnp.exp(jnp.log(jnp.maximum(per_chain, 1e-38)).mean(axis=0))
+
+
+@jax.jit
+def _gather_scores(table_flat: jax.Array, d: jax.Array, w: jax.Array,
+                   n_vocab: int) -> jax.Array:
+    # int32 flat index is safe: the table is capped at TABLE_MAX_ELEMS
+    # (1<<27) elements, far under int32 range.
+    return table_flat[d.astype(jnp.int32) * jnp.int32(n_vocab) + w]
+
+
+# D*V budget for materializing the score table (f32 elements). 1<<27 =
+# 512 MB — small next to 16 GB HBM, large enough for D=200k x V=640.
+TABLE_MAX_ELEMS = 1 << 27
+
 # Dedup pays once the device scan shrinks enough to cover the host-side
 # np.unique sort; real telemetry is Zipf over (ip, word) pairs, so the
 # unique-pair count is typically a small fraction of the event count
@@ -166,14 +196,38 @@ def score_all(theta, phi_wk, doc_ids, word_ids, chunk: int = 1 << 22,
               dedup: bool = True) -> np.ndarray:
     """Score every event, chunked on host to bound device memory.
 
-    With `dedup`, duplicate (doc, word) pairs are scored once on device
-    and broadcast back through the inverse index — same scores
-    bit-for-bit (scoring is a pure function of the pair)."""
+    Strategy selection:
+    1. D×V small (the product regime): materialize θ·φᵀ once on the MXU
+       and score each event with a flat gather.
+    2. Otherwise, with `dedup`, duplicate (doc, word) pairs are scored
+       once on device and broadcast back through the inverse index —
+       same scores bit-for-bit (scoring is a pure function of the pair).
+    3. Fallback: chunked gather-dot scan.
+    """
     doc_ids = np.asarray(doc_ids)
     word_ids = np.asarray(word_ids)
     n = doc_ids.shape[0]
+    theta_a = np.asarray(theta)
+    n_docs = int(theta_a.shape[-2])
+    n_vocab = int(np.asarray(phi_wk).shape[-2])
+    chains = theta_a.shape[0] if theta_a.ndim == 3 else 1
+    # Table strategy gates: (a) the [C,D,V] build (plus its log/exp
+    # temporaries on the chain path) must respect the memory budget;
+    # (b) the D*V*4B of table traffic must amortize over the events
+    # (each event replaces ~2K*8B of gathered-operand traffic, so the
+    # break-even is D*V ≈ 40n; 32 keeps margin). Small batches — the
+    # streaming scorer — fall through to the gather-dot/dedup paths.
+    if (n and chains * n_docs * n_vocab <= TABLE_MAX_ELEMS
+            and n_docs * n_vocab <= 32 * n):
+        table = score_table(jnp.asarray(theta), jnp.asarray(phi_wk)).ravel()
+        out = np.empty(n, np.float32)
+        for lo in range(0, n, chunk):
+            hi = min(lo + chunk, n)
+            out[lo:hi] = np.asarray(_gather_scores(
+                table, jnp.asarray(doc_ids[lo:hi]),
+                jnp.asarray(word_ids[lo:hi]), n_vocab))
+        return out
     if dedup and n:
-        n_vocab = int(np.asarray(phi_wk).shape[-2])
         key = doc_ids.astype(np.int64) * n_vocab + word_ids
         uniq, inv = np.unique(key, return_inverse=True)
         if uniq.shape[0] <= _DEDUP_THRESHOLD * n:
@@ -189,3 +243,16 @@ def score_all(theta, phi_wk, doc_ids, word_ids, chunk: int = 1 << 22,
                                                   jnp.asarray(doc_ids[lo:hi]),
                                                   jnp.asarray(word_ids[lo:hi])))
     return out
+
+
+def select_suspicious(scores: np.ndarray, tol: float,
+                      max_results: int) -> np.ndarray:
+    """Host-side suspicious selection: indices of events with score <
+    tol, ascending by score, capped at max_results — the POST-LDA
+    filter/sort/take contract (SURVEY.md §3.1) shared by the batch run
+    and the benches."""
+    cand = np.flatnonzero(scores < tol)
+    if cand.size > max_results:
+        part = np.argpartition(scores[cand], max_results - 1)
+        cand = cand[part[:max_results]]
+    return cand[np.argsort(scores[cand], kind="stable")]

@@ -18,7 +18,7 @@ import numpy as np
 import pandas as pd
 
 from onix.config import OnixConfig
-from onix.models.scoring import score_all
+from onix.models.scoring import score_all, select_suspicious
 from onix.pipelines.corpus_build import CorpusBundle, build_corpus, event_scores
 from onix.pipelines.words import WORD_FNS
 from onix.store import Store, feedback_path, results_path
@@ -187,12 +187,8 @@ def run_scoring(cfg: OnixConfig, engine: str = "gibbs",
         # top_suspicious — the 1B-event benchmark path) pays a ~25s
         # cold compile through the device tunnel for zero benefit when
         # the array is already on the host.
-        cand = np.flatnonzero(ev_scores < cfg.pipeline.tol)
-        if cand.size > cfg.pipeline.max_results:
-            part = np.argpartition(ev_scores[cand],
-                                   cfg.pipeline.max_results - 1)
-            cand = cand[part[:cfg.pipeline.max_results]]
-        top = cand[np.argsort(ev_scores[cand], kind="stable")]
+        top = select_suspicious(ev_scores, cfg.pipeline.tol,
+                                cfg.pipeline.max_results)
         meter.add(n_events)
     # Snapshot now: the judged events/sec must not absorb the result-
     # frame assembly and CSV write below.

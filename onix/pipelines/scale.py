@@ -30,8 +30,8 @@ from onix.pipelines.words import flow_words_from_arrays
 
 
 def run_scale(n_events: int, n_hosts: int | None = None,
-              n_sweeps: int = 20, n_topics: int = 20,
-              max_results: int = 3000, seed: int = 0,
+              n_anomalies: int | None = None, n_sweeps: int = 20,
+              n_topics: int = 20, max_results: int = 3000, seed: int = 0,
               out_path: str | pathlib.Path | None = None) -> dict:
     """End-to-end scale run; returns (and optionally writes) the manifest."""
     import jax
@@ -41,11 +41,18 @@ def run_scale(n_events: int, n_hosts: int | None = None,
 
     if n_hosts is None:
         n_hosts = max(120, min(200_000, n_events // 500))
+    if n_anomalies is None:
+        # Sublinear in n: at 10^8+, a linear anomaly count concentrates
+        # enough repeated signature words that the sampler gives the
+        # attack its own topic and the events stop being low-probability
+        # (the planted-anomaly contract assumes heterogeneity).
+        n_anomalies = max(30, min(1000, n_events // 10_000))
     walls: dict[str, float] = {}
     t_all = time.monotonic()
 
     t = time.monotonic()
-    cols = synth_flow_day_arrays(n_events, n_hosts=n_hosts, seed=seed)
+    cols = synth_flow_day_arrays(n_events, n_hosts=n_hosts,
+                                 n_anomalies=n_anomalies, seed=seed)
     walls["synthesize"] = time.monotonic() - t
 
     t = time.monotonic()

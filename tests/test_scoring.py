@@ -123,3 +123,30 @@ def test_score_all_dedup_matches_direct():
     got3 = score_all(theta3, phi3, d, w, dedup=True)
     want3 = score_all(theta3, phi3, d, w, dedup=False)
     np.testing.assert_array_equal(got3, want3)
+
+
+def test_score_all_table_path_matches_gather_dot():
+    """The MXU table strategy (θ·φᵀ once + flat gather) must agree with
+    the per-event gather-dot path, single-chain and multi-chain."""
+    import jax.numpy as jnp
+
+    from onix.models import scoring
+
+    rng = np.random.default_rng(3)
+    d_docs, v, k, n = 300, 150, 6, 10_000
+    theta = rng.dirichlet(np.full(k, 0.5), size=d_docs).astype(np.float32)
+    phi = rng.dirichlet(np.full(k, 0.5), size=v).astype(np.float32)
+    d = rng.integers(0, d_docs, n).astype(np.int32)
+    w = rng.integers(0, v, n).astype(np.int32)
+    assert d_docs * v <= scoring.TABLE_MAX_ELEMS   # table path engaged
+    got = scoring.score_all(theta, phi, d, w)
+    want = np.asarray(scoring._score_events_jit(
+        theta, phi, jnp.asarray(d), jnp.asarray(w)))
+    np.testing.assert_allclose(got, want, rtol=2e-5)
+
+    thc = np.stack([theta, theta[::-1], theta])
+    phc = np.stack([phi, phi[::-1], phi])
+    gotc = scoring.score_all(thc, phc, d, w)
+    wantc = np.asarray(scoring._score_events_jit(
+        thc, phc, jnp.asarray(d), jnp.asarray(w)))
+    np.testing.assert_allclose(gotc, wantc, rtol=2e-5)
