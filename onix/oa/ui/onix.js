@@ -22,7 +22,17 @@ const COLS = {
           "uripath", "respcode", "useragent", "geo_country", "rep"],
 };
 const REP_COLS = new Set(["rep", "src_rep", "dst_rep"]);
+// Which row fields correspond to a graph edge's (source, target) — must
+// match onix/oa/engine.py _graph().
+const EDGE_KEYS = {
+  flow: ["sip", "dip"],
+  dns: ["ip_dst", "domain"],
+  proxy: ["clientip", "host"],
+};
 const labels = new Map();   // rank -> label
+let allRows = [];           // current date's suspicious rows
+let graphMode = "chord";    // "chord" | "list"
+let lastGraph = null;
 
 function hashDate() {
   const m = location.hash.match(/date=(\d{4}-\d{2}-\d{2})/);
