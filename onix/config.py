@@ -127,10 +127,13 @@ class StoreConfig:
     logical layout as Parquet datasets under `root`.
     """
 
+    # Empty sub-dirs mean "derive from root" (<root>/<name>) at
+    # validate() time, so one --set store.root=... override relocates
+    # the whole store (OA output included, see OAConfig).
     root: str = "data/onix"
-    feedback_dir: str = "data/onix/feedback"
-    results_dir: str = "data/onix/results"
-    checkpoint_dir: str = "data/onix/checkpoints"
+    feedback_dir: str = ""
+    results_dir: str = ""
+    checkpoint_dir: str = ""
 
 
 @dataclass
@@ -138,7 +141,10 @@ class OAConfig:
     """Operational Analytics (SURVEY.md §2.1 #12-#13): enrichment inputs
     and the per-date UI data directory the dashboards read."""
 
-    data_dir: str = "data/onix/oa"
+    # Empty means "derive from store.root" (<root>/oa) at validate()
+    # time, so one --set store.root=... override relocates the whole
+    # store, OA outputs included.
+    data_dir: str = ""
     geoip_db: str = ""          # CSV: network,country,city,latitude,longitude,isp
     reputation: str = ""        # plugin specs, comma-separated: local:<path>|noop
     top_domains: str = ""       # popular-domains list file (rank order)
@@ -156,6 +162,14 @@ class OnixConfig:
         self.lda.validate()
         self.mesh.validate()
         self.pipeline.validate()
+        root = pathlib.Path(self.store.root)
+        for attr, sub in (("feedback_dir", "feedback"),
+                          ("results_dir", "results"),
+                          ("checkpoint_dir", "checkpoints")):
+            if not getattr(self.store, attr):
+                setattr(self.store, attr, str(root / sub))
+        if not self.oa.data_dir:
+            self.oa.data_dir = str(root / "oa")
         return self
 
     # -- serialization ----------------------------------------------------

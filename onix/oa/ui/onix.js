@@ -31,6 +31,7 @@ const EDGE_KEYS = {
 };
 const labels = new Map();   // rank -> label
 let allRows = [];           // current date's suspicious rows
+let currentDate = null;
 let graphMode = "chord";    // "chord" | "list"
 let lastGraph = null;
 
@@ -99,29 +100,43 @@ function renderBars(elId, values, titleFn) {
   box.replaceChildren(svg);
 }
 
-function renderGraph(graph) {
-  const box = document.getElementById("graph");
-  const links = [...graph.links].sort((a, b) => a.min_score - b.min_score)
-    .slice(0, 60);
+function edgeTitle(l) {
+  return `${l.source} → ${l.target} (${l.weight} events, ` +
+    `min score ${fmtScore(l.min_score)})`;
+}
+
+function edgeOf(l, links, maxW) {
+  // Shared edge decoration: hotness by score, width by weight, tooltip,
+  // and the graph → rows drill-down on click.
+  return {
+    cls: "edge" + (l.min_score <= links[0].min_score * 10 ? " hot" : ""),
+    width: Math.max(1, 4 * l.weight / maxW),
+    attach(shape) {
+      const t = svgEl("title");
+      t.textContent = edgeTitle(l);
+      shape.append(t);
+      shape.addEventListener("click", () => showDrill(l));
+    },
+  };
+}
+
+function renderBipartite(links, box) {
   const srcs = [...new Set(links.map(l => l.source))];
   const dsts = [...new Set(links.map(l => l.target))];
-  if (!links.length) { box.replaceChildren(el("div", { class: "empty" }, "no edges")); return; }
   const rowH = 14, svgW = 460, pad = 110;
   const svgH = Math.max(srcs.length, dsts.length) * rowH + 24;
   const svg = svgEl("svg", { viewBox: `0 0 ${svgW} ${svgH}`, width: "100%" });
   const yOf = (list, id) => 16 + list.indexOf(id) * rowH;
   const maxW = Math.max(...links.map(l => l.weight));
   for (const l of links) {
+    const deco = edgeOf(l, links, maxW);
     const line = svgEl("line", {
-      class: "edge" + (l.min_score <= links[0].min_score * 10 ? " hot" : ""),
+      class: deco.cls,
       x1: pad, y1: yOf(srcs, l.source),
       x2: svgW - pad, y2: yOf(dsts, l.target),
-      "stroke-width": Math.max(1, 4 * l.weight / maxW),
+      "stroke-width": deco.width,
     });
-    const t = svgEl("title");
-    t.textContent = `${l.source} → ${l.target} (${l.weight} events, ` +
-      `min score ${fmtScore(l.min_score)})`;
-    line.append(t);
+    deco.attach(line);
     svg.append(line);
   }
   srcs.forEach(s => {
@@ -137,7 +152,89 @@ function renderGraph(graph) {
   box.replaceChildren(svg);
 }
 
-function renderTable(rows, date) {
+function renderChord(links, box) {
+  // Dependency-free chord-style view: every endpoint on a circle,
+  // edges as quadratic curves pulled toward the center — the
+  // reference's flow chord dashboard re-imagined without D3
+  // (reference README.md:45-48,55-56).
+  const ids = [...new Set(links.flatMap(l => [l.source, l.target]))];
+  const svgW = 460, svgH = 460, cx = svgW / 2, cy = svgH / 2;
+  const r = Math.min(cx, cy) - 76;
+  const pos = new Map(ids.map((id, i) => {
+    const a = (2 * Math.PI * i) / ids.length - Math.PI / 2;
+    return [id, { x: cx + r * Math.cos(a), y: cy + r * Math.sin(a), a }];
+  }));
+  const svg = svgEl("svg", { viewBox: `0 0 ${svgW} ${svgH}`, width: "100%" });
+  const maxW = Math.max(...links.map(l => l.weight));
+  for (const l of links) {
+    const p1 = pos.get(l.source), p2 = pos.get(l.target);
+    const deco = edgeOf(l, links, maxW);
+    const path = svgEl("path", {
+      class: deco.cls, fill: "none",
+      d: `M ${p1.x.toFixed(1)} ${p1.y.toFixed(1)} ` +
+         `Q ${cx} ${cy} ${p2.x.toFixed(1)} ${p2.y.toFixed(1)}`,
+      "stroke-width": deco.width,
+    });
+    deco.attach(path);
+    svg.append(path);
+  }
+  for (const id of ids) {
+    const p = pos.get(id);
+    const deg = (p.a * 180) / Math.PI;
+    const flip = deg > 90 || deg < -90;
+    const t = svgEl("text", {
+      class: "node",
+      x: 0, y: 0,
+      "text-anchor": flip ? "end" : "start",
+      transform: `translate(${(cx + (r + 6) * Math.cos(p.a)).toFixed(1)},` +
+        `${(cy + (r + 6) * Math.sin(p.a)).toFixed(1)}) ` +
+        `rotate(${(flip ? deg + 180 : deg).toFixed(1)})`,
+    });
+    t.textContent = id;
+    svg.append(t);
+  }
+  box.replaceChildren(svg);
+}
+
+function renderGraph(graph) {
+  lastGraph = graph;
+  const box = document.getElementById("graph");
+  const links = [...graph.links].sort((a, b) => a.min_score - b.min_score)
+    .slice(0, 60);
+  if (!links.length) { box.replaceChildren(el("div", { class: "empty" }, "no edges")); return; }
+  if (graphMode === "chord") renderChord(links, box);
+  else renderBipartite(links, box);
+  const btn = document.getElementById("graph-mode");
+  btn.textContent = graphMode === "chord" ? "bipartite view" : "chord view";
+  btn.onclick = () => {
+    graphMode = graphMode === "chord" ? "list" : "chord";
+    renderGraph(lastGraph);
+  };
+}
+
+function showDrill(link) {
+  // Graph → rows → label without touching the main table's ordering:
+  // filter the day's rows to the clicked edge and render them in the
+  // drill panel with the same label controls (shared `labels` map, same
+  // Save button).
+  const [ks, kt] = EDGE_KEYS[TYPE];
+  const rows = allRows.filter(
+    r => String(r[ks]) === String(link.source) &&
+         String(r[kt]) === String(link.target));
+  document.getElementById("drill-title").textContent =
+    `${link.source} → ${link.target} — ${rows.length} suspicious ` +
+    `row${rows.length === 1 ? "" : "s"}`;
+  renderTable(rows, currentDate, document.getElementById("drill-table"));
+  const panel = document.getElementById("drill-panel");
+  panel.hidden = false;
+  panel.scrollIntoView({ behavior: "smooth", block: "nearest" });
+  document.getElementById("drill-clear").onclick = () => {
+    panel.hidden = true;
+  };
+}
+
+function renderTable(rows, date, table = null) {
+  table = table || document.getElementById("sus-table");
   const cols = COLS[TYPE].filter(c => rows.length === 0 || c in rows[0]);
   const thead = el("thead");
   const hr = el("tr");
@@ -161,6 +258,9 @@ function renderTable(rows, date) {
       ([v, t]) => sel.append(el("option", { value: v }, t)));
     sel.value = String(row.sev ?? 0);
     sel.addEventListener("change", () => {
+      // Mutate the shared row object so the main table and a drill
+      // panel rendering the same row stay consistent on re-render.
+      row.sev = Number(sel.value);
       if (sel.value === "0") labels.delete(row.rank);
       else labels.set(row.rank, {
         ip: row.ip, word: row.word, rank: row.rank, score: row.score,
@@ -173,7 +273,6 @@ function renderTable(rows, date) {
     tr.append(labelTd);
     tbody.append(tr);
   }
-  const table = document.getElementById("sus-table");
   table.replaceChildren(thead, tbody);
   document.getElementById("save").onclick = async () => {
     const status = document.getElementById("status");
@@ -213,6 +312,11 @@ async function load() {
   const [rows, sum, graph] = await Promise.all([
     getJSON(`${dir}/suspicious.json`), getJSON(`${dir}/summary.json`),
     getJSON(`${dir}/graph.json`)]);
+  allRows = rows;
+  currentDate = date;
+  labels.clear();
+  document.getElementById("save").disabled = true;
+  document.getElementById("drill-panel").hidden = true;
   renderTiles(sum);
   renderBars("hist", sum.histogram.counts,
     (i, v) => `bin ${i}: ${v} events`);

@@ -48,7 +48,7 @@ def run_rehearsal(n_events: int = 100_000, n_sweeps: int = 300,
     from onix.pipelines.synth import synth_flow_day
     from onix.pipelines.words import flow_words
 
-    day, _planted = synth_flow_day(
+    day, planted = synth_flow_day(
         n_events=n_events, n_hosts=max(120, n_events // 250),
         n_anomalies=max(30, n_events // 650), seed=seed)
     bundle = build_corpus(flow_words(day))
@@ -90,6 +90,17 @@ def run_rehearsal(n_events: int = 100_000, n_sweeps: int = 300,
     walls["jax_fit_and_score"] = round(time.monotonic() - t, 1)
 
     k = JUDGED_K
+    # Detection sanity alongside fidelity: fraction of planted exfil
+    # events each engine surfaces in its bottom-k (event score = min
+    # over the event's tokens, via the layout-checked shared helper).
+    from onix.pipelines.corpus_build import event_scores
+    n = len(day)
+    hits = {}
+    for name, sc_tok in (("jax", jx), ("oracle", ora_a)):
+        ev = event_scores(bundle, np.asarray(sc_tok), n)
+        bottom = set(np.argsort(ev)[:k].tolist())
+        hits[name] = round(
+            len(bottom & set(planted.tolist())) / len(planted), 4)
     result = {
         "metric": f"top-{k} suspicious-connect overlap vs oracle",
         "bar": JUDGED_BAR,
@@ -102,6 +113,7 @@ def run_rehearsal(n_events: int = 100_000, n_sweeps: int = 300,
         "overlap_at_k": {
             str(kk): round(oracle.topk_overlap(jx, ora_a, kk), 4)
             for kk in (100, 500, 1000, 2000)},
+        "planted_hit_at_k": hits,
         "config": {
             "n_events": n_events, "n_docs": int(corpus.n_docs),
             "n_vocab": int(corpus.n_vocab),

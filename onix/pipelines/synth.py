@@ -98,18 +98,26 @@ def synth_flow_day(n_events: int = 20000, n_hosts: int = 120,
                     zip(prof, rng.integers(0, 4, n_bg))])
     sport = rng.integers(1025, 65535, n_bg)
 
-    # Anomalies: exfil-shaped — ephemeral↔ephemeral ports to rare external
-    # peers, off-hours, outsized transfers; heterogeneous so no single
-    # signature word repeats enough to form its own topic.
+    # Anomalies: exfil-shaped — ephemeral↔ephemeral ports (the off-profile
+    # signature: background traffic always has a service port) to rare
+    # external peers. Each anomaly is its OWN campaign: sizes drawn
+    # log-uniform across the whole background range and hours uniform, so
+    # the plant spreads over the hour/packet/byte bin grid — tiny beacons
+    # through bulk exfil at all times of day — and no signature word
+    # accumulates count. (A homogeneous plant collapses into one word
+    # whose count reaches the vocabulary median and stops being rare —
+    # word rarity IS the detection signal.)
     a_sip = hosts[rng.integers(0, n_hosts, n_anomalies)]
     a_dip = np.array([f"203.0.{rng.integers(0, 16)}.{rng.integers(1, 255)}"
                       for _ in range(n_anomalies)])
     a_dport = rng.integers(31337, 65535, n_anomalies)
     a_sport = rng.integers(1025, 65535, n_anomalies)
-    a_proto = np.full(n_anomalies, "TCP", dtype=object)
-    a_hour = rng.uniform(0, 6, n_anomalies)
-    a_ipkt = np.exp(rng.normal(7, 1.5, n_anomalies)).astype(np.int64) + 1
-    a_ibyt = a_ipkt * rng.integers(900, 1460, n_anomalies)
+    a_proto = np.where(rng.random(n_anomalies) < 0.25,
+                       "UDP", "TCP").astype(object)
+    a_hour = rng.uniform(0, 24, n_anomalies) % 23.99
+    a_ipkt = np.exp(rng.uniform(0.3, 8.5, n_anomalies)).astype(np.int64) + 1
+    a_bpp = np.exp(rng.uniform(3.7, 7.2, n_anomalies)) + 40
+    a_ibyt = a_ipkt * a_bpp.astype(np.int64)
 
     def col(bg, an):
         return np.concatenate([bg, an])
@@ -197,8 +205,8 @@ def synth_flow_day_arrays(n_events: int, n_hosts: int = 100_000,
         out["ipkt"][lo:hi] = ipkt
         out["ibyt"][lo:hi] = ipkt * bpp
 
-    # Anomalies: exfil-shaped (ephemeral↔ephemeral, rare external peers,
-    # off-hours, outsized transfers) — same recipe as synth_flow_day.
+    # Anomalies: exfil-shaped, each its own campaign spread across the
+    # bin grid — same recipe (and same rationale) as synth_flow_day.
     a = slice(n_bg, n_events)
     out["sip_u32"][a] = host_base + rng.integers(
         0, n_hosts, n_anomalies).astype(np.uint32)
@@ -207,11 +215,14 @@ def synth_flow_day_arrays(n_events: int, n_hosts: int = 100_000,
                          + rng.integers(1, 255, n_anomalies).astype(np.uint32))
     out["sport"][a] = rng.integers(1025, 65535, n_anomalies)
     out["dport"][a] = rng.integers(31337, 65535, n_anomalies)
-    out["proto_id"][a] = FLOW_PROTO_CLASSES.index("TCP")
-    out["hour"][a] = rng.uniform(0, 6, n_anomalies)
-    a_ipkt = np.exp(rng.normal(7, 1.5, n_anomalies)).astype(np.int64) + 1
+    out["proto_id"][a] = np.where(rng.random(n_anomalies) < 0.25,
+                                  FLOW_PROTO_CLASSES.index("UDP"),
+                                  FLOW_PROTO_CLASSES.index("TCP")).astype(np.int8)
+    out["hour"][a] = rng.uniform(0, 24, n_anomalies) % 23.99
+    a_ipkt = np.exp(rng.uniform(0.3, 8.5, n_anomalies)).astype(np.int64) + 1
+    a_bpp = np.exp(rng.uniform(3.7, 7.2, n_anomalies)) + 40
     out["ipkt"][a] = a_ipkt
-    out["ibyt"][a] = a_ipkt * rng.integers(900, 1460, n_anomalies)
+    out["ibyt"][a] = a_ipkt * a_bpp.astype(np.int64)
     out["anomaly_idx"] = np.arange(n_bg, n_events, dtype=np.int64)
     out["proto_classes"] = list(FLOW_PROTO_CLASSES)
     return out

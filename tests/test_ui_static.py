@@ -58,3 +58,39 @@ def test_js_braces_and_parens_balanced():
     error that would kill the whole dashboard silently."""
     for open_c, close_c in ("{}", "()", "[]"):
         assert JS.count(open_c) == JS.count(close_c), (open_c, close_c)
+
+
+def test_edge_keys_match_graph_builder_and_columns():
+    """The drill-down filters rows by EDGE_KEYS — those keys must be the
+    exact fields onix/oa/engine.py _graph() aggregates edges by, and
+    must exist in the row columns the table renders."""
+    m = re.search(r"const EDGE_KEYS = \{(.*?)\};", JS, re.S)
+    assert m, "EDGE_KEYS missing from onix.js"
+    found = re.findall(r'(\w+): \["([^"]+)", "([^"]+)"\]', m.group(1))
+    edge_keys = {t: (a, b) for t, a, b in found}
+    # keep in lockstep with engine._graph (source of the graph.json)
+    assert edge_keys == {"flow": ("sip", "dip"),
+                         "dns": ("ip_dst", "domain"),
+                         "proxy": ("clientip", "host")}
+    cols = re.search(r"const COLS = \{(.*?)\};", JS, re.S).group(1)
+    for t, pair in edge_keys.items():
+        for f in pair:
+            assert f'"{f}"' in cols, f"{t} drill key {f} not in COLS"
+    from onix.oa import engine
+    import inspect
+    src = inspect.getsource(engine._graph)
+    for f in ("sip", "dip", "ip_dst", "domain", "clientip", "host"):
+        assert f'"{f}"' in src
+
+
+def test_drill_panel_contract():
+    """Edge click → drill rows → label: the drill panel ids exist, edges
+    get click handlers, and the drill renders through the SAME
+    renderTable (same label select path) into its own table."""
+    assert 'addEventListener("click", () => showDrill(l))' in JS
+    assert re.search(r'renderTable\(rows, currentDate,\s*'
+                     r'document\.getElementById\("drill-table"\)\)', JS)
+    for rel, html in DASHBOARDS.items():
+        for i in ("drill-panel", "drill-title", "drill-clear",
+                  "drill-table", "graph-mode"):
+            assert f'id="{i}"' in html, f"{rel} missing #{i}"
