@@ -69,6 +69,33 @@ def v9_blob(pad_template=False):
     return hdr + tpl_set + data_set
 
 
+def ipfix_blob(long_varlen=False, strip_template=False):
+    """One IPFIX message: template (enterprise + variable-length fields)
+    + options template set + 2 data records."""
+    fields = [(8, 4), (12, 4), (7, 2), (11, 2), (4, 1), (6, 1),
+              (0x8000 | 55, 4), (2, 4), (1, 4), (82, 0xFFFF),
+              (152, 8), (153, 8)]
+    tpl = struct.pack(">HH", 310, len(fields))
+    for t, ln in fields:
+        tpl += struct.pack(">HH", t, ln)
+        if t & 0x8000:
+            tpl += struct.pack(">I", 29305)
+    tpl_set = struct.pack(">HH", 2, 4 + len(tpl)) + tpl
+    opt_body = struct.pack(">HHH", 320, 2, 1) + \
+        struct.pack(">HH", 130, 4) + struct.pack(">HH", 41, 8)
+    opt_set = struct.pack(">HH", 3, 4 + len(opt_body)) + opt_body
+    name = b"eth0"
+    vl = (struct.pack(">BH", 255, len(name)) + name if long_varlen
+          else struct.pack(">B", len(name)) + name)
+    rec = struct.pack(">IIHHBB", 10 << 24, 192 << 24, 1024, 443, 6, 0x18) \
+        + struct.pack(">I", 0xDEADBEEF) + struct.pack(">II", 5, 1000) \
+        + vl + struct.pack(">QQ", 1467936000000, 1467936060000)
+    data_set = struct.pack(">HH", 310, 4 + 2 * len(rec)) + rec + rec
+    sets = (b"" if strip_template else tpl_set + opt_set) + data_set
+    hdr = struct.pack(">HHIII", 10, 16 + len(sets), 1467936000, 0, 0)
+    return hdr + sets
+
+
 def dns_pcap_blob(truncate=0):
     """One-response DNS pcap (Ethernet/IPv4/UDP), optionally torn."""
     name = b"\x03www\x07example\x03com\x00"
@@ -119,6 +146,11 @@ def main() -> int:
         ("v9 oversized template count",
          struct.pack(">HHIIII", 9, 1, 0, 0, 0, 0)
          + struct.pack(">HH", 0, 12) + struct.pack(">HH", 256, 60000), 1),
+        ("ipfix happy path", ipfix_blob(), 0),
+        ("ipfix long varlen prefix", ipfix_blob(long_varlen=True), 0),
+        ("ipfix unknown template skipped", ipfix_blob(strip_template=True), 0),
+        ("ipfix truncated", ipfix_blob()[:-5], 1),
+        ("mixed v5+v9+ipfix", v5_blob() + v9_blob() + ipfix_blob(), 0),
     ]:
         p = tmp / "cap.bin"
         p.write_bytes(blob)

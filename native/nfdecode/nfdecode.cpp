@@ -5,11 +5,14 @@
 // netflow captures into text for the flow ingest path (SURVEY.md §3.2:
 // "subprocess: oni-nfdump binary decodes nfcapd → CSV"). onix implements
 // its own decoder for the OPEN protocols — Cisco NetFlow v5 export
-// packets (24-byte header + N×48-byte records) and template-based
+// packets (24-byte header + N×48-byte records), template-based
 // NetFlow v9 (RFC 3954: template flowsets announce record layouts, data
-// flowsets carry them) — rather than porting nfdump's proprietary
-// internal nfcapd framing. A capture file here is a concatenation of
-// export packets as received off the wire; v5 and v9 may be mixed.
+// flowsets carry them), and IPFIX/v10 (RFC 7011: explicit message
+// length, enterprise fields, variable-length encoding) — rather than
+// porting nfdump's proprietary internal nfcapd framing (nfcapd files
+// are handled by subprocess passthrough to an installed nfdump, see
+// onix/ingest/nfdecode.py). A capture file here is a concatenation of
+// export packets as received off the wire; versions may be mixed.
 //
 // Exposed as a C ABI for ctypes (onix/ingest/nfdecode.py): two-pass
 // (count, then fill caller-allocated SoA arrays — no ownership transfer
@@ -206,6 +209,166 @@ bool parse_v9_packet(const uint8_t* p, size_t pkt_len, V9Templates* tpls,
   return off == pkt_len;
 }
 
+// ---------------------------------------------------------------------------
+// IPFIX (RFC 7011) — NetFlow v10
+// ---------------------------------------------------------------------------
+//
+// Same template/data-set shape as v9 with three twists the decoder must
+// honor: the message header carries the total byte length (framing is
+// explicit), field specifiers may set the enterprise bit (a 4-byte
+// enterprise number follows; such fields are skipped by length), and a
+// declared length of 0xFFFF means variable-length encoding (RFC 7011
+// §7: 1 length byte, or 255 followed by 2 length bytes, per record).
+
+constexpr size_t kIpfixHeaderLen = 16;
+constexpr uint16_t kIpfixVersion = 10;
+constexpr uint16_t kVarLen = 0xFFFF;
+
+// IPFIX information elements 1..128 share NetFlow v9 field type ids
+// (RFC 7011 §10.2 / IANA registry), so kInBytes..kFirstSwitched above
+// apply verbatim; the absolute-timestamp IEs are IPFIX additions.
+enum IpfixField : uint16_t {
+  kFlowStartSeconds = 150,
+  kFlowEndSeconds = 151,
+  kFlowStartMilliseconds = 152,
+  kFlowEndMilliseconds = 153,
+};
+
+struct IpfixFieldSpec {
+  uint16_t type;
+  uint16_t len;        // kVarLen = variable-length
+  bool enterprise;     // enterprise-specific: skipped by length
+};
+
+struct IpfixTemplate {
+  std::vector<IpfixFieldSpec> fields;
+  size_t min_len = 0;  // fixed bytes + 1 per variable-length field
+};
+
+// Key = (observation domain id << 16) | template id (same collision
+// argument as the v9 map).
+using IpfixTemplates = std::map<uint64_t, IpfixTemplate>;
+
+template <typename Sink>
+bool parse_ipfix_packet(const uint8_t* p, size_t pkt_len,
+                        IpfixTemplates* tpls, Sink&& sink) {
+  const uint32_t export_secs = be32(p + 4);
+  const uint32_t domain_id = be32(p + 12);
+  size_t off = kIpfixHeaderLen;
+  while (off + 4 <= pkt_len) {
+    const uint16_t set_id = be16(p + off);
+    const uint16_t set_len = be16(p + off + 2);
+    if (set_len < 4 || off + set_len > pkt_len) return false;
+    const uint8_t* body = p + off + 4;
+    const size_t body_len = set_len - 4;
+    if (set_id == 2) {  // template set
+      size_t t = 0;
+      while (t + 4 <= body_len) {
+        const uint16_t tpl_id = be16(body + t);
+        const uint16_t n_fields = be16(body + t + 2);
+        if (tpl_id == 0 && n_fields == 0) break;  // trailing padding
+        t += 4;
+        if (tpl_id < 256) return false;
+        IpfixTemplate tpl;
+        for (uint16_t f = 0; f < n_fields; ++f) {
+          if (t + 4 > body_len) return false;
+          const uint16_t raw_type = be16(body + t);
+          const uint16_t flen = be16(body + t + 2);
+          t += 4;
+          const bool ent = (raw_type & 0x8000) != 0;
+          if (ent) {   // enterprise number follows the specifier
+            if (t + 4 > body_len) return false;
+            t += 4;
+          }
+          if (flen == kVarLen) {
+            tpl.min_len += 1;  // at least the 1-byte length prefix
+          } else {
+            if (flen == 0 || tpl.min_len + flen > 0xFFFF) return false;
+            tpl.min_len += flen;
+          }
+          tpl.fields.push_back(
+              {(uint16_t)(raw_type & 0x7FFF), flen, ent});
+        }
+        if (tpl.min_len == 0) return false;
+        (*tpls)[((uint64_t)domain_id << 16) | tpl_id] = tpl;
+      }
+    } else if (set_id >= 256) {  // data set
+      auto it = tpls->find(((uint64_t)domain_id << 16) | set_id);
+      if (it != tpls->end()) {
+        const IpfixTemplate& tpl = it->second;
+        size_t r = 0;
+        // Records run until less than one minimal record remains; the
+        // tail is padding (RFC 7011 §3.3.1).
+        while (body_len - r >= tpl.min_len) {
+          V9Record out;
+          uint64_t start_s = 0, end_s = 0, start_ms = 0, end_ms = 0;
+          bool has_s0 = false, has_s1 = false, has_ms0 = false,
+               has_ms1 = false;
+          bool bad = false;
+          for (const IpfixFieldSpec& f : tpl.fields) {
+            size_t flen = f.len;
+            if (f.len == kVarLen) {  // RFC 7011 §7 variable length
+              if (r >= body_len) { bad = true; break; }
+              flen = body[r];
+              r += 1;
+              if (flen == 255) {
+                if (r + 2 > body_len) { bad = true; break; }
+                flen = be16(body + r);
+                r += 2;
+              }
+            }
+            if (r + flen > body_len) { bad = true; break; }
+            if (!f.enterprise && flen > 0) {
+              const uint64_t v = beN(body + r, (uint16_t)flen);
+              switch (f.type) {
+                case kIpv4Src: out.sip = (uint32_t)v; break;
+                case kIpv4Dst: out.dip = (uint32_t)v; break;
+                case kL4SrcPort: out.sport = (uint16_t)v; break;
+                case kL4DstPort: out.dport = (uint16_t)v; break;
+                case kProtocol: out.proto = (uint8_t)v; break;
+                case kTcpFlags: out.tcp_flags = (uint8_t)v; break;
+                case kInPkts: out.dpkts = (uint32_t)v; break;
+                case kInBytes: out.doctets = (uint32_t)v; break;
+                case kFlowStartSeconds: start_s = v; has_s0 = true; break;
+                case kFlowEndSeconds: end_s = v; has_s1 = true; break;
+                case kFlowStartMilliseconds:
+                  start_ms = v; has_ms0 = true; break;
+                case kFlowEndMilliseconds:
+                  end_ms = v; has_ms1 = true; break;
+                default: break;  // skipped field
+              }
+            }
+            r += flen;
+          }
+          if (bad) return false;
+          // Best available clock: absolute ms > absolute s > export
+          // time. (Uptime-relative IEs 21/22 would need IE 160, the
+          // system init time, which classic exporters rarely send —
+          // export time is the honest fallback.)
+          const double t0 = has_ms0 ? (double)start_ms / 1000.0
+                            : has_s0 ? (double)start_s
+                                     : (double)export_secs;
+          const double t1 = has_ms1 ? (double)end_ms / 1000.0
+                            : has_s1 ? (double)end_s
+                                     : (double)export_secs;
+          if (!sink(out, t0, t1)) return false;
+        }
+      }
+    }
+    // set_id 3 (options template) and unknown data sets: skipped whole.
+    off += set_len;
+  }
+  return off == pkt_len;
+}
+
+// IPFIX framing is explicit: the message header's length field.
+size_t ipfix_packet_extent(const uint8_t* p, size_t remaining) {
+  if (remaining < kIpfixHeaderLen || be16(p) != kIpfixVersion) return 0;
+  const uint16_t msg_len = be16(p + 2);
+  if (msg_len < kIpfixHeaderLen || msg_len > remaining) return 0;
+  return msg_len;
+}
+
 // v9 packets do not carry their own byte length; the header's `count`
 // field is the record/template count, not bytes. Walk the flowsets to
 // find the packet end. The framing is unambiguous: a flowset starts
@@ -217,7 +380,9 @@ size_t v9_packet_extent(const uint8_t* p, size_t remaining) {
   size_t off = kV9HeaderLen;
   while (off + 4 <= remaining) {
     const uint16_t set_id = be16(p + off);
-    if (set_id == kVersion || set_id == kV9Version) break;  // next packet
+    if (set_id == kVersion || set_id == kV9Version ||
+        set_id == kIpfixVersion)
+      break;  // next packet (5/9/10 are reserved set ids, RFC 3954 §5.2)
     const uint16_t set_len = be16(p + off + 2);
     if (set_len < 4 || off + set_len > remaining) return 0;
     off += set_len;
@@ -290,7 +455,7 @@ int64_t nf5_decode(const uint8_t* buf, int64_t len, int64_t n,
   return i;
 }
 
-// Count records in a mixed v5/v9 stream. v9 data flowsets without a
+// Count records in a mixed v5/v9/IPFIX stream. Data flowsets without a
 // known template are skipped (not errors) — matching nfdump; templates
 // learned from earlier packets apply to later ones. Returns -1 on
 // malformed framing.
@@ -299,6 +464,11 @@ int64_t nfx_count(const uint8_t* buf, int64_t len) {
   int64_t total = 0;
   size_t off = 0;
   V9Templates tpls;
+  IpfixTemplates itpls;
+  auto count_sink = [&](const V9Record&, double, double) {
+    ++total;
+    return true;
+  };
   while (off < (size_t)len) {
     const uint16_t ver = ((size_t)len - off >= 2) ? be16(buf + off) : 0;
     if (ver == kVersion) {
@@ -310,12 +480,13 @@ int64_t nfx_count(const uint8_t* buf, int64_t len) {
     } else if (ver == kV9Version) {
       const size_t used = v9_packet_extent(buf + off, (size_t)len - off);
       if (used == 0) return -1;
-      bool ok = parse_v9_packet(buf + off, used, &tpls,
-                                [&](const V9Record&, double, double) {
-                                  ++total;
-                                  return true;
-                                });
-      if (!ok) return -1;
+      if (!parse_v9_packet(buf + off, used, &tpls, count_sink)) return -1;
+      off += used;
+    } else if (ver == kIpfixVersion) {
+      const size_t used = ipfix_packet_extent(buf + off, (size_t)len - off);
+      if (used == 0) return -1;
+      if (!parse_ipfix_packet(buf + off, used, &itpls, count_sink))
+        return -1;
       off += used;
     } else {
       return -1;
@@ -324,9 +495,9 @@ int64_t nfx_count(const uint8_t* buf, int64_t len) {
   return total;
 }
 
-// Decode a mixed v5/v9 stream into caller-allocated arrays of length
-// `n` (from nfx_count). Same output schema as nf5_decode. Returns the
-// number of records written, -1 on error.
+// Decode a mixed v5/v9/IPFIX stream into caller-allocated arrays of
+// length `n` (from nfx_count). Same output schema as nf5_decode.
+// Returns the number of records written, -1 on error.
 int64_t nfx_decode(const uint8_t* buf, int64_t len, int64_t n,
                    uint32_t* sip, uint32_t* dip, uint16_t* sport,
                    uint16_t* dport, uint8_t* proto, uint8_t* tcp_flags,
@@ -338,6 +509,22 @@ int64_t nfx_decode(const uint8_t* buf, int64_t len, int64_t n,
   int64_t i = 0;
   size_t off = 0;
   V9Templates tpls;
+  IpfixTemplates itpls;
+  auto write_sink = [&](const V9Record& r, double t0, double t1) {
+    if (i >= n) return false;
+    sip[i] = r.sip;
+    dip[i] = r.dip;
+    sport[i] = r.sport;
+    dport[i] = r.dport;
+    proto[i] = r.proto;
+    tcp_flags[i] = r.tcp_flags;
+    dpkts[i] = r.dpkts;
+    doctets[i] = r.doctets;
+    start_ts[i] = t0;
+    end_ts[i] = t1;
+    ++i;
+    return true;
+  };
   while (off < (size_t)len) {
     const uint16_t ver = ((size_t)len - off >= 2) ? be16(buf + off) : 0;
     if (ver == kVersion) {
@@ -355,24 +542,13 @@ int64_t nfx_decode(const uint8_t* buf, int64_t len, int64_t n,
     } else if (ver == kV9Version) {
       const size_t used = v9_packet_extent(buf + off, (size_t)len - off);
       if (used == 0) return -1;
-      bool ok = parse_v9_packet(
-          buf + off, used, &tpls,
-          [&](const V9Record& r, double t0, double t1) {
-            if (i >= n) return false;
-            sip[i] = r.sip;
-            dip[i] = r.dip;
-            sport[i] = r.sport;
-            dport[i] = r.dport;
-            proto[i] = r.proto;
-            tcp_flags[i] = r.tcp_flags;
-            dpkts[i] = r.dpkts;
-            doctets[i] = r.doctets;
-            start_ts[i] = t0;
-            end_ts[i] = t1;
-            ++i;
-            return true;
-          });
-      if (!ok) return -1;
+      if (!parse_v9_packet(buf + off, used, &tpls, write_sink)) return -1;
+      off += used;
+    } else if (ver == kIpfixVersion) {
+      const size_t used = ipfix_packet_extent(buf + off, (size_t)len - off);
+      if (used == 0) return -1;
+      if (!parse_ipfix_packet(buf + off, used, &itpls, write_sink))
+        return -1;
       off += used;
     } else {
       return -1;
@@ -412,13 +588,17 @@ int main(int argc, char** argv) {
 
   const int64_t n = nfx_count(buf.data(), sz);
   if (n < 0) {
-    std::fprintf(stderr, "malformed netflow v5/v9 stream\n");
+    std::fprintf(stderr, "malformed netflow v5/v9/ipfix stream\n");
     return 1;
   }
-  std::vector<uint32_t> sip(n), dip(n), dpkts(n), doctets(n);
-  std::vector<uint16_t> sport(n), dport(n);
-  std::vector<uint8_t> proto(n), flags(n);
-  std::vector<double> t0(n), t1(n);
+  // n == 0 is legal (e.g. data sets whose template was never seen):
+  // size the vectors at >=1 so .data() is non-null for the FFI's
+  // null-pointer guard, and print just the header.
+  const size_t cap = n > 0 ? (size_t)n : 1;
+  std::vector<uint32_t> sip(cap), dip(cap), dpkts(cap), doctets(cap);
+  std::vector<uint16_t> sport(cap), dport(cap);
+  std::vector<uint8_t> proto(cap), flags(cap);
+  std::vector<double> t0(cap), t1(cap);
   if (nfx_decode(buf.data(), sz, n, sip.data(), dip.data(), sport.data(),
                  dport.data(), proto.data(), flags.data(), dpkts.data(),
                  doctets.data(), t0.data(), t1.data()) != n) {

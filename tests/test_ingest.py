@@ -405,3 +405,88 @@ def test_v9_source_ids_do_not_collide():
                         0x00010001) + data_set
     out = nfd.decode_bytes(a + b_pkt)
     assert len(out) == len(table)       # only A's records decode
+
+
+@needs_decoder
+@pytest.mark.parametrize("long_form", [False, True])
+def test_ipfix_roundtrip_exact(long_form):
+    """IPFIX (RFC 7011) round-trip: enterprise field skipped by length,
+    variable-length field walked per record (both 1-byte and 255+uint16
+    prefixes), options template + its data set skipped whole,
+    millisecond timestamp IEs carried exactly."""
+    table = _synth_flow_arrays(n=57, seed=6)   # partial last packet
+    blob = nfd.write_ipfix(table, varlen_long_form=long_form)
+    out = nfd.decode_bytes(blob)
+    assert len(out) == 57
+    np.testing.assert_array_equal(nfd.str_to_ip(out["sip"]),
+                                  table["sip"].to_numpy())
+    np.testing.assert_array_equal(nfd.str_to_ip(out["dip"]),
+                                  table["dip"].to_numpy())
+    np.testing.assert_array_equal(out["sport"].to_numpy(np.int64),
+                                  table["sport"].to_numpy())
+    np.testing.assert_array_equal(out["dport"].to_numpy(np.int64),
+                                  table["dport"].to_numpy())
+    np.testing.assert_array_equal(out["ipkt"].to_numpy(np.int64),
+                                  table["ipkt"].to_numpy())
+    np.testing.assert_array_equal(out["ibyt"].to_numpy(np.int64),
+                                  table["ibyt"].to_numpy())
+    np.testing.assert_array_equal(out["tcp_flags"].to_numpy(np.int64),
+                                  table["tcp_flags"].to_numpy())
+    got = (pd.to_datetime(out["treceived"]).to_numpy()
+           .astype("datetime64[s]").astype(np.int64).astype(np.float64))
+    assert np.abs(got - table["start_ts"].to_numpy()).max() < 1.0
+
+
+@needs_decoder
+def test_mixed_v5_v9_ipfix_stream():
+    """All three wire formats concatenated in one capture decode in
+    stream order, each through its own template state."""
+    t5 = _synth_flow_arrays(n=10, seed=7)
+    t9 = _synth_flow_arrays(n=11, seed=8)
+    t10 = _synth_flow_arrays(n=12, seed=9)
+    blob = (nfd.write_v5(t5) + nfd.write_v9(t9) + nfd.write_ipfix(t10)
+            + nfd.write_v5(t5))
+    out = nfd.decode_bytes(blob)
+    assert len(out) == 10 + 11 + 12 + 10
+    np.testing.assert_array_equal(
+        nfd.str_to_ip(out["sip"].iloc[10:21]), t9["sip"].to_numpy())
+    np.testing.assert_array_equal(
+        nfd.str_to_ip(out["sip"].iloc[21:33]), t10["sip"].to_numpy())
+
+
+@needs_decoder
+def test_ipfix_unknown_template_and_truncation():
+    table = _synth_flow_arrays(n=8, seed=10)
+    blob = nfd.write_ipfix(table)
+    # Strip the template set: records under an unannounced template are
+    # skipped, not errors (exporters re-send templates periodically).
+    import struct as _s
+    msg_len = _s.unpack(">H", blob[2:4])[0]
+    # walk sets of the first message, rebuild without set id 2
+    off, sets = 16, []
+    while off < msg_len:
+        sid, slen = _s.unpack(">HH", blob[off:off + 4])
+        if sid != 2:
+            sets.append(blob[off:off + slen])
+        off += slen
+    body = b"".join(sets)
+    stripped = (_s.pack(">HHIII", 10, 16 + len(body),
+                        *_s.unpack(">III", blob[4:16])) + body
+                + blob[msg_len:])
+    out = nfd.decode_bytes(stripped)
+    assert len(out) == 0 or len(out) < len(table)
+    # Truncated mid-message is malformed (explicit length framing).
+    with pytest.raises(ValueError):
+        nfd.decode_bytes(blob[:len(blob) - 5])
+
+
+@needs_decoder
+def test_nfcapd_magic_dispatch(tmp_path, monkeypatch):
+    """An nfcapd-magic file routes to the nfdump passthrough; without
+    nfdump installed that is a clear DecoderUnavailable, never a
+    misparse as wire format."""
+    p = tmp_path / "nfcapd.202607080000"
+    p.write_bytes(b"\x0c\xa5" + b"\x00" * 64)
+    monkeypatch.setenv("PATH", str(tmp_path))   # hide any real nfdump
+    with pytest.raises(nfd.DecoderUnavailable):
+        nfd.decode_file(p)
