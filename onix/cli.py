@@ -59,6 +59,21 @@ def build_parser() -> argparse.ArgumentParser:
     p_ingest.add_argument("datatype", choices=("flow", "dns", "proxy"))
     p_ingest.add_argument("paths", nargs="+", help="raw capture/log files")
 
+    p_watch = sub.add_parser(
+        "watch", help="watch a landing directory and ingest new files; "
+                      "--procs fans out over worker processes (run the "
+                      "same command on N hosts sharing the directory to "
+                      "scale out)")
+    _add_common(p_watch)
+    p_watch.add_argument("datatype", choices=("flow", "dns", "proxy"))
+    p_watch.add_argument("landing_dir")
+    p_watch.add_argument("--procs", type=int, default=1,
+                         help="worker processes (1 = in-process watcher)")
+    p_watch.add_argument("--max-seconds", type=float, default=None,
+                         help="stop after this long (default: forever)")
+    p_watch.add_argument("--drain", action="store_true",
+                         help="exit once a poll finds nothing to claim")
+
     p_stream = sub.add_parser(
         "stream", help="streaming scoring: online-VB model updated and "
                        "scored per ingest minibatch (one file = one batch)")
@@ -152,6 +167,33 @@ def main(argv: list[str] | None = None) -> int:
     if args.command == "ingest":
         from onix.ingest.run import run_ingest
         return run_ingest(cfg, args.datatype, args.paths)
+
+    if args.command == "watch":
+        if args.procs > 1:
+            from onix.ingest.mpingest import run_workers
+            stats = run_workers(cfg, args.datatype, args.landing_dir,
+                                n_procs=args.procs,
+                                max_seconds=args.max_seconds,
+                                idle_exit=args.drain)
+            print(f"onix watch: {stats['files']} files, {stats['rows']} "
+                  f"rows, {stats['errors']} errors "
+                  f"({stats['workers']} workers)")
+            return 1 if stats["errors"] else 0
+        import time as time_mod
+        from onix.ingest.watcher import IngestWatcher
+        w = IngestWatcher(cfg, args.datatype, args.landing_dir,
+                          require_stable=not args.drain)
+        if args.drain:
+            t0 = time_mod.monotonic()
+            while w.poll_once():
+                if (args.max_seconds is not None
+                        and time_mod.monotonic() - t0 > args.max_seconds):
+                    break
+        else:
+            w.run(max_seconds=args.max_seconds)
+        print(f"onix watch: {w.stats['files']} files, {w.stats['rows']} "
+              f"rows, {w.stats['errors']} errors")
+        return 1 if w.stats["errors"] else 0
 
     if args.command == "stream":
         from onix.pipelines.streaming import run_stream

@@ -1,0 +1,231 @@
+"""Multi-process / multi-host parallel ingest.
+
+The reference's "Parallel Ingest Framework" fans work out from a master
+collector to worker processes across nodes over Kafka partitions
+(reference README.md:35-38; SURVEY.md §3.2). onix keeps that fan-out
+shape with the shared filesystem as the coordination plane instead of a
+broker: any number of worker PROCESSES — on one machine or many hosts
+mounting the same landing directory — consume the same directory of
+capture files with no master and no broker.
+
+Coordination protocol (all steps NFS-safe — no flock):
+
+  claim   a worker reserves a file by creating
+          `.onix_claims/<digest>.claim` with O_EXCL (atomic create;
+          exactly one creator wins). <digest> hashes the file's resolved
+          path + size + mtime, so a file that later grows or is
+          re-delivered gets a fresh identity and is re-ingested —
+          identical semantics to the single-process watcher's ledger.
+  commit  after the rows are durably in the store, the claim is renamed
+          to `<digest>.done` (atomic rename). A crash before commit
+          leaves a claim but no done marker.
+  lease   a claim older than `lease_seconds` with no done marker is
+          presumed dead. Takeover: rename it to a unique tombstone —
+          rename is atomic, so exactly one contender wins — then claim
+          fresh. At-least-once delivery, like Kafka offset redelivery.
+
+Part-file writes are safe under this concurrency because Store.append
+allocates part numbers with an atomic hard-link (see onix/store.py).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import multiprocessing
+import os
+import pathlib
+import socket
+import time
+
+from onix.config import OnixConfig
+from onix.ingest.run import ingest_file
+from onix.store import Store
+
+log = logging.getLogger("onix.ingest.mp")
+
+CLAIMS_DIR = ".onix_claims"
+DEFAULT_PATTERNS = ("*.nf5", "*.tsv", "*.log", "*.csv", "*.pcap")
+
+
+def _digest(path: pathlib.Path) -> tuple[str, dict]:
+    st = path.stat()
+    ident = f"{path.resolve()}|{st.st_size}|{st.st_mtime}"
+    return hashlib.sha1(ident.encode()).hexdigest()[:24], {
+        "path": str(path.resolve()),
+        "size": st.st_size,
+        "mtime": st.st_mtime,
+    }
+
+
+class ClaimStore:
+    """The on-disk claim/done protocol for one landing directory."""
+
+    def __init__(self, landing: pathlib.Path, lease_seconds: float = 300.0):
+        self.dir = landing / CLAIMS_DIR
+        self.dir.mkdir(exist_ok=True)
+        self.lease_seconds = lease_seconds
+
+    def try_claim(self, path: pathlib.Path) -> str | None:
+        """Atomically claim `path`; returns the digest on success, None
+        if done, claimed by a live worker, or lost a race."""
+        digest, meta = _digest(path)
+        if (self.dir / f"{digest}.done").exists():
+            return None
+        claim = self.dir / f"{digest}.claim"
+        try:
+            st = claim.stat()
+        except FileNotFoundError:
+            st = None
+        if st is not None:
+            if time.time() - st.st_mtime < self.lease_seconds:
+                return None     # live claim — someone else is on it
+            # Stale claim: exactly one contender wins this rename.
+            tomb = self.dir / f"{digest}.stale-{os.getpid()}-{time.time_ns()}"
+            try:
+                os.rename(claim, tomb)
+            except FileNotFoundError:
+                return None     # another contender took it over first
+        meta.update(pid=os.getpid(), host=socket.gethostname(),
+                    claimed_at=time.time())
+        try:
+            with open(claim, "x") as f:     # O_EXCL: atomic create
+                json.dump(meta, f)
+        except FileExistsError:
+            return None
+        return digest
+
+    def commit(self, digest: str) -> None:
+        """Durably mark done (atomic rename of the claim)."""
+        os.rename(self.dir / f"{digest}.claim", self.dir / f"{digest}.done")
+
+    def release(self, digest: str) -> None:
+        """Drop a claim after a failed ingest so any worker may retry."""
+        (self.dir / f"{digest}.claim").unlink(missing_ok=True)
+
+    def done_count(self) -> int:
+        return sum(1 for _ in self.dir.glob("*.done"))
+
+
+def worker_loop(cfg: OnixConfig, datatype: str,
+                landing: str | pathlib.Path, *,
+                patterns: tuple[str, ...] = DEFAULT_PATTERNS,
+                poll_interval: float = 0.5,
+                max_seconds: float | None = None,
+                lease_seconds: float = 300.0,
+                settle_seconds: float = 2.0,
+                idle_exit: bool = False) -> dict:
+    """One worker process: claim→ingest→commit until stopped.
+
+    With `idle_exit`, returns after a poll that found nothing claimable
+    (batch drain mode); otherwise polls until `max_seconds`.
+
+    A file is only claimable once its mtime is at least `settle_seconds`
+    old — the multi-host rendering of the watcher's two-poll stability
+    gate. Claiming a still-growing capture would ingest its truncated
+    head, commit it done under the truncated signature, and then ingest
+    the finished file again under a fresh digest: head rows duplicated."""
+    landing = pathlib.Path(landing)
+    claims = ClaimStore(landing, lease_seconds=lease_seconds)
+    store = Store(cfg.store.root)
+    stats = {"files": 0, "rows": 0, "errors": 0}
+    t0 = time.monotonic()
+    while True:
+        dispatched = 0
+        candidates: list[pathlib.Path] = []
+        for pat in patterns:
+            candidates.extend(landing.glob(pat))
+        for path in sorted(candidates):
+            try:
+                if time.time() - path.stat().st_mtime < settle_seconds:
+                    continue    # possibly still being written
+                digest = claims.try_claim(path)
+            except OSError:
+                continue    # vanished between glob and stat
+            if digest is None:
+                continue
+            try:
+                counts = ingest_file(store, datatype, path)
+                claims.commit(digest)
+                stats["files"] += 1
+                stats["rows"] += sum(counts.values())
+                dispatched += 1
+            except Exception:
+                log.exception("mp ingest failed for %s (released)", path)
+                claims.release(digest)
+                stats["errors"] += 1
+        if idle_exit and dispatched == 0:
+            return stats
+        if max_seconds is not None and time.monotonic() - t0 > max_seconds:
+            return stats
+        time.sleep(poll_interval)
+
+
+def _worker_entry(cfg_dict: dict, datatype: str, landing: str,
+                  kwargs: dict, q) -> None:
+    from onix.config import from_dict
+    stats = worker_loop(from_dict(cfg_dict), datatype, landing, **kwargs)
+    q.put(stats)
+
+
+def run_workers(cfg: OnixConfig, datatype: str,
+                landing: str | pathlib.Path, n_procs: int = 4, *,
+                patterns: tuple[str, ...] = DEFAULT_PATTERNS,
+                poll_interval: float = 0.2,
+                max_seconds: float | None = None,
+                lease_seconds: float = 300.0,
+                settle_seconds: float = 2.0,
+                idle_exit: bool = True) -> dict:
+    """Fan ingest out over `n_procs` OS processes (the single-host
+    rendering of the reference's multi-node worker fleet — on a shared
+    filesystem the same invocation on N hosts cooperates identically).
+
+    Returns the merged stats dict. A worker that dies without reporting
+    (OOM kill, native crash) is counted under `dead_workers` and as an
+    error — the parent never hangs waiting for a corpse's stats; its
+    claimed file is released to other workers by the lease takeover."""
+    import queue as queue_mod
+
+    ctx = multiprocessing.get_context("spawn")   # fork is unsafe under JAX
+    q = ctx.Queue()
+    kwargs = dict(patterns=patterns, poll_interval=poll_interval,
+                  max_seconds=max_seconds, lease_seconds=lease_seconds,
+                  settle_seconds=settle_seconds, idle_exit=idle_exit)
+    procs = [ctx.Process(target=_worker_entry,
+                         args=(cfg.to_dict(), datatype, str(landing),
+                               kwargs, q))
+             for _ in range(n_procs)]
+    for p in procs:
+        p.start()
+    merged = {"files": 0, "rows": 0, "errors": 0, "workers": n_procs,
+              "dead_workers": 0}
+    reported = 0
+    while reported < n_procs:
+        try:
+            st = q.get(timeout=0.5)
+        except queue_mod.Empty:
+            if not any(p.is_alive() for p in procs):
+                # Last drain: a worker may have flushed its stats right
+                # before exiting.
+                try:
+                    while reported < n_procs:
+                        st = q.get(timeout=0.2)
+                        for k in ("files", "rows", "errors"):
+                            merged[k] += st[k]
+                        reported += 1
+                except queue_mod.Empty:
+                    pass
+                break   # remaining workers died without reporting
+            continue
+        for k in ("files", "rows", "errors"):
+            merged[k] += st[k]
+        reported += 1
+    for p in procs:
+        p.join()
+    dead = n_procs - reported
+    if dead:
+        log.error("%d ingest worker(s) died without reporting", dead)
+        merged["dead_workers"] = dead
+        merged["errors"] += dead
+    return merged

@@ -11,14 +11,11 @@ property from HDFS staging files.
 from __future__ import annotations
 
 import pathlib
-import threading
 
 import pandas as pd
 
 from onix.config import OnixConfig
 from onix.store import Store
-
-_part_lock = threading.Lock()
 
 
 def decode(datatype: str, path: str | pathlib.Path) -> pd.DataFrame:
@@ -47,28 +44,17 @@ def _day_of(datatype: str, table: pd.DataFrame) -> pd.Series:
     return table["p_date"].astype(str)
 
 
-def _next_part(store: Store, datatype: str, date: str) -> int:
-    """Next free part number for a partition (single-writer discipline:
-    guarded by a process-wide lock; SURVEY.md §5.2 'deterministic
-    single-writer queues')."""
-    pdir = store.partition_dir(datatype, date)
-    existing = sorted(pdir.glob("part-*.parquet"))
-    return (int(existing[-1].stem.split("-")[1]) + 1) if existing else 0
-
-
 def ingest_file(store: Store, datatype: str,
                 path: str | pathlib.Path) -> dict[str, int]:
     """Decode one raw file and append its rows to the day partitions it
-    spans. Returns {date: n_rows}."""
+    spans (Store.append allocates part numbers atomically, so parallel
+    worker threads AND processes never collide). Returns {date: n_rows}."""
     table = decode(datatype, path)
     out: dict[str, int] = {}
     if not len(table):
         return out
     for date, day_rows in table.groupby(_day_of(datatype, table)):
-        with _part_lock:
-            part = _next_part(store, datatype, str(date))
-            store.write(datatype, str(date), day_rows.reset_index(drop=True),
-                        part=part)
+        store.append(datatype, str(date), day_rows.reset_index(drop=True))
         out[str(date)] = len(day_rows)
     return out
 

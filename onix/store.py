@@ -14,8 +14,10 @@ are files, not RPCs") so every stage stays independently re-runnable.
 from __future__ import annotations
 
 import dataclasses
+import os
 import pathlib
 import re
+import uuid
 
 import numpy as np
 import pandas as pd
@@ -47,6 +49,38 @@ class Store:
         path = pdir / f"part-{part:05d}.parquet"
         table.to_parquet(path, index=False)
         return path
+
+    def append(self, datatype: str, date: str,
+               table: pd.DataFrame) -> pathlib.Path:
+        """Append rows as the next free part file, safely across
+        processes AND hosts sharing the store.
+
+        The parquet is written to a unique temp name, then `os.link`ed
+        into the next free `part-NNNNN` slot — link fails atomically
+        (EEXIST) if another writer took the slot first (works on POSIX
+        local filesystems and NFSv3+, unlike flock), in which case the
+        next slot is tried. The visible part file is therefore always a
+        complete parquet."""
+        pdir = self.partition_dir(datatype, date)
+        pdir.mkdir(parents=True, exist_ok=True)
+        tmp = pdir / f".tmp-{os.getpid()}-{uuid.uuid4().hex[:8]}.parquet"
+        table.to_parquet(tmp, index=False)
+        try:
+            while True:
+                # Numeric max, not lexicographic sort: at >=100001 parts
+                # the 6-digit names sort before 5-digit ones and a
+                # lexicographic last() would retry a taken slot forever.
+                part = 1 + max(
+                    (int(p.stem.split("-")[1])
+                     for p in pdir.glob("part-*.parquet")), default=-1)
+                path = pdir / f"part-{part:05d}.parquet"
+                try:
+                    os.link(tmp, path)
+                    return path
+                except FileExistsError:
+                    continue    # lost the slot race; try the next number
+        finally:
+            tmp.unlink(missing_ok=True)
 
     def read(self, datatype: str, date: str) -> pd.DataFrame:
         """Read a full day partition (all part files, concatenated in order)."""

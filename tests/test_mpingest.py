@@ -1,0 +1,165 @@
+"""Multi-process ingest: the shared-filesystem claim protocol that
+renders the reference's Kafka worker fan-out (README.md:35-38,
+SURVEY.md §3.2) without a broker, and the atomic part allocation in
+Store.append that makes concurrent writers safe."""
+
+import concurrent.futures
+import json
+import os
+import time
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from onix.config import OnixConfig
+from onix.ingest.mpingest import ClaimStore, run_workers, worker_loop
+from onix.ingest.parsers import format_bluecoat
+from onix.pipelines.synth import synth_proxy_day
+from onix.store import Store
+
+
+def _landing_with_logs(tmp_path, n_files=6, rows_per_file=40):
+    landing = tmp_path / "landing"
+    landing.mkdir()
+    total = 0
+    for i in range(n_files):
+        table, _ = synth_proxy_day(n_events=rows_per_file, n_anomalies=2,
+                                   seed=i)
+        p = landing / f"proxy-{i:03d}.log"
+        p.write_text(format_bluecoat(table))
+        # Backdate past the settle gate (fresh files are presumed to be
+        # still growing and are skipped).
+        old = time.time() - 60
+        os.utime(p, (old, old))
+        total += len(table)
+    return landing, total
+
+
+def test_store_append_is_concurrency_safe(tmp_path):
+    """32 concurrent appends to one partition: every append lands in its
+    own part file, none clobbered (the hard-link slot race)."""
+    store = Store(tmp_path / "store")
+    frames = [pd.DataFrame({"x": np.full(5, i)}) for i in range(32)]
+    with concurrent.futures.ThreadPoolExecutor(8) as pool:
+        list(pool.map(lambda t: store.append("flow", "2016-07-08", t),
+                      frames))
+    out = store.read("flow", "2016-07-08")
+    assert len(out) == 32 * 5
+    assert sorted(np.unique(out["x"])) == list(range(32))
+
+
+def test_single_worker_drains_and_commits(tmp_path):
+    landing, total = _landing_with_logs(tmp_path, n_files=4)
+    cfg = OnixConfig()
+    cfg.store.root = str(tmp_path / "store")
+    cfg.validate()
+    stats = worker_loop(cfg, "proxy", landing, idle_exit=True)
+    assert stats["files"] == 4 and stats["errors"] == 0
+    assert stats["rows"] == total
+    claims = ClaimStore(landing)
+    assert claims.done_count() == 4
+    # Second drain: everything is done-marked, nothing re-ingested.
+    stats2 = worker_loop(cfg, "proxy", landing, idle_exit=True)
+    assert stats2["files"] == 0
+    store = Store(cfg.store.root)
+    assert len(store.read("proxy", "2016-07-08")) == total
+
+
+def test_multiprocess_drain_exactly_once(tmp_path):
+    """3 worker processes drain 6 files: every row lands exactly once
+    (claims partition the work; no duplicates, no loss)."""
+    landing, total = _landing_with_logs(tmp_path, n_files=6)
+    cfg = OnixConfig()
+    cfg.store.root = str(tmp_path / "store")
+    cfg.validate()
+    stats = run_workers(cfg, "proxy", landing, n_procs=3, idle_exit=True)
+    assert stats["errors"] == 0
+    assert stats["files"] == 6
+    assert stats["rows"] == total
+    store = Store(cfg.store.root)
+    assert len(store.read("proxy", "2016-07-08")) == total
+    assert ClaimStore(landing).done_count() == 6
+
+
+def test_stale_claim_takeover(tmp_path):
+    """A claim whose worker died is taken over after the lease expires —
+    exactly one contender wins the tombstone rename."""
+    landing, _ = _landing_with_logs(tmp_path, n_files=1)
+    path = next(landing.glob("*.log"))
+    claims = ClaimStore(landing, lease_seconds=0.2)
+    d1 = claims.try_claim(path)
+    assert d1 is not None
+    # Live claim: refused.
+    assert claims.try_claim(path) is None
+    time.sleep(0.25)
+    # Lease expired: takeover succeeds and yields the same digest.
+    d2 = claims.try_claim(path)
+    assert d2 == d1
+    tombs = list((landing / ".onix_claims").glob("*.stale-*"))
+    assert len(tombs) == 1
+    claims.commit(d2)
+    assert claims.try_claim(path) is None   # done is done
+
+
+def test_modified_file_gets_fresh_identity(tmp_path):
+    """Appending rows to an already-ingested file changes its digest, so
+    the grown file is re-offered (the watcher-ledger semantics)."""
+    landing, _ = _landing_with_logs(tmp_path, n_files=1)
+    path = next(landing.glob("*.log"))
+    claims = ClaimStore(landing)
+    d1 = claims.try_claim(path)
+    claims.commit(d1)
+    assert claims.try_claim(path) is None
+    extra, _ = synth_proxy_day(n_events=10, n_anomalies=1, seed=99)
+    with open(path, "a") as f:
+        f.write(format_bluecoat(extra))
+    os.utime(path, (time.time() + 5, time.time() + 5))
+    d2 = claims.try_claim(path)
+    assert d2 is not None and d2 != d1
+
+
+def test_failed_ingest_releases_claim(tmp_path):
+    """A file that fails to parse is released (retryable), not wedged,
+    and the worker reports the error."""
+    landing = tmp_path / "landing"
+    landing.mkdir()
+    bad = landing / "bad.log"
+    bad.write_text("not a bluecoat line at all\n")
+    os.utime(bad, (time.time() - 60, time.time() - 60))
+    cfg = OnixConfig()
+    cfg.store.root = str(tmp_path / "store")
+    cfg.validate()
+    stats = worker_loop(cfg, "proxy", landing, idle_exit=True)
+    assert stats["errors"] == 1 and stats["files"] == 0
+    claims = ClaimStore(landing)
+    assert claims.done_count() == 0
+    assert not list((landing / ".onix_claims").glob("*.claim"))
+
+
+def test_fresh_files_wait_for_settle(tmp_path):
+    """A just-written (possibly still growing) file is not claimed until
+    its mtime is settle_seconds old — the truncated-head guard."""
+    landing = tmp_path / "landing"
+    landing.mkdir()
+    table, _ = synth_proxy_day(n_events=20, n_anomalies=1, seed=0)
+    (landing / "hot.log").write_text(format_bluecoat(table))   # fresh mtime
+    cfg = OnixConfig()
+    cfg.store.root = str(tmp_path / "store")
+    cfg.validate()
+    stats = worker_loop(cfg, "proxy", landing, idle_exit=True,
+                        settle_seconds=30.0)
+    assert stats["files"] == 0          # skipped, not half-ingested
+    stats = worker_loop(cfg, "proxy", landing, idle_exit=True,
+                        settle_seconds=0.0)
+    assert stats["files"] == 1
+
+
+def test_claim_meta_records_owner(tmp_path):
+    landing, _ = _landing_with_logs(tmp_path, n_files=1)
+    path = next(landing.glob("*.log"))
+    claims = ClaimStore(landing)
+    d = claims.try_claim(path)
+    meta = json.loads((landing / ".onix_claims" / f"{d}.claim").read_text())
+    assert meta["pid"] == os.getpid()
+    assert meta["path"] == str(path.resolve())
