@@ -279,7 +279,10 @@ REPUTATION_REGISTRY = {
 
 
 def build_reputation(specs: str) -> list[ReputationClient]:
-    """Parse comma-separated plugin specs: `local:<path>` / `noop`."""
+    """Parse comma-separated plugin specs: `local:<path>` / `noop` /
+    `http:<url>` (spec splits at the FIRST colon, so URLs pass through
+    intact)."""
+    from onix.oa import repclients  # noqa: F401  (registers "http")
     clients: list[ReputationClient] = []
     for spec in (s.strip() for s in specs.split(",") if s.strip()):
         name, _, arg = spec.partition(":")

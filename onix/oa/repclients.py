@@ -1,0 +1,189 @@
+"""Network-backed reputation clients with failure discipline.
+
+The reference's OA layer enriches suspicious connects through external
+reputation services — McAfee GTI and Facebook ThreatExchange plugin
+clients (SURVEY.md §2.1 #12; reference README.md:45-48 "attack
+heuristics"). Those services need credentials and egress, so what this
+module owns is the part that makes a network client PRODUCTION-grade
+rather than a demo: request batching, per-request timeouts, bounded
+retries with exponential backoff (5xx/transport errors only — a 4xx is
+a contract bug and retrying it is abuse), a circuit breaker that stops
+hammering a dead service, and a TTL cache so one run never asks twice.
+
+Enrichment is advisory: every failure path degrades to "NONE" rather
+than blocking the scoring pipeline (fail-open). The transport is
+injectable, so the discipline is fully testable offline — and a real
+deployment points the same client at its service endpoint.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+import urllib.error
+import urllib.request
+
+from onix.oa.components import REPUTATION_REGISTRY, ReputationClient
+
+log = logging.getLogger("onix.oa.reputation")
+
+
+class TransportError(RuntimeError):
+    """Connection-level failure (DNS, refused, timeout) — retryable."""
+
+
+def _urllib_transport(url: str, payload: bytes, timeout: float,
+                      headers: dict) -> tuple[int, bytes]:
+    req = urllib.request.Request(url, data=payload, method="POST",
+                                 headers={"Content-Type": "application/json",
+                                          **headers})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as e:          # non-2xx WITH a response
+        return e.code, e.read()
+    except (urllib.error.URLError, OSError, TimeoutError) as e:
+        raise TransportError(str(e)) from e
+
+
+class CircuitBreaker:
+    """Open after `threshold` consecutive failures; half-open (one trial
+    request allowed) after `cooldown` seconds."""
+
+    def __init__(self, threshold: int = 5, cooldown: float = 60.0):
+        self.threshold = threshold
+        self.cooldown = cooldown
+        self.failures = 0
+        self.opened_at: float | None = None
+
+    def allow(self) -> bool:
+        if self.opened_at is None:
+            return True
+        if time.monotonic() - self.opened_at >= self.cooldown:
+            return True     # half-open: let one trial through
+        return False
+
+    def record(self, ok: bool) -> None:
+        if ok:
+            self.failures = 0
+            self.opened_at = None
+        else:
+            self.failures += 1
+            if self.failures >= self.threshold:
+                self.opened_at = time.monotonic()
+
+
+class HTTPReputationClient(ReputationClient):
+    """Batched JSON-over-HTTP reputation lookups with failure discipline.
+
+    Wire contract (the shape GTI/ThreatExchange-style services share):
+    POST {"indicators": [...]} -> {"results": {indicator: LEVEL}} with
+    LEVEL in NONE/LOW/MEDIUM/HIGH; unknown indicators may be omitted.
+    Subclass and override `encode_request`/`parse_response` to adapt a
+    specific vendor's schema — the discipline underneath is shared.
+    """
+
+    name = "http"
+
+    def __init__(self, url: str = "", *, api_key: str = "",
+                 batch_size: int = 100, timeout: float = 5.0,
+                 max_retries: int = 3, backoff_base: float = 0.25,
+                 cache_ttl: float = 3600.0, transport=None,
+                 breaker: CircuitBreaker | None = None, sleep=time.sleep):
+        if not url:
+            raise ValueError("http reputation plugin needs a URL "
+                             "(spec: http:<url>)")
+        self.url = url
+        self.api_key = api_key
+        self.batch_size = batch_size
+        self.timeout = timeout
+        self.max_retries = max_retries
+        self.backoff_base = backoff_base
+        self.cache_ttl = cache_ttl
+        self.transport = transport or _urllib_transport
+        self.breaker = breaker or CircuitBreaker()
+        self.sleep = sleep
+        self._cache: dict[str, tuple[float, str]] = {}
+        self.stats = {"requests": 0, "retries": 0, "failures": 0,
+                      "cache_hits": 0, "breaker_skips": 0}
+
+    # -- vendor adaptation points -----------------------------------------
+
+    def encode_request(self, batch: list[str]) -> bytes:
+        return json.dumps({"indicators": batch}).encode()
+
+    def parse_response(self, body: bytes) -> dict[str, str]:
+        data = json.loads(body)
+        results = data.get("results", {})
+        if not isinstance(results, dict):
+            raise ValueError("results must be an object")
+        return {str(k): str(v).upper() for k, v in results.items()}
+
+    # -- discipline --------------------------------------------------------
+
+    def _post_batch(self, batch: list[str]) -> dict[str, str]:
+        """One batch with retries; raises on definitive failure."""
+        headers = {"Authorization": f"Bearer {self.api_key}"} \
+            if self.api_key else {}
+        last: Exception | None = None
+        for attempt in range(self.max_retries + 1):
+            if attempt:
+                self.stats["retries"] += 1
+                # Exponential backoff; deterministic (tests inject sleep).
+                self.sleep(self.backoff_base * (2 ** (attempt - 1)))
+            try:
+                self.stats["requests"] += 1
+                status, body = self.transport(self.url,
+                                              self.encode_request(batch),
+                                              self.timeout, headers)
+            except TransportError as e:
+                last = e
+                continue
+            if 200 <= status < 300:
+                return self.parse_response(body)
+            if 500 <= status < 600 or status == 429:
+                last = RuntimeError(f"HTTP {status}")
+                continue
+            # 4xx: our request is wrong; retrying is abuse. Definitive.
+            raise RuntimeError(f"HTTP {status} (not retryable)")
+        raise last if last else RuntimeError("unreachable")
+
+    def check(self, values: list[str]) -> dict[str, str]:
+        now = time.monotonic()
+        out: dict[str, str] = {}
+        todo: list[str] = []
+        for v in values:
+            hit = self._cache.get(v)
+            if hit is not None and now - hit[0] < self.cache_ttl:
+                out[v] = hit[1]
+                self.stats["cache_hits"] += 1
+            else:
+                todo.append(v)
+        for lo in range(0, len(todo), self.batch_size):
+            batch = todo[lo:lo + self.batch_size]
+            if not self.breaker.allow():
+                self.stats["breaker_skips"] += 1
+                out.update({v: "NONE" for v in batch})   # fail-open
+                continue
+            try:
+                got = self._post_batch(batch)
+                self.breaker.record(True)
+            except Exception as e:
+                self.breaker.record(False)
+                self.stats["failures"] += 1
+                log.warning("reputation lookup failed (%s): %s — "
+                            "degrading %d indicators to NONE",
+                            self.url, e, len(batch))
+                out.update({v: "NONE" for v in batch})   # fail-open
+                continue
+            for v in batch:
+                level = got.get(v, "NONE")
+                if level not in ("NONE", "LOW", "MEDIUM", "HIGH"):
+                    level = "NONE"
+                self._cache[v] = (now, level)
+                out[v] = level
+        return out
+
+
+REPUTATION_REGISTRY["http"] = HTTPReputationClient

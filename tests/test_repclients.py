@@ -1,0 +1,147 @@
+"""Failure discipline of the network reputation client (SURVEY.md §2.1
+#12): batching, retry/backoff, 4xx fast-fail, circuit breaker, TTL
+cache, fail-open degradation — all driven through an injected transport
+(this image has no egress; the discipline is the product)."""
+
+import json
+
+import pytest
+
+from onix.oa.components import build_reputation, reputation_column
+from onix.oa.repclients import (CircuitBreaker, HTTPReputationClient,
+                                TransportError)
+
+
+class FakeTransport:
+    """Scripted transport: pop one behavior per call.
+
+    Behaviors: ("ok", {ind: level}) | ("status", code) | "down".
+    """
+
+    def __init__(self, script):
+        self.script = list(script)
+        self.calls = []
+
+    def __call__(self, url, payload, timeout, headers):
+        self.calls.append((url, json.loads(payload), headers))
+        beh = self.script.pop(0) if self.script else self.script_default
+        if beh == "down":
+            raise TransportError("connection refused")
+        kind, arg = beh
+        if kind == "ok":
+            return 200, json.dumps({"results": arg}).encode()
+        return arg, b"{}"
+
+    script_default = ("ok", {})
+
+
+def _client(script, **kw):
+    t = FakeTransport(script)
+    kw.setdefault("sleep", lambda s: None)
+    c = HTTPReputationClient("https://rep.example/api", transport=t, **kw)
+    return c, t
+
+
+def test_happy_path_batches_and_caches():
+    c, t = _client([("ok", {"1.2.3.4": "HIGH", "evil.biz": "MEDIUM"})])
+    got = c.check(["1.2.3.4", "evil.biz", "benign.org"])
+    assert got == {"1.2.3.4": "HIGH", "evil.biz": "MEDIUM",
+                   "benign.org": "NONE"}
+    assert len(t.calls) == 1
+    # Second call: all three answered from cache, no request.
+    got2 = c.check(["1.2.3.4", "evil.biz", "benign.org"])
+    assert got2 == got
+    assert len(t.calls) == 1
+    assert c.stats["cache_hits"] == 3
+
+
+def test_batching_respects_batch_size():
+    c, t = _client([("ok", {}), ("ok", {}), ("ok", {})], batch_size=2)
+    c.check([f"10.0.0.{i}" for i in range(5)])
+    assert [len(call[1]["indicators"]) for call in t.calls] == [2, 2, 1]
+
+
+def test_retry_then_success_with_backoff():
+    sleeps = []
+    c, t = _client(["down", ("status", 503),
+                    ("ok", {"1.2.3.4": "HIGH"})],
+                   sleep=sleeps.append, backoff_base=0.25)
+    got = c.check(["1.2.3.4"])
+    assert got["1.2.3.4"] == "HIGH"
+    assert len(t.calls) == 3
+    assert sleeps == [0.25, 0.5]           # exponential
+    assert c.stats["retries"] == 2 and c.stats["failures"] == 0
+
+
+def test_4xx_is_definitive_no_retry():
+    c, t = _client([("status", 403)], max_retries=3)
+    got = c.check(["1.2.3.4"])
+    assert got["1.2.3.4"] == "NONE"        # fail-open
+    assert len(t.calls) == 1               # no retry on auth errors
+    assert c.stats["failures"] == 1
+
+
+def test_exhausted_retries_fail_open():
+    c, t = _client(["down"] * 10, max_retries=2)
+    got = c.check(["1.2.3.4", "5.6.7.8"])
+    assert set(got.values()) == {"NONE"}
+    assert c.stats["failures"] == 1        # one batch failed
+    assert len(t.calls) == 3               # initial + 2 retries
+
+
+def test_circuit_breaker_opens_and_half_opens():
+    clock = [0.0]
+    br = CircuitBreaker(threshold=2, cooldown=60)
+    c, t = _client(["down"] * 100, max_retries=0, breaker=br)
+    c.check(["a"])          # failure 1
+    c.check(["b"])          # failure 2 -> breaker opens
+    n_before = len(t.calls)
+    got = c.check(["c"])    # breaker open: no network call at all
+    assert got["c"] == "NONE"
+    assert len(t.calls) == n_before
+    assert c.stats["breaker_skips"] == 1
+    # After cooldown the half-open trial goes to the network again.
+    br.opened_at -= 61
+    c.check(["d"])
+    assert len(t.calls) == n_before + 1
+
+
+def test_breaker_closes_on_success():
+    c, t = _client(["down", "down", ("ok", {"x": "LOW"})],
+                   max_retries=0,
+                   breaker=CircuitBreaker(threshold=5, cooldown=60))
+    c.check(["a"])
+    c.check(["b"])
+    got = c.check(["x"])
+    assert got["x"] == "LOW"
+    assert c.breaker.failures == 0 and c.breaker.opened_at is None
+
+
+def test_garbage_levels_and_payloads_degrade():
+    c, _ = _client([("ok", {"a": "SUPERBAD"})])
+    assert c.check(["a"])["a"] == "NONE"   # unknown level sanitized
+    c2, _ = _client([(("ok"), "not-a-dict")])
+    assert c2.check(["b"])["b"] == "NONE"  # malformed body -> fail-open
+
+
+def test_api_key_sent_as_bearer():
+    c, t = _client([("ok", {})], api_key="sekrit")
+    c.check(["a"])
+    assert t.calls[0][2]["Authorization"] == "Bearer sekrit"
+
+
+def test_registry_spec_preserves_url():
+    clients = build_reputation("http:https://rep.example/v1/check")
+    assert len(clients) == 1
+    assert clients[0].url == "https://rep.example/v1/check"
+    with pytest.raises(ValueError):
+        build_reputation("http")           # URL is required
+
+
+def test_reputation_column_merges_with_local(tmp_path):
+    lst = tmp_path / "bad.txt"
+    lst.write_text("evil.biz,MEDIUM\n")
+    http, _ = _client([("ok", {"evil.biz": "HIGH"})])
+    local = build_reputation(f"local:{lst}")[0]
+    col = reputation_column([local, http], ["evil.biz", "fine.org"])
+    assert list(col) == ["HIGH", "NONE"]   # max across clients
