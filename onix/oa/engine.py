@@ -13,6 +13,7 @@ Output layout under `cfg.oa.data_dir`:
     <datatype>/<YYYYMMDD>/suspicious.json   same rows for the UI fetch
     <datatype>/<YYYYMMDD>/summary.json      stats/histogram/timeline
     <datatype>/<YYYYMMDD>/graph.json        network graph nodes+links
+    <datatype>/<YYYYMMDD>/storyboard.json   per-actor threat cards
     <datatype>/dates.json                   date index for the picker
 """
 
@@ -121,6 +122,78 @@ def _graph(df: pd.DataFrame, datatype: str) -> dict:
     }
 
 
+def _human_bytes(n: float) -> str:
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if n < 1024 or unit == "TB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024
+
+
+# (actor column, peer column, peer noun) per datatype — the storyboard
+# groups suspicious rows by the internal actor under investigation.
+_STORY_KEYS = {
+    "flow": ("sip", "dip", "external peer"),
+    "dns": ("ip_dst", "domain", "queried domain"),
+    "proxy": ("clientip", "host", "contacted host"),
+}
+
+
+def _storyboard(df: pd.DataFrame, datatype: str, top_n: int = 8) -> dict:
+    """Per-actor threat cards — the reference's threat storyboard
+    (README.md:45-48 "attack heuristics"/visual investigation) rebuilt
+    as data: who, how many suspicious events, to which peers, when,
+    how much moved, with a generated plain-language narrative. The
+    `ranks` list ties each card back to its table rows for drill-down."""
+    if not len(df):
+        return {"threats": []}
+    actor_col, peer_col, peer_noun = _STORY_KEYS[datatype]
+    actors = df[actor_col].astype(str)
+    hours = _hours(df, datatype)
+    threats = []
+    # Rank actors by how suspicious their worst event is, tie-broken by
+    # volume — a single catastrophic connection outranks broad noise.
+    order = (df.assign(_a=actors)
+             .groupby("_a")["score"].agg(["min", "size"])
+             .sort_values(["min", "size"], ascending=[True, False]))
+    for actor in order.head(top_n).index:
+        m = (actors == actor).to_numpy()
+        rows = df[m]
+        peers = rows[peer_col].astype(str).value_counts()
+        hh = np.bincount(hours[m], minlength=24)[:24]
+        active = np.flatnonzero(hh)
+        t0, t1 = (int(active[0]), int(active[-1])) if len(active) else (0, 0)
+        card = {
+            "entity": actor,
+            "n_events": int(m.sum()),
+            "score_min": float(rows["score"].min()),
+            "n_peers": int(peers.size),
+            "peers": [{"id": k, "count": int(v)}
+                      for k, v in peers.head(5).items()],
+            "hourly": hh.tolist(),
+            "ranks": rows["rank"].astype(int).tolist(),
+        }
+        story = (f"{actor} produced {card['n_events']} suspicious "
+                 f"event{'s' if card['n_events'] != 1 else ''} across "
+                 f"{card['n_peers']} {peer_noun}"
+                 f"{'s' if card['n_peers'] != 1 else ''} between "
+                 f"{t0:02d}:00 and {t1:02d}:59")
+        if datatype == "flow" and "ibyt" in rows:
+            total = float(rows["ibyt"].sum())
+            card["bytes_total"] = total
+            story += f", moving {_human_bytes(total)}"
+        rep_cols = [c for c in ("dst_rep", "rep") if c in rows]
+        flagged = 0
+        if rep_cols:
+            flagged = int((rows[rep_cols[0]].astype(str)
+                           .isin(("HIGH", "MEDIUM"))).sum())
+        if flagged:
+            story += (f"; {flagged} hit{'s' if flagged != 1 else ''} on "
+                      f"reputation-flagged destinations")
+        card["story"] = story + "."
+        threats.append(card)
+    return {"threats": threats}
+
+
 def _summary(df: pd.DataFrame, datatype: str, date: str,
              manifest: dict | None) -> dict:
     scores = df["score"].to_numpy(np.float64)
@@ -199,6 +272,8 @@ def run_oa(cfg: OnixConfig, date: str, datatype: str) -> int:
     (out / "summary.json").write_text(
         json.dumps(_summary(enriched, datatype, date, manifest), indent=2))
     (out / "graph.json").write_text(json.dumps(_graph(enriched, datatype)))
+    (out / "storyboard.json").write_text(
+        json.dumps(_storyboard(enriched, datatype)))
     _update_dates_index(out.parent, date)
     print(f"onix oa: {len(enriched)} results -> {out}")
     return 0

@@ -212,18 +212,12 @@ function renderGraph(graph) {
   };
 }
 
-function showDrill(link) {
-  // Graph → rows → label without touching the main table's ordering:
-  // filter the day's rows to the clicked edge and render them in the
-  // drill panel with the same label controls (shared `labels` map, same
-  // Save button).
-  const [ks, kt] = EDGE_KEYS[TYPE];
-  const rows = allRows.filter(
-    r => String(r[ks]) === String(link.source) &&
-         String(r[kt]) === String(link.target));
+function openDrill(title, rows) {
+  // Rows → label without touching the main table's ordering: render the
+  // filtered rows in the drill panel with the same label controls
+  // (shared `labels` map, same Save button).
   document.getElementById("drill-title").textContent =
-    `${link.source} → ${link.target} — ${rows.length} suspicious ` +
-    `row${rows.length === 1 ? "" : "s"}`;
+    `${title} — ${rows.length} suspicious row${rows.length === 1 ? "" : "s"}`;
   renderTable(rows, currentDate, document.getElementById("drill-table"));
   const panel = document.getElementById("drill-panel");
   panel.hidden = false;
@@ -231,6 +225,59 @@ function showDrill(link) {
   document.getElementById("drill-clear").onclick = () => {
     panel.hidden = true;
   };
+}
+
+function showDrill(link) {
+  const [ks, kt] = EDGE_KEYS[TYPE];
+  const rows = allRows.filter(
+    r => String(r[ks]) === String(link.source) &&
+         String(r[kt]) === String(link.target));
+  openDrill(`${link.source} → ${link.target}`, rows);
+}
+
+function sparkline(values, w = 120, h = 26) {
+  const svg = svgEl("svg", { viewBox: `0 0 ${w} ${h}`, class: "spark" });
+  const max = Math.max(1, ...values);
+  const bw = w / values.length;
+  values.forEach((v, i) => {
+    const bh = (h - 2) * v / max;
+    svg.append(svgEl("rect", {
+      class: "bar", x: i * bw + 0.5, width: Math.max(bw - 1, 0.5),
+      y: h - bh, height: bh,
+    }));
+  });
+  return svg;
+}
+
+function renderStoryboard(sb) {
+  // The reference's threat storyboard (README.md:45-48) as cards: each
+  // actor's narrative, activity sparkline, top peers; click → that
+  // actor's rows in the drill panel for labeling.
+  const box = document.getElementById("storyboard");
+  const threats = (sb && sb.threats) || [];
+  if (!threats.length) {
+    box.replaceChildren(el("div", { class: "empty" }, "no threats"));
+    return;
+  }
+  box.replaceChildren(...threats.map(t => {
+    const card = el("div", { class: "story-card" });
+    const head = el("div", { class: "story-head" });
+    head.append(el("span", { class: "story-entity" }, t.entity),
+                el("span", { class: "story-count" },
+                   `${t.n_events} ev · min ${fmtScore(t.score_min)}`));
+    const spark = sparkline(t.hourly || []);
+    const story = el("div", { class: "story-text" }, t.story || "");
+    const peers = el("div", { class: "story-peers" });
+    (t.peers || []).forEach(p => peers.append(
+      el("span", { class: "chip" }, `${p.id} ×${p.count}`)));
+    card.append(head, spark, story, peers);
+    card.addEventListener("click", () => {
+      const set = new Set(t.ranks || []);
+      openDrill(`threat ${t.entity}`,
+                allRows.filter(r => set.has(r.rank)));
+    });
+    return card;
+  }));
 }
 
 function renderTable(rows, date, table = null) {
@@ -309,9 +356,10 @@ async function load() {
   picker.value = date;
   picker.onchange = () => { location.hash = `date=${picker.value}`; };
   const dir = `/data/${TYPE}/${dayDir(date)}`;
-  const [rows, sum, graph] = await Promise.all([
+  const [rows, sum, graph, story] = await Promise.all([
     getJSON(`${dir}/suspicious.json`), getJSON(`${dir}/summary.json`),
-    getJSON(`${dir}/graph.json`)]);
+    getJSON(`${dir}/graph.json`),
+    getJSON(`${dir}/storyboard.json`).catch(() => ({ threats: [] }))]);
   allRows = rows;
   currentDate = date;
   labels.clear();
@@ -323,6 +371,7 @@ async function load() {
   renderBars("timeline", sum.timeline_hourly,
     (i, v) => `${String(i).padStart(2, "0")}:00: ${v} events`);
   renderGraph(graph);
+  renderStoryboard(story);
   renderTable(rows, date);
 }
 

@@ -240,3 +240,63 @@ def test_top_domains_accepts_standard_formats(tmp_path):
                                       "bare-sld"]
     dc = domain_context(["mail.google.com"], _load_top_domains(cfg))
     assert dc["domain_rank"].tolist() == [1]
+
+
+@pytest.mark.parametrize("datatype", ["flow", "dns", "proxy"])
+def test_storyboard_cards(tmp_path, datatype):
+    """storyboard.json: per-actor cards ranked by worst score, with
+    narrative, hourly activity, top peers, and rank back-references
+    that resolve to real table rows."""
+    bl = tmp_path / "bad.txt"
+    bl.write_text("evil.biz\n203.0.113.1\n")
+    cfg = load_config(None, [
+        f"store.root={tmp_path}/store",
+        f"store.results_dir={tmp_path}/results",
+        f"oa.data_dir={tmp_path}/oa",
+        f"oa.reputation=local:{bl}",
+    ])
+    date = "2016-07-08"
+    res = results_path(cfg.store.results_dir, datatype, date)
+    res.parent.mkdir(parents=True, exist_ok=True)
+    _fake_results(datatype).to_csv(res, index=False)
+    assert run_oa(cfg, date, datatype) == 0
+
+    out = oa_dir(cfg, datatype, date)
+    sb = json.loads((out / "storyboard.json").read_text())
+    threats = sb["threats"]
+    assert threats, "expected threat cards"
+    # Cards are ranked by worst (lowest) score.
+    mins = [t["score_min"] for t in threats]
+    assert mins == sorted(mins)
+    rows = json.loads((out / "suspicious.json").read_text())
+    by_rank = {r["rank"]: r for r in rows}
+    actor_col = {"flow": "sip", "dns": "ip_dst", "proxy": "clientip"}[datatype]
+    for t in threats:
+        assert t["n_events"] == len(t["ranks"])
+        assert len(t["hourly"]) == 24
+        assert sum(t["hourly"]) == t["n_events"]
+        assert t["entity"] in t["story"]
+        for rank in t["ranks"]:   # back-references resolve to the actor
+            assert str(by_rank[rank][actor_col]) == t["entity"]
+        assert t["peers"] and t["peers"][0]["count"] >= t["peers"][-1]["count"]
+    if datatype == "flow":
+        assert "moving" in threats[0]["story"]       # byte volume phrased
+        assert threats[0]["bytes_total"] > 0
+    # Reputation-flagged peers surface in the narrative (the fake data
+    # plants evil.biz / 203.0.113.1 in the local list).
+    assert any("reputation-flagged" in t["story"] for t in threats)
+
+
+def test_storyboard_empty_results(tmp_path):
+    cfg = load_config(None, [
+        f"store.root={tmp_path}/store",
+        f"store.results_dir={tmp_path}/results",
+        f"oa.data_dir={tmp_path}/oa",
+    ])
+    res = results_path(cfg.store.results_dir, "flow", "2016-07-08")
+    res.parent.mkdir(parents=True, exist_ok=True)
+    _fake_results("flow", n=12).iloc[:0].to_csv(res, index=False)
+    assert run_oa(cfg, "2016-07-08", "flow") == 0
+    sb = json.loads((oa_dir(cfg, "flow", "2016-07-08")
+                     / "storyboard.json").read_text())
+    assert sb == {"threats": []}

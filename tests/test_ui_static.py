@@ -94,3 +94,15 @@ def test_drill_panel_contract():
         for i in ("drill-panel", "drill-title", "drill-clear",
                   "drill-table", "graph-mode"):
             assert f'id="{i}"' in html, f"{rel} missing #{i}"
+
+
+def test_storyboard_contract():
+    """Storyboard cards drill by rank back-references through the same
+    openDrill/label path; the panel exists on every dashboard."""
+    assert "storyboard.json" in JS
+    assert re.search(r"new Set\(t\.ranks", JS)
+    assert re.search(r"openDrill\(`threat \$\{t\.entity\}`", JS)
+    from onix.oa import engine
+    assert set(engine._STORY_KEYS) == {"flow", "dns", "proxy"}
+    for rel, html in DASHBOARDS.items():
+        assert 'id="storyboard"' in html, f"{rel} missing storyboard"
