@@ -59,6 +59,43 @@ def _finalize_topk(scores: jax.Array, indices: jax.Array) -> TopK:
     return TopK(scores=scores, indices=indices)
 
 
+def _scan_bottom_k(arrays: tuple, n: int, score_chunk, *,
+                   max_results: int, chunk: int) -> TopK:
+    """Shared running-bottom-k machinery: chunk the input arrays
+    together, score each chunk with `score_chunk(*chunk_cols)` (which
+    must already return +inf for rows it rejects), mask the tail pad by
+    global index, and merge a running bottom-`max_results` through one
+    `lax.scan`. Every selection entry point (bottom_k, top_suspicious,
+    table_pair_bottom_k) is this scan plus a per-chunk score function —
+    a fix to the selection logic lands in exactly one place."""
+    if n == 0:     # static shape: resolved at trace time, not per-call
+        return TopK(scores=jnp.full((max_results,), jnp.inf, jnp.float32),
+                    indices=jnp.full((max_results,), -1, jnp.int32))
+    chunk = min(chunk, max(n, 1))
+    pad = (-n) % chunk
+    if pad:
+        arrays = tuple(jnp.pad(a, (0, pad)) for a in arrays)
+    n_chunks = (n + pad) // chunk
+    cols = tuple(a.reshape(n_chunks, -1) for a in arrays)
+    base = jnp.arange(chunk, dtype=jnp.int32)
+
+    def step(carry, xs):
+        best_s, best_i = carry
+        *cs, ci = xs
+        idx = ci * chunk + base
+        s = jnp.where(idx < n, score_chunk(*cs), jnp.inf)
+        cat_s = jnp.concatenate([best_s, s])
+        cat_i = jnp.concatenate([best_i, idx])
+        neg, pos = jax.lax.top_k(-cat_s, max_results)
+        return (-neg, cat_i[pos]), None
+
+    init = (jnp.full((max_results,), jnp.inf, jnp.float32),
+            jnp.full((max_results,), -1, jnp.int32))
+    (out_s, out_i), _ = jax.lax.scan(
+        step, init, (*cols, jnp.arange(n_chunks, dtype=jnp.int32)))
+    return _finalize_topk(out_s, out_i)
+
+
 @functools.partial(jax.jit, static_argnames=("max_results", "chunk"))
 def bottom_k(
     scores: jax.Array,        # float32 [N] precomputed event scores
@@ -70,33 +107,10 @@ def bottom_k(
     """Bottom-`max_results` among precomputed scores < tol — the selection
     half of `top_suspicious` for callers that aggregate scores before
     selecting (e.g. flow events take the min over src/dst-doc tokens)."""
-    n = scores.shape[0]
-    if n == 0:     # static shape: resolved at trace time, not per-call
-        return TopK(scores=jnp.full((max_results,), jnp.inf, jnp.float32),
-                    indices=jnp.full((max_results,), -1, jnp.int32))
-    chunk = min(chunk, max(n, 1))
-    pad = (-n) % chunk
-    if pad:
-        scores = jnp.pad(scores, (0, pad), constant_values=jnp.inf)
-    n_chunks = (n + pad) // chunk
-    s2 = scores.reshape(n_chunks, -1)
-    base = jnp.arange(chunk, dtype=jnp.int32)
-
-    def step(carry, xs):
-        best_s, best_i = carry
-        sc, ci = xs
-        sc = jnp.where(sc < tol, sc, jnp.inf)
-        idx = ci * chunk + base
-        cat_s = jnp.concatenate([best_s, sc])
-        cat_i = jnp.concatenate([best_i, idx])
-        neg, pos = jax.lax.top_k(-cat_s, max_results)
-        return (-neg, cat_i[pos]), None
-
-    init = (jnp.full((max_results,), jnp.inf, jnp.float32),
-            jnp.full((max_results,), -1, jnp.int32))
-    (out_s, out_i), _ = jax.lax.scan(
-        step, init, (s2, jnp.arange(n_chunks, dtype=jnp.int32)))
-    return _finalize_topk(out_s, out_i)
+    return _scan_bottom_k(
+        (scores,), scores.shape[0],
+        lambda sc: jnp.where(sc < tol, sc, jnp.inf),
+        max_results=max_results, chunk=chunk)
 
 
 @functools.partial(jax.jit, static_argnames=("max_results", "chunk"))
@@ -118,38 +132,13 @@ def top_suspicious(
     events are pushed to +inf so they never enter the result set. Single
     fused scan — no host round-trips.
     """
-    n = doc_ids.shape[0]
-    if n == 0:     # static shape: resolved at trace time, not per-call
-        return TopK(scores=jnp.full((max_results,), jnp.inf, jnp.float32),
-                    indices=jnp.full((max_results,), -1, jnp.int32))
-    chunk = min(chunk, max(n, 1))
-    pad = (-n) % chunk
-    if pad:
-        doc_ids = jnp.pad(doc_ids, (0, pad))
-        word_ids = jnp.pad(word_ids, (0, pad))
-        mask = jnp.pad(mask, (0, pad))
-    n_chunks = (n + pad) // chunk
-    d = doc_ids.reshape(n_chunks, -1)
-    w = word_ids.reshape(n_chunks, -1)
-    m = mask.reshape(n_chunks, -1)
-    base = jnp.arange(d.shape[1], dtype=jnp.int32)
 
-    def step(carry, xs):
-        best_s, best_i = carry
-        dc, wc, mc, ci = xs
+    def score_chunk(dc, wc, mc):
         s = score_events(theta, phi_wk, dc, wc)
-        s = jnp.where((mc > 0) & (s < tol), s, jnp.inf)
-        idx = ci * d.shape[1] + base
-        cat_s = jnp.concatenate([best_s, s])
-        cat_i = jnp.concatenate([best_i, idx])
-        neg, pos = jax.lax.top_k(-cat_s, max_results)
-        return (-neg, cat_i[pos]), None
+        return jnp.where((mc > 0) & (s < tol), s, jnp.inf)
 
-    init = (jnp.full((max_results,), jnp.inf, jnp.float32),
-            jnp.full((max_results,), -1, jnp.int32))
-    (scores, indices), _ = jax.lax.scan(
-        step, init, (d, w, m, jnp.arange(n_chunks, dtype=jnp.int32)))
-    return _finalize_topk(scores, indices)
+    return _scan_bottom_k((doc_ids, word_ids, mask), doc_ids.shape[0],
+                          score_chunk, max_results=max_results, chunk=chunk)
 
 
 _score_events_jit = jax.jit(score_events)
@@ -183,6 +172,33 @@ def _gather_scores(table_flat: jax.Array, d: jax.Array, w: jax.Array,
 # D*V budget for materializing the score table (f32 elements). 1<<27 =
 # 512 MB — small next to 16 GB HBM, large enough for D=200k x V=640.
 TABLE_MAX_ELEMS = 1 << 27
+
+@functools.partial(jax.jit, static_argnames=("max_results", "chunk"))
+def table_pair_bottom_k(
+    table_flat: jax.Array,   # float32 [D*V] from score_table().ravel()
+    idx_src: jax.Array,      # int32 [N] flat index d_src*V + w per event
+    idx_dst: jax.Array,      # int32 [N] flat index d_dst*V + w per event
+    *,
+    tol: float,
+    max_results: int,
+    chunk: int = 1 << 21,
+) -> TopK:
+    """Fused flow-event scoring + selection, entirely on device: per
+    event, score = min over its two tokens (src-doc and dst-doc gather
+    from the θ·φᵀ table), filter < tol, keep the running bottom-k.
+
+    Exists for the 10⁸⁺-event path: the unfused pipeline ships every
+    token score to the host (hundreds of MB through the device tunnel),
+    takes the pair-min there, and ships event scores back for selection.
+    Here only the final [max_results] rows ever leave the device."""
+
+    def score_chunk(si, di):
+        s = jnp.minimum(table_flat[si], table_flat[di])
+        return jnp.where(s < tol, s, jnp.inf)
+
+    return _scan_bottom_k((idx_src, idx_dst), idx_src.shape[0],
+                          score_chunk, max_results=max_results, chunk=chunk)
+
 
 # Dedup pays once the device scan shrinks enough to cover the host-side
 # np.unique sort; real telemetry is Zipf over (ip, word) pairs, so the

@@ -10,8 +10,9 @@ wall-clock recorded into a manifest artifact.
 
 Every stage is the production code path: `flow_words_from_arrays` /
 `build_corpus` (zero per-row Python), `ShardedGibbsLDA` (the psum
-engine), `score_all` (device scan with pair dedup). Nothing here is a
-special-cased benchmark kernel.
+engine), `select_suspicious_events` (fused device score+pair-min+
+bottom-k — only the winners cross the device tunnel). Nothing here is
+a special-cased benchmark kernel.
 """
 
 from __future__ import annotations
@@ -23,8 +24,7 @@ import time
 import numpy as np
 
 from onix.config import LDAConfig
-from onix.models.scoring import bottom_k, score_all
-from onix.pipelines.corpus_build import build_corpus, event_scores
+from onix.pipelines.corpus_build import build_corpus, select_suspicious_events
 from onix.pipelines.synth import synth_flow_day_arrays
 from onix.pipelines.words import flow_words_from_arrays
 
@@ -38,6 +38,17 @@ def run_scale(n_events: int, n_hosts: int | None = None,
 
     from onix.parallel.mesh import make_mesh
     from onix.parallel.sharded_gibbs import ShardedGibbsLDA
+    from onix.utils.obs import enable_compile_cache
+
+    # Cold compiles through the device tunnel run 25-40s per program;
+    # persist them so scale runs measure the pipeline, not the compiler.
+    # Per-host tempdir location (override: ONIX_JAX_CACHE), NOT a
+    # cwd-relative path — the runner is invoked from anywhere.
+    import os
+    import tempfile
+    enable_compile_cache(os.environ.get(
+        "ONIX_JAX_CACHE",
+        pathlib.Path(tempfile.gettempdir()) / "onix-jax-cache"))
 
     if n_hosts is None:
         n_hosts = max(120, min(200_000, n_events // 500))
@@ -79,10 +90,10 @@ def run_scale(n_events: int, n_hosts: int | None = None,
     walls["gibbs_fit"] = time.monotonic() - t
 
     t = time.monotonic()
-    tok_scores = score_all(theta, phi_wk, corpus.doc_ids[:wt.n_rows],
-                           corpus.word_ids[:wt.n_rows])
-    ev_scores = event_scores(bundle, tok_scores, n_events)
-    top = bottom_k(ev_scores, tol=1.0, max_results=max_results)
+    # Fused device path: score -> pair-min -> bottom-k in one compiled
+    # scan; only the winners cross the tunnel (corpus_build strategy).
+    top = select_suspicious_events(bundle, theta, phi_wk, n_events,
+                                   tol=1.0, max_results=max_results)
     top_idx = np.asarray(top.indices)
     walls["score_select"] = time.monotonic() - t
 

@@ -42,11 +42,22 @@ def entropy_array(strings) -> np.ndarray:
     return np.asarray([shannon_entropy(s) for s in strings], dtype=np.float32)
 
 
+# Above this size, quantile edges are fitted on a deterministic stride
+# sample. Fitting coarse bin edges (n_bins ~ 5) needs quantiles to
+# ~1e-3 accuracy; a 4M-element stride sample delivers that while a full
+# np.quantile at 10^8 elements spends tens of seconds sorting — pure
+# waste on the billion-event path.
+_QUANTILE_SAMPLE_MAX = 1 << 22
+
+
 def quantile_edges(values: np.ndarray, n_bins: int) -> np.ndarray:
     """Interior quantile cut points (n_bins - 1 edges) for equal-mass bins.
 
     The flow word binning of the reference (SURVEY.md §2.1 #5:
-    "quantile-binned bytes, packets, and time-of-day").
+    "quantile-binned bytes, packets, and time-of-day"). Beyond
+    _QUANTILE_SAMPLE_MAX elements the fit uses a deterministic stride
+    sample (same input -> same edges; the fitted edges are archived in
+    the run manifest either way, so apply-mode reproducibility is exact).
     """
     if n_bins < 1:
         raise ValueError("n_bins must be >= 1")
@@ -54,6 +65,9 @@ def quantile_edges(values: np.ndarray, n_bins: int) -> np.ndarray:
     values = np.asarray(values, dtype=np.float64)
     if values.size == 0:
         return np.zeros(n_bins - 1, dtype=np.float64)
+    if values.size > _QUANTILE_SAMPLE_MAX:
+        stride = -(-values.size // _QUANTILE_SAMPLE_MAX)   # ceil div
+        values = values[::stride]
     return np.quantile(values, qs)
 
 
