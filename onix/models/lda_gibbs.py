@@ -150,12 +150,25 @@ def make_block_step(*, alpha: float, eta: float, n_vocab: int,
         ndk = n_dk[d].astype(jnp.float32) - ohf
         nwk = n_wk[w].astype(jnp.float32) - ohf
         nk = n_k.astype(jnp.float32)[None, :] - ohf
+        # Categorical sampling in LOG space via Gumbel-argmax. An
+        # inverse-CDF formulation (cumsum + 1 uniform/token, 20x fewer
+        # PRNG bits) was measured at identical tokens/s — the sweep is
+        # scatter/gather-bound, not sampler-bound — and rejected
+        # because a linear-space f32 cumsum rounds away topics whose
+        # conditional probability is below ~2^-24 of the total, making
+        # rare-topic transitions exactly impossible; log space keeps
+        # the full dynamic range.
         logp = (jnp.log(ndk + alpha)
                 + jnp.log(jnp.maximum(nwk + eta, 1e-10))
                 - jnp.log(nk + v_eta))
         g = jax.random.gumbel(skey, logp.shape, dtype=jnp.float32)
         z_new = jnp.argmax(logp + g, axis=-1).astype(jnp.int32)
         z_new = jnp.where(m > 0, z_new, z_old)      # padding keeps sentinel
+        # Dense one-hot delta rows, NOT per-element scalar scatters:
+        # XLA's TPU scatter vectorizes the K lane dimension of row
+        # updates, so the dense [B,K] delta runs ~2x faster than the
+        # "only 2 of K entries change" rank-1 formulation (measured
+        # 35M vs 18M tokens/s at K=20).
         delta = _one_hot(z_new, k_topics) - oh_old  # int32-exact update
         n_dk = n_dk.at[d].add(delta)
         n_wk = n_wk.at[w].add(delta)

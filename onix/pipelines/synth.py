@@ -157,6 +157,9 @@ def synth_flow_day_arrays(n_events: int, n_hosts: int = 100_000,
     """
     if n_anomalies is None:
         n_anomalies = max(30, n_events // 10_000)
+    # A tail chunk smaller than the anomaly floor must not make the
+    # background count negative.
+    n_anomalies = min(n_anomalies, n_events)
     rng = np.random.default_rng(seed)
     n_prof = len(_FLOW_PROFILES)
     mix_cum = _host_mixture(rng, n_hosts, n_prof).cumsum(axis=1)
