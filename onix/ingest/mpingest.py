@@ -40,13 +40,12 @@ import socket
 import time
 
 from onix.config import OnixConfig
-from onix.ingest.run import ingest_file
+from onix.ingest.run import DEFAULT_PATTERNS, ingest_file
 from onix.store import Store
 
 log = logging.getLogger("onix.ingest.mp")
 
 CLAIMS_DIR = ".onix_claims"
-DEFAULT_PATTERNS = ("*.nf5", "*.tsv", "*.log", "*.csv", "*.pcap")
 
 
 def _digest(path: pathlib.Path) -> tuple[str, dict]:

@@ -17,6 +17,14 @@ import pandas as pd
 from onix.config import OnixConfig
 from onix.store import Store
 
+#: Landing-dir globs both ingest modes watch (single-process watcher and
+#: the multi-process claim fleet — ONE definition so the modes can never
+#: drift apart). `nfcapd.2*` matches nfdump's rotated
+#: `nfcapd.YYYYMMDDhhmm` names but NOT the live in-progress
+#: `nfcapd.current*` file, whose truncated head must never be ingested.
+DEFAULT_PATTERNS = ("*.nf5", "*.tsv", "*.log", "*.csv", "*.pcap",
+                    "nfcapd.2*")
+
 
 def decode(datatype: str, path: str | pathlib.Path) -> pd.DataFrame:
     if datatype == "flow":

@@ -26,7 +26,7 @@ import threading
 import time
 
 from onix.config import OnixConfig
-from onix.ingest.run import ingest_file
+from onix.ingest.run import DEFAULT_PATTERNS, ingest_file
 from onix.store import Store
 
 log = logging.getLogger("onix.ingest")
@@ -95,8 +95,7 @@ class IngestWatcher:
     def __init__(self, cfg: OnixConfig, datatype: str,
                  landing_dir: str | pathlib.Path,
                  n_workers: int = 2, poll_interval: float = 0.5,
-                 patterns: tuple[str, ...] = ("*.nf5", "*.tsv", "*.log",
-                                              "*.csv"),
+                 patterns: tuple[str, ...] = DEFAULT_PATTERNS,
                  require_stable: bool = True):
         self.cfg = cfg
         self.datatype = datatype
