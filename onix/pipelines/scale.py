@@ -18,7 +18,9 @@ a special-cased benchmark kernel.
 from __future__ import annotations
 
 import json
+import os
 import pathlib
+import tempfile
 import time
 
 import numpy as np
@@ -58,8 +60,6 @@ def run_scale(n_events: int, n_hosts: int | None = None,
     # persist them so scale runs measure the pipeline, not the compiler.
     # Per-host tempdir location (override: ONIX_JAX_CACHE), NOT a
     # cwd-relative path — the runner is invoked from anywhere.
-    import os
-    import tempfile
     enable_compile_cache(os.environ.get(
         "ONIX_JAX_CACHE",
         pathlib.Path(tempfile.gettempdir()) / "onix-jax-cache"))
@@ -70,11 +70,7 @@ def run_scale(n_events: int, n_hosts: int | None = None,
     if n_hosts is None:
         n_hosts = max(120, min(200_000, n_events // 500))
     if n_anomalies is None:
-        # Sublinear in n: at 10^8+, a linear anomaly count concentrates
-        # enough repeated signature words that the sampler gives the
-        # attack its own topic and the events stop being low-probability
-        # (the planted-anomaly contract assumes heterogeneity).
-        n_anomalies = max(30, min(1000, train_events // 10_000))
+        n_anomalies = _default_anomalies(train_events)
     walls: dict[str, float] = {}
     t_all = time.monotonic()
 
@@ -156,6 +152,14 @@ def run_scale(n_events: int, n_hosts: int | None = None,
     return manifest
 
 
+def _default_anomalies(n_events: int) -> int:
+    """Sublinear in n: at 10^8+, a linear anomaly count concentrates
+    enough repeated signature words that the sampler gives the attack
+    its own topic and the events stop being low-probability (the
+    planted-anomaly contract assumes heterogeneity)."""
+    return max(30, min(1000, n_events // 10_000))
+
+
 def extend_model_for_unseen(theta, phi_wk):
     """Extend (theta, phi) by one UNSEEN row each for scoring events
     outside the training window: an unseen word scores at HALF the
@@ -212,13 +216,16 @@ def _stream_score(bundle, fitted_edges, theta, phi_wk, *, n_events: int,
         if c == 0:
             # Chunk 0 is the training window — its corpus is already
             # mapped; reuse the integer ids directly.
+            # int32 throughout: the extended table is capped at 2^27
+            # elements, so every flat index fits with room to spare —
+            # int64 temporaries would double the chunk's memory.
             d_ids = bundle.corpus.doc_ids[:bundle.n_real_tokens]
             w_ids = bundle.corpus.word_ids[:bundle.n_real_tokens]
-            idx = d_ids.astype(np.int64) * v_x + w_ids
+            idx = (d_ids.astype(np.int32) * np.int32(v_x)
+                   + w_ids.astype(np.int32))
         else:
             cols = synth_flow_day_arrays(
-                m, n_hosts=n_hosts,
-                n_anomalies=max(30, min(1000, m // 10_000)),
+                m, n_hosts=n_hosts, n_anomalies=_default_anomalies(m),
                 seed=seed + 1000 * c)
             planted.update((cols["anomaly_idx"] + offset).tolist())
             wt = flow_words_from_arrays(
@@ -233,19 +240,18 @@ def _stream_score(bundle, fitted_edges, theta, phi_wk, *, n_events: int,
             # the UNSEEN rows.
             ukeys, winv = _unique_inverse(wt.word_key)
             wid_u = bundle.vocab.ids(wt.render_keys(ukeys), strict=False)
-            wid_u = np.where(wid_u < 0, unseen_w, wid_u).astype(np.int64)
+            wid_u = np.where(wid_u < 0, unseen_w, wid_u).astype(np.int32)
             udocs, dinv = _unique_inverse(wt.ip_u32)
             from onix.pipelines.words import u32_to_ips
             did_u = bundle.doc_index(u32_to_ips(udocs), strict=False)
-            did_u = np.where(did_u < 0, unseen_d, did_u).astype(np.int64)
-            idx = did_u[dinv] * v_x + wid_u[winv]
+            did_u = np.where(did_u < 0, unseen_d, did_u).astype(np.int32)
+            idx = did_u[dinv] * np.int32(v_x) + wid_u[winv]
             del wt, winv, dinv
         walls["stream_synth_words"] += time.monotonic() - t
 
         t = time.monotonic()
         top = scoring.table_pair_bottom_k(
-            table, jnp.asarray(idx[:m].astype(np.int32)),
-            jnp.asarray(idx[m:].astype(np.int32)),
+            table, jnp.asarray(idx[:m]), jnp.asarray(idx[m:]),
             tol=1.0, max_results=max_results)
         ti = np.asarray(top.indices)
         ts = np.asarray(top.scores)
