@@ -59,6 +59,35 @@ def _finalize_topk(scores: jax.Array, indices: jax.Array) -> TopK:
     return TopK(scores=scores, indices=indices)
 
 
+def _chunked_cols(arrays: tuple, n: int, chunk: int):
+    """Pad arrays to a chunk multiple and reshape to [n_chunks, chunk]
+    scan columns. Shapes are static under jit, so the pad amount is
+    compile-time."""
+    chunk = min(chunk, max(n, 1))
+    pad = (-n) % chunk
+    if pad:
+        arrays = tuple(jnp.pad(a, (0, pad)) for a in arrays)
+    n_chunks = (n + pad) // chunk
+    cols = tuple(a.reshape(n_chunks, -1) for a in arrays)
+    base = jnp.arange(chunk, dtype=jnp.int32)
+    return cols, base, n_chunks, chunk
+
+
+def _empty_topk(max_results: int) -> TopK:
+    return TopK(scores=jnp.full((max_results,), jnp.inf, jnp.float32),
+                indices=jnp.full((max_results,), -1, jnp.int32))
+
+
+def _merge_bottom_k(best_s, best_i, s, idx, max_results: int):
+    """Merge chunk scores into the running bottom-k. Ties keep the
+    lower concat position, so incumbents always beat later arrivals at
+    an equal score — both scan paths rely on this for determinism."""
+    cat_s = jnp.concatenate([best_s, s])
+    cat_i = jnp.concatenate([best_i, idx])
+    neg, pos = jax.lax.top_k(-cat_s, max_results)
+    return -neg, cat_i[pos]
+
+
 def _scan_bottom_k(arrays: tuple, n: int, score_chunk, *,
                    max_results: int, chunk: int) -> TopK:
     """Shared running-bottom-k machinery: chunk the input arrays
@@ -69,30 +98,19 @@ def _scan_bottom_k(arrays: tuple, n: int, score_chunk, *,
     table_pair_bottom_k) is this scan plus a per-chunk score function —
     a fix to the selection logic lands in exactly one place."""
     if n == 0:     # static shape: resolved at trace time, not per-call
-        return TopK(scores=jnp.full((max_results,), jnp.inf, jnp.float32),
-                    indices=jnp.full((max_results,), -1, jnp.int32))
-    chunk = min(chunk, max(n, 1))
-    pad = (-n) % chunk
-    if pad:
-        arrays = tuple(jnp.pad(a, (0, pad)) for a in arrays)
-    n_chunks = (n + pad) // chunk
-    cols = tuple(a.reshape(n_chunks, -1) for a in arrays)
-    base = jnp.arange(chunk, dtype=jnp.int32)
+        return _empty_topk(max_results)
+    cols, base, n_chunks, chunk = _chunked_cols(arrays, n, chunk)
 
     def step(carry, xs):
         best_s, best_i = carry
         *cs, ci = xs
         idx = ci * chunk + base
         s = jnp.where(idx < n, score_chunk(*cs), jnp.inf)
-        cat_s = jnp.concatenate([best_s, s])
-        cat_i = jnp.concatenate([best_i, idx])
-        neg, pos = jax.lax.top_k(-cat_s, max_results)
-        return (-neg, cat_i[pos]), None
+        return _merge_bottom_k(best_s, best_i, s, idx, max_results), None
 
-    init = (jnp.full((max_results,), jnp.inf, jnp.float32),
-            jnp.full((max_results,), -1, jnp.int32))
     (out_s, out_i), _ = jax.lax.scan(
-        step, init, (*cols, jnp.arange(n_chunks, dtype=jnp.int32)))
+        step, tuple(_empty_topk(max_results)),
+        (*cols, jnp.arange(n_chunks, dtype=jnp.int32)))
     return _finalize_topk(out_s, out_i)
 
 
@@ -113,7 +131,8 @@ def bottom_k(
         max_results=max_results, chunk=chunk)
 
 
-@functools.partial(jax.jit, static_argnames=("max_results", "chunk"))
+@functools.partial(jax.jit,
+                   static_argnames=("max_results", "chunk", "prune_buf"))
 def top_suspicious(
     theta: jax.Array,
     phi_wk: jax.Array,
@@ -124,6 +143,7 @@ def top_suspicious(
     tol: float,
     max_results: int,
     chunk: int = 1 << 20,
+    prune_buf: int = 2048,
 ) -> TopK:
     """Bottom-`max_results` events by score among those with score < tol.
 
@@ -131,7 +151,18 @@ def top_suspicious(
     jit, so the pad amount is compile-time). Padding and above-threshold
     events are pushed to +inf so they never enter the result set. Single
     fused scan — no host round-trips.
+
+    Single-chain estimates take a branch-and-bound fast path
+    (`_bound_pruned_bottom_k`): a per-event LOWER bound on the score
+    prunes almost every event before the expensive gather-dot runs —
+    exact, because pruning only discards events provably outside the
+    bottom-k (docs/PERF.md). Multi-chain (geometric-mean) estimates use
+    the generic full-scoring scan.
     """
+    if theta.ndim == 2:
+        return _bound_pruned_bottom_k(
+            theta, phi_wk, doc_ids, word_ids, mask, tol=tol,
+            max_results=max_results, chunk=chunk, prune_buf=prune_buf)
 
     def score_chunk(dc, wc, mc):
         s = score_events(theta, phi_wk, dc, wc)
@@ -139,6 +170,99 @@ def top_suspicious(
 
     return _scan_bottom_k((doc_ids, word_ids, mask), doc_ids.shape[0],
                           score_chunk, max_results=max_results, chunk=chunk)
+
+
+def _bound_pruned_bottom_k(theta, phi_wk, doc_ids, word_ids, mask, *,
+                           tol, max_results, chunk, prune_buf) -> TopK:
+    """Branch-and-bound bottom-k: prune with a cheap score lower bound,
+    fully score only the survivors.
+
+    For every event, `score = Σ_k θ[d,k]·φ[w,k] ≥ θ[d,j]·φ[w,j]` for ANY
+    topic j — in particular j = argmax_k θ[d,k], which needs only three
+    4-byte flat gathers per event (argmax-topic id, its θ value, one φ
+    element) instead of two lane-padded K-row gathers plus a 128-lane
+    dot that wastes 108 lanes (docs/PERF.md "where the time goes"). An
+    event whose lower bound already exceeds the running k-th-best
+    threshold (or tol) provably cannot enter the result, so per chunk
+    only the ≤`prune_buf` best-bounded candidates are fully scored.
+
+    Exactness: the threshold is the current k-th smallest score, which
+    only decreases; `bound > thresh ⇒ score > thresh` now and forever,
+    and ties at the threshold never displace an incumbent (lax.top_k
+    prefers lower concat positions). When a chunk's candidate count
+    exceeds `prune_buf` — cold start while the running set is unfilled,
+    or adversarially ordered data — `lax.cond` falls back to full
+    scoring of that chunk, so the result is identical in all regimes.
+    """
+    n = doc_ids.shape[0]
+    if n == 0:
+        return _empty_topk(max_results)
+    k_topics = theta.shape[-1]
+    j_max = jnp.argmax(theta, axis=-1).astype(jnp.int32)     # [D]
+    t_max = jnp.max(theta, axis=-1)                          # [D]
+    phi_flat = phi_wk.reshape(-1)                            # [V*K]
+
+    def part_scan(carry, arrays, n_part, offset, chunk_part):
+        """Scan one contiguous slice of the event stream with its own
+        chunk size, threading the running bottom-k carry through."""
+        cols, base, n_chunks, chunk_part = _chunked_cols(
+            arrays, n_part, chunk_part)
+        buf = min(prune_buf, chunk_part)
+
+        def step(carry, xs):
+            best_s, best_i = carry
+            dc, wc, mc, ci = xs
+            local = ci * chunk_part + base
+            idx = offset + local
+            valid = (mc > 0) & (local < n_part)
+            # thresh is the worst kept score (best_s ascends out of
+            # top_k); nothing at or above it — or at or above tol —
+            # can qualify, and lb <= score, so lb >= thresh prunes.
+            thresh = jnp.minimum(best_s[-1], tol)
+            jd = j_max[dc]
+            lb = t_max[dc] * phi_flat[wc * jnp.int32(k_topics) + jd]
+            cand = valid & (lb < thresh)
+            n_cand = jnp.sum(cand.astype(jnp.int32))
+
+            def fast(carry):
+                best_s, best_i = carry
+                key = jnp.where(cand, lb, jnp.inf)
+                neg_lb, pos = jax.lax.top_k(-key, buf)  # ALL candidates
+                s_c = score_events(theta, phi_wk, dc[pos], wc[pos])
+                live = jnp.isfinite(neg_lb) & (s_c < thresh)
+                s_c = jnp.where(live, s_c, jnp.inf)
+                return _merge_bottom_k(best_s, best_i, s_c, idx[pos],
+                                       max_results)
+
+            def full(carry):
+                best_s, best_i = carry
+                s = score_events(theta, phi_wk, dc, wc)
+                s = jnp.where(valid & (s < tol), s, jnp.inf)
+                return _merge_bottom_k(best_s, best_i, s, idx, max_results)
+
+            return jax.lax.cond(n_cand <= buf, fast, full,
+                                (best_s, best_i)), None
+
+        carry, _ = jax.lax.scan(
+            step, carry, (*cols, jnp.arange(n_chunks, dtype=jnp.int32)))
+        return carry
+
+    init = tuple(_empty_topk(max_results))
+    # Warm prefix: the first (up to) `chunk` events run at 1/16 chunk
+    # size, so the threshold tightens on cheap small chunks before the
+    # full-width chunks stream — otherwise chunk 0 always pays the
+    # exhaustive path at full width (thresh starts at +inf) and early
+    # wide chunks overflow the candidate buffer while the threshold is
+    # still loose (expected candidates/chunk ~ k*chunk/events_seen).
+    head_n = min(n, chunk)
+    carry = part_scan(init, (doc_ids[:head_n], word_ids[:head_n],
+                             mask[:head_n]), head_n, 0,
+                      max(chunk // 16, 1))
+    if n > head_n:
+        carry = part_scan(carry, (doc_ids[head_n:], word_ids[head_n:],
+                                  mask[head_n:]), n - head_n, head_n, chunk)
+    out_s, out_i = carry
+    return _finalize_topk(out_s, out_i)
 
 
 _score_events_jit = jax.jit(score_events)
