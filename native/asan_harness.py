@@ -69,6 +69,19 @@ def v9_blob(pad_template=False):
     return hdr + tpl_set + data_set
 
 
+def v9_options_blob(bad_scope_len=False):
+    """v9 options template flowset (RFC 3954 §6.1: scope System +
+    SAMPLING_INTERVAL) plus its data record; with bad_scope_len the
+    scope byte length is not a multiple of the 4-byte spec size."""
+    scope_len = 3 if bad_scope_len else 4
+    opt = struct.pack(">HHH", 400, scope_len, 4)
+    opt += struct.pack(">HH", 1, 4) + struct.pack(">HH", 34, 4)
+    opt_set = struct.pack(">HH", 1, 4 + len(opt)) + opt
+    opt_data = struct.pack(">HHII", 400, 12, 0, 64)
+    hdr = struct.pack(">HHIIII", 9, 2, 3_600_000, 1467936000, 0, 0)
+    return hdr + opt_set + opt_data
+
+
 def ipfix_blob(long_varlen=False, strip_template=False):
     """One IPFIX message: template (enterprise + variable-length fields)
     + options template set + 2 data records."""
@@ -146,6 +159,11 @@ def main() -> int:
         ("v9 oversized template count",
          struct.pack(">HHIIII", 9, 1, 0, 0, 0, 0)
          + struct.pack(">HH", 0, 12) + struct.pack(">HH", 256, 60000), 1),
+        # options records are exporter state, never flow rows — a
+        # stream of ONLY options sets decodes to zero flows (rc 0)
+        ("v9 options template + sampling record", v9_options_blob(), 0),
+        ("v9 options bad scope length", v9_options_blob(bad_scope_len=True),
+         1),
         ("ipfix happy path", ipfix_blob(), 0),
         ("ipfix long varlen prefix", ipfix_blob(long_varlen=True), 0),
         ("ipfix unknown template skipped", ipfix_blob(strip_template=True), 0),

@@ -7,8 +7,10 @@
 // its own decoder for the OPEN protocols — Cisco NetFlow v5 export
 // packets (24-byte header + N×48-byte records), template-based
 // NetFlow v9 (RFC 3954: template flowsets announce record layouts, data
-// flowsets carry them), and IPFIX/v10 (RFC 7011: explicit message
-// length, enterprise fields, variable-length encoding) — rather than
+// flowsets carry them; options templates announce exporter-state
+// records, surfaced as metadata such as the sampling interval), and
+// IPFIX/v10 (RFC 7011: explicit message length, enterprise fields,
+// variable-length encoding, options template sets) — rather than
 // porting nfdump's proprietary internal nfcapd framing (nfcapd files
 // are handled by subprocess passthrough to an installed nfdump, see
 // onix/ingest/nfdecode.py). A capture file here is a concatenation of
@@ -82,7 +84,33 @@ enum V9Field : uint16_t {
   kIpv4Dst = 12,
   kLastSwitched = 21,
   kFirstSwitched = 22,
+  kSamplingInterval = 34,  // options-record field: exporter sample rate
 };
+
+// Exporter metadata extracted from options records (RFC 3954 §6.1 /
+// RFC 7011 §3.4.2.2). Options data carries exporter state, not flows —
+// the one element the ingest path acts on is the sampling interval
+// (nfdump applies it to scale counters; onix exposes it the same way).
+// Sampling is tracked PER EXPORTER (same keying as the template maps:
+// a v9 source id or IPFIX observation domain, tagged by format so the
+// namespaces cannot collide) — exporter A announcing 1-in-64 must
+// never scale exporter B's unsampled flows.
+struct StreamMeta {
+  uint32_t sampling_interval = 0;  // last announced by ANY exporter
+  std::map<uint64_t, uint32_t> by_exporter;
+  bool apply = false;              // scale counters at decode time
+  void announce(uint64_t exporter_key, uint32_t interval) {
+    sampling_interval = interval;
+    by_exporter[exporter_key] = interval;
+  }
+  uint32_t factor(uint64_t exporter_key) const {
+    auto it = by_exporter.find(exporter_key);
+    return (it != by_exporter.end() && it->second > 1) ? it->second : 1;
+  }
+};
+
+constexpr uint64_t kV9ExporterTag = 0;
+constexpr uint64_t kIpfixExporterTag = 1ULL << 32;
 
 struct V9FieldSpec {
   uint16_t type;
@@ -93,6 +121,12 @@ struct V9FieldSpec {
 struct V9Template {
   std::vector<V9FieldSpec> fields;
   uint16_t record_len = 0;
+  // Options templates (announced via set id 1 / IPFIX set 3) describe
+  // exporter-state records, not flows: their data sets update
+  // StreamMeta and never reach the flow sink. Scope fields are stored
+  // with type 0 (their type ids live in a separate namespace, RFC 3954
+  // §6.1) so they can never alias a flow field.
+  bool options = false;
 };
 
 // Key = (source_id << 16) | template_id; source ids are full 32-bit
@@ -118,10 +152,20 @@ struct V9Record {
   bool has_first = false, has_last = false;
 };
 
+// Sampling-scaled counters saturate at UINT32_MAX rather than wrapping
+// (a 5M-packet flow at 1-in-1024 sampling overflows uint32; a pinned
+// max is visibly wrong, a wrapped small number is silently wrong).
+inline void scale_counters(V9Record* r, uint32_t s) {
+  const uint64_t pk = (uint64_t)r->dpkts * s;
+  const uint64_t by = (uint64_t)r->doctets * s;
+  r->dpkts = pk > 0xFFFFFFFFULL ? 0xFFFFFFFFU : (uint32_t)pk;
+  r->doctets = by > 0xFFFFFFFFULL ? 0xFFFFFFFFU : (uint32_t)by;
+}
+
 // Sink receives each decoded record; returns false to abort (capacity).
 template <typename Sink>
 bool parse_v9_packet(const uint8_t* p, size_t pkt_len, V9Templates* tpls,
-                     Sink&& sink) {
+                     StreamMeta* meta, Sink&& sink) {
   const uint32_t sys_uptime_ms = be32(p + 4);
   const uint32_t unix_secs = be32(p + 8);
   const uint32_t source_id = be32(p + 16);
@@ -132,7 +176,7 @@ bool parse_v9_packet(const uint8_t* p, size_t pkt_len, V9Templates* tpls,
     if (set_len < 4 || off + set_len > pkt_len) return false;
     const uint8_t* body = p + off + 4;
     const size_t body_len = set_len - 4;
-    if (set_id == 0) {  // template flowset (id 1 = options: skipped)
+    if (set_id == 0) {  // template flowset (options templates: set 1)
       size_t t = 0;
       while (t + 4 <= body_len) {
         const uint16_t tpl_id = be16(body + t);
@@ -159,9 +203,57 @@ bool parse_v9_packet(const uint8_t* p, size_t pkt_len, V9Templates* tpls,
         (*tpls)[((uint64_t)source_id << 16) | tpl_id] = tpl;
         t += (size_t)n_fields * 4;
       }
+    } else if (set_id == 1) {  // options template flowset (RFC 3954 §6.1)
+      size_t t = 0;
+      while (t + 6 <= body_len) {
+        const uint16_t tpl_id = be16(body + t);
+        const uint16_t scope_len = be16(body + t + 2);    // bytes of specs
+        const uint16_t option_len = be16(body + t + 4);
+        // Trailing zero padding is legal here too (§6.1 permits it the
+        // same way §5.2 does for data templates).
+        if (tpl_id == 0 && scope_len == 0 && option_len == 0) break;
+        t += 6;
+        // Scope must be non-empty (§6.1), matching the IPFIX check —
+        // identical malformed exporter state fails on both formats.
+        if (tpl_id < 256 || scope_len == 0 || (scope_len % 4) ||
+            (option_len % 4) ||
+            t + (size_t)scope_len + option_len > body_len)
+          return false;
+        V9Template tpl;
+        tpl.options = true;
+        size_t rec_off = 0;
+        const size_t spec_bytes = (size_t)scope_len + option_len;
+        for (size_t q = 0; q < spec_bytes; q += 4) {
+          const uint16_t ftype = be16(body + t + q);
+          const uint16_t flen = be16(body + t + q + 2);
+          if (flen == 0 || rec_off + flen > 0xFFFF) return false;
+          // Scope field types (System/Interface/...) are a separate
+          // namespace — store as 0 so they never match a flow field.
+          tpl.fields.push_back({q < scope_len ? (uint16_t)0 : ftype,
+                                flen, (uint16_t)rec_off});
+          rec_off += flen;
+        }
+        if (rec_off == 0) return false;
+        tpl.record_len = (uint16_t)rec_off;
+        (*tpls)[((uint64_t)source_id << 16) | tpl_id] = tpl;
+        t += spec_bytes;
+      }
     } else if (set_id >= 256) {  // data flowset
       auto it = tpls->find(((uint64_t)source_id << 16) | set_id);
-      if (it != tpls->end() && it->second.record_len > 0) {
+      if (it != tpls->end() && it->second.options) {
+        // Options data: exporter state, not flows. Extract the
+        // sampling interval; nothing reaches the sink.
+        const V9Template& tpl = it->second;
+        const size_t n_rec = body_len / tpl.record_len;
+        for (size_t r = 0; r < n_rec; ++r) {
+          const uint8_t* rec = body + r * tpl.record_len;
+          for (const V9FieldSpec& f : tpl.fields) {
+            if (f.type == kSamplingInterval && meta)
+              meta->announce(kV9ExporterTag | source_id,
+                             (uint32_t)beN(rec + f.offset, f.len));
+          }
+        }
+      } else if (it != tpls->end() && it->second.record_len > 0) {
         const V9Template& tpl = it->second;
         const size_t n_rec = body_len / tpl.record_len;  // tail = padding
         const double boot =
@@ -197,13 +289,14 @@ bool parse_v9_packet(const uint8_t* p, size_t pkt_len, V9Templates* tpls,
           const double t1 = out.has_last
                                 ? boot + (double)out.last_ms / 1000.0
                                 : (double)unix_secs;
+          if (meta && meta->apply)
+            scale_counters(&out, meta->factor(kV9ExporterTag | source_id));
           if (!sink(out, t0, t1)) return false;
         }
       }
       // Unknown template: records are skipped (nfdump behavior) — the
       // exporter re-sends templates periodically.
     }
-    // set_id 1 (options template) and its data fall through: skipped.
     off += set_len;
   }
   return off == pkt_len;
@@ -243,15 +336,64 @@ struct IpfixFieldSpec {
 struct IpfixTemplate {
   std::vector<IpfixFieldSpec> fields;
   size_t min_len = 0;  // fixed bytes + 1 per variable-length field
+  bool options = false;  // set-3 template: data is exporter state
 };
 
 // Key = (observation domain id << 16) | template id (same collision
 // argument as the v9 map).
 using IpfixTemplates = std::map<uint64_t, IpfixTemplate>;
 
+// Shared template-record parser for IPFIX sets 2 and 3: specifiers are
+// identical (enterprise bit + optional enterprise number); options
+// templates (set 3) additionally lead with a scope-field count whose
+// fields get type 0 (scope ids are exporter-chosen IEs describing the
+// scope, not flow values to extract — RFC 7011 §3.4.2.1).
+inline bool parse_ipfix_template_body(const uint8_t* body, size_t body_len,
+                                      uint32_t domain_id, bool options,
+                                      IpfixTemplates* tpls) {
+  const size_t head = options ? 6 : 4;
+  size_t t = 0;
+  while (t + head <= body_len) {
+    const uint16_t tpl_id = be16(body + t);
+    const uint16_t n_fields = be16(body + t + 2);
+    const uint16_t n_scope = options ? be16(body + t + 4) : 0;
+    if (tpl_id == 0 && n_fields == 0) break;  // trailing padding
+    t += head;
+    if (tpl_id < 256) return false;
+    if (options && (n_scope == 0 || n_scope > n_fields))
+      return false;  // §3.4.2.2: scope count is 1..field count
+    IpfixTemplate tpl;
+    tpl.options = options;
+    for (uint16_t f = 0; f < n_fields; ++f) {
+      if (t + 4 > body_len) return false;
+      const uint16_t raw_type = be16(body + t);
+      const uint16_t flen = be16(body + t + 2);
+      t += 4;
+      const bool ent = (raw_type & 0x8000) != 0;
+      if (ent) {   // enterprise number follows the specifier
+        if (t + 4 > body_len) return false;
+        t += 4;
+      }
+      if (flen == kVarLen) {
+        tpl.min_len += 1;  // at least the 1-byte length prefix
+      } else {
+        if (flen == 0 || tpl.min_len + flen > 0xFFFF) return false;
+        tpl.min_len += flen;
+      }
+      const uint16_t ftype =
+          f < n_scope ? (uint16_t)0 : (uint16_t)(raw_type & 0x7FFF);
+      tpl.fields.push_back({ftype, flen, ent});
+    }
+    if (tpl.min_len == 0) return false;
+    (*tpls)[((uint64_t)domain_id << 16) | tpl_id] = tpl;
+  }
+  return true;
+}
+
 template <typename Sink>
 bool parse_ipfix_packet(const uint8_t* p, size_t pkt_len,
-                        IpfixTemplates* tpls, Sink&& sink) {
+                        IpfixTemplates* tpls, StreamMeta* meta,
+                        Sink&& sink) {
   const uint32_t export_secs = be32(p + 4);
   const uint32_t domain_id = be32(p + 12);
   size_t off = kIpfixHeaderLen;
@@ -261,37 +403,10 @@ bool parse_ipfix_packet(const uint8_t* p, size_t pkt_len,
     if (set_len < 4 || off + set_len > pkt_len) return false;
     const uint8_t* body = p + off + 4;
     const size_t body_len = set_len - 4;
-    if (set_id == 2) {  // template set
-      size_t t = 0;
-      while (t + 4 <= body_len) {
-        const uint16_t tpl_id = be16(body + t);
-        const uint16_t n_fields = be16(body + t + 2);
-        if (tpl_id == 0 && n_fields == 0) break;  // trailing padding
-        t += 4;
-        if (tpl_id < 256) return false;
-        IpfixTemplate tpl;
-        for (uint16_t f = 0; f < n_fields; ++f) {
-          if (t + 4 > body_len) return false;
-          const uint16_t raw_type = be16(body + t);
-          const uint16_t flen = be16(body + t + 2);
-          t += 4;
-          const bool ent = (raw_type & 0x8000) != 0;
-          if (ent) {   // enterprise number follows the specifier
-            if (t + 4 > body_len) return false;
-            t += 4;
-          }
-          if (flen == kVarLen) {
-            tpl.min_len += 1;  // at least the 1-byte length prefix
-          } else {
-            if (flen == 0 || tpl.min_len + flen > 0xFFFF) return false;
-            tpl.min_len += flen;
-          }
-          tpl.fields.push_back(
-              {(uint16_t)(raw_type & 0x7FFF), flen, ent});
-        }
-        if (tpl.min_len == 0) return false;
-        (*tpls)[((uint64_t)domain_id << 16) | tpl_id] = tpl;
-      }
+    if (set_id == 2 || set_id == 3) {  // template / options-template set
+      if (!parse_ipfix_template_body(body, body_len, domain_id,
+                                     set_id == 3, tpls))
+        return false;
     } else if (set_id >= 256) {  // data set
       auto it = tpls->find(((uint64_t)domain_id << 16) | set_id);
       if (it != tpls->end()) {
@@ -321,6 +436,11 @@ bool parse_ipfix_packet(const uint8_t* p, size_t pkt_len,
             if (!f.enterprise && flen > 0) {
               const uint64_t v = beN(body + r, (uint16_t)flen);
               switch (f.type) {
+                case kSamplingInterval:
+                  if (tpl.options && meta)
+                    meta->announce(kIpfixExporterTag | domain_id,
+                                   (uint32_t)v);
+                  break;
                 case kIpv4Src: out.sip = (uint32_t)v; break;
                 case kIpv4Dst: out.dip = (uint32_t)v; break;
                 case kL4SrcPort: out.sport = (uint16_t)v; break;
@@ -351,11 +471,16 @@ bool parse_ipfix_packet(const uint8_t* p, size_t pkt_len,
           const double t1 = has_ms1 ? (double)end_ms / 1000.0
                             : has_s1 ? (double)end_s
                                      : (double)export_secs;
-          if (!sink(out, t0, t1)) return false;
+          if (!tpl.options) {  // options data: meta only, never a flow
+            if (meta && meta->apply)
+              scale_counters(&out,
+                             meta->factor(kIpfixExporterTag | domain_id));
+            if (!sink(out, t0, t1)) return false;
+          }
         }
       }
     }
-    // set_id 3 (options template) and unknown data sets: skipped whole.
+    // Unknown data sets (template never seen): skipped whole.
     off += set_len;
   }
   return off == pkt_len;
@@ -480,12 +605,13 @@ int64_t nfx_count(const uint8_t* buf, int64_t len) {
     } else if (ver == kV9Version) {
       const size_t used = v9_packet_extent(buf + off, (size_t)len - off);
       if (used == 0) return -1;
-      if (!parse_v9_packet(buf + off, used, &tpls, count_sink)) return -1;
+      if (!parse_v9_packet(buf + off, used, &tpls, nullptr, count_sink))
+        return -1;
       off += used;
     } else if (ver == kIpfixVersion) {
       const size_t used = ipfix_packet_extent(buf + off, (size_t)len - off);
       if (used == 0) return -1;
-      if (!parse_ipfix_packet(buf + off, used, &itpls, count_sink))
+      if (!parse_ipfix_packet(buf + off, used, &itpls, nullptr, count_sink))
         return -1;
       off += used;
     } else {
@@ -495,14 +621,57 @@ int64_t nfx_count(const uint8_t* buf, int64_t len) {
   return total;
 }
 
+// Metadata peek: walk a mixed v5/v9/IPFIX stream and return the
+// sampling interval from the LAST options record that carried one (v9
+// field / IPFIX IE 34): 0 when no options record announced a rate, -1
+// on malformed framing. This is a stream-level summary; actual counter
+// scaling is per exporter via nfx_decode_scaled.
+int64_t nfx_sampling(const uint8_t* buf, int64_t len) {
+  if (!buf || len < 0) return -1;
+  size_t off = 0;
+  V9Templates tpls;
+  IpfixTemplates itpls;
+  StreamMeta meta;
+  auto drop_sink = [](const V9Record&, double, double) { return true; };
+  while (off < (size_t)len) {
+    const uint16_t ver = ((size_t)len - off >= 2) ? be16(buf + off) : 0;
+    if (ver == kVersion) {
+      PacketView pv;
+      const size_t used = parse_header(buf + off, (size_t)len - off, &pv);
+      if (used == 0) return -1;
+      off += used;   // v5 has no options records
+    } else if (ver == kV9Version) {
+      const size_t used = v9_packet_extent(buf + off, (size_t)len - off);
+      if (used == 0) return -1;
+      if (!parse_v9_packet(buf + off, used, &tpls, &meta, drop_sink))
+        return -1;
+      off += used;
+    } else if (ver == kIpfixVersion) {
+      const size_t used = ipfix_packet_extent(buf + off, (size_t)len - off);
+      if (used == 0) return -1;
+      if (!parse_ipfix_packet(buf + off, used, &itpls, &meta, drop_sink))
+        return -1;
+      off += used;
+    } else {
+      return -1;
+    }
+  }
+  return (int64_t)meta.sampling_interval;
+}
+
 // Decode a mixed v5/v9/IPFIX stream into caller-allocated arrays of
 // length `n` (from nfx_count). Same output schema as nf5_decode.
-// Returns the number of records written, -1 on error.
-int64_t nfx_decode(const uint8_t* buf, int64_t len, int64_t n,
-                   uint32_t* sip, uint32_t* dip, uint16_t* sport,
-                   uint16_t* dport, uint8_t* proto, uint8_t* tcp_flags,
-                   uint32_t* dpkts, uint32_t* doctets, double* start_ts,
-                   double* end_ts) {
+// With `apply_sampling`, packet/byte counters are scaled by the
+// announcing exporter's own sampling interval (per source id / domain
+// id — one exporter's rate never touches another's flows; v5 has no
+// options mechanism and is never scaled). Returns the number of
+// records written, -1 on error.
+static int64_t nfx_decode_impl(const uint8_t* buf, int64_t len, int64_t n,
+                               uint32_t* sip, uint32_t* dip, uint16_t* sport,
+                               uint16_t* dport, uint8_t* proto,
+                               uint8_t* tcp_flags, uint32_t* dpkts,
+                               uint32_t* doctets, double* start_ts,
+                               double* end_ts, bool apply_sampling) {
   if (!buf || !sip || !dip || !sport || !dport || !proto || !tcp_flags ||
       !dpkts || !doctets || !start_ts || !end_ts)
     return -1;
@@ -510,6 +679,8 @@ int64_t nfx_decode(const uint8_t* buf, int64_t len, int64_t n,
   size_t off = 0;
   V9Templates tpls;
   IpfixTemplates itpls;
+  StreamMeta meta;
+  meta.apply = apply_sampling;
   auto write_sink = [&](const V9Record& r, double t0, double t1) {
     if (i >= n) return false;
     sip[i] = r.sip;
@@ -542,12 +713,13 @@ int64_t nfx_decode(const uint8_t* buf, int64_t len, int64_t n,
     } else if (ver == kV9Version) {
       const size_t used = v9_packet_extent(buf + off, (size_t)len - off);
       if (used == 0) return -1;
-      if (!parse_v9_packet(buf + off, used, &tpls, write_sink)) return -1;
+      if (!parse_v9_packet(buf + off, used, &tpls, &meta, write_sink))
+        return -1;
       off += used;
     } else if (ver == kIpfixVersion) {
       const size_t used = ipfix_packet_extent(buf + off, (size_t)len - off);
       if (used == 0) return -1;
-      if (!parse_ipfix_packet(buf + off, used, &itpls, write_sink))
+      if (!parse_ipfix_packet(buf + off, used, &itpls, &meta, write_sink))
         return -1;
       off += used;
     } else {
@@ -555,6 +727,27 @@ int64_t nfx_decode(const uint8_t* buf, int64_t len, int64_t n,
     }
   }
   return i;
+}
+
+int64_t nfx_decode(const uint8_t* buf, int64_t len, int64_t n,
+                   uint32_t* sip, uint32_t* dip, uint16_t* sport,
+                   uint16_t* dport, uint8_t* proto, uint8_t* tcp_flags,
+                   uint32_t* dpkts, uint32_t* doctets, double* start_ts,
+                   double* end_ts) {
+  return nfx_decode_impl(buf, len, n, sip, dip, sport, dport, proto,
+                         tcp_flags, dpkts, doctets, start_ts, end_ts,
+                         /*apply_sampling=*/false);
+}
+
+int64_t nfx_decode_scaled(const uint8_t* buf, int64_t len, int64_t n,
+                          uint32_t* sip, uint32_t* dip, uint16_t* sport,
+                          uint16_t* dport, uint8_t* proto,
+                          uint8_t* tcp_flags, uint32_t* dpkts,
+                          uint32_t* doctets, double* start_ts,
+                          double* end_ts) {
+  return nfx_decode_impl(buf, len, n, sip, dip, sport, dport, proto,
+                         tcp_flags, dpkts, doctets, start_ts, end_ts,
+                         /*apply_sampling=*/true);
 }
 
 }  // extern "C"

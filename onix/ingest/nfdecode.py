@@ -72,8 +72,27 @@ def load_library() -> ctypes.CDLL:
     lib.nfx_decode.restype = ctypes.c_int64
     lib.nfx_decode.argtypes = [u8, ctypes.c_int64, ctypes.c_int64,
                                u32, u32, u16, u16, u8, u8, u32, u32, f64, f64]
+    lib.nfx_sampling.restype = ctypes.c_int64
+    lib.nfx_sampling.argtypes = [u8, ctypes.c_int64]
+    lib.nfx_decode_scaled.restype = ctypes.c_int64
+    lib.nfx_decode_scaled.argtypes = list(lib.nfx_decode.argtypes)
     _lib = lib
     return lib
+
+
+def sampling_interval(data: bytes) -> int:
+    """Exporter sampling interval from the stream's options records
+    (NetFlow v9 field / IPFIX IE 34, carried in options data sets —
+    RFC 3954 §6.1 / RFC 7011 §3.4.2.2). Returns 0 when no options
+    record announced one (v5 has no options mechanism). Last value in
+    stream order wins, matching how exporters refresh exporter state."""
+    lib = load_library()
+    buf = np.frombuffer(data, np.uint8)
+    bp = buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+    s = lib.nfx_sampling(bp, len(data))
+    if s < 0:
+        raise ValueError("malformed netflow v5/v9/ipfix stream")
+    return int(s)
 
 
 def ip_to_str(ips: np.ndarray) -> np.ndarray:
@@ -92,9 +111,17 @@ def str_to_ip(strs) -> np.ndarray:
     return (parts[:, 0] << 24) | (parts[:, 1] << 16) | (parts[:, 2] << 8) | parts[:, 3]
 
 
-def decode_bytes(data: bytes) -> pd.DataFrame:
-    """Decode a (possibly mixed) v5/v9 packet stream into the ingest
-    flow table."""
+def decode_bytes(data: bytes, apply_sampling: bool = False) -> pd.DataFrame:
+    """Decode a (possibly mixed) v5/v9/IPFIX packet stream into the
+    ingest flow table.
+
+    With `apply_sampling`, packet/byte counters are scaled by the
+    ANNOUNCING exporter's sampling interval (options records, field 34;
+    per v9 source id / IPFIX domain id, so one exporter's rate never
+    inflates another's flows) — the equivalent of running the
+    reference's nfdump fork with counter scaling on a sampled exporter.
+    Off by default: raw wire counters are the honest record of what was
+    exported."""
     lib = load_library()
     buf = np.frombuffer(data, np.uint8)
     bp = buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
@@ -112,7 +139,8 @@ def decode_bytes(data: bytes) -> pd.DataFrame:
     def p(name, ct):
         return arrays[name].ctypes.data_as(ctypes.POINTER(ct))
 
-    wrote = lib.nfx_decode(
+    decode = lib.nfx_decode_scaled if apply_sampling else lib.nfx_decode
+    wrote = decode(
         bp, len(data), n,
         p("sip", ctypes.c_uint32), p("dip", ctypes.c_uint32),
         p("sport", ctypes.c_uint16), p("dport", ctypes.c_uint16),
@@ -298,14 +326,20 @@ _IPFIX_FIELDS = [(8, 4), (12, 4), (7, 2), (11, 2), (4, 1), (6, 1),
 def write_ipfix(table: pd.DataFrame, *, records_per_packet: int = 20,
                 domain_id: int = 0, template_every_packet: bool = False,
                 varlen_long_form: bool = False,
-                with_options_set: bool = True) -> bytes:
+                with_options_set: bool = True,
+                sampling_interval: int | None = None) -> bytes:
     """Encode a flow table as an IPFIX (NetFlow v10) message stream.
     Same input schema as write_v5/write_v9.
 
     varlen_long_form encodes the variable-length field with the 3-byte
     (255 + uint16) prefix; with_options_set emits an options template
-    set (id 3) plus its data set, which the decoder must skip whole."""
+    set (id 3) plus its data set — exporter state the decoder must
+    parse for metadata (sampling interval when `sampling_interval` is
+    given) without ever emitting it as flow rows."""
     n = len(table)
+    # The sampling announcement rides in the options set — asking for
+    # one without the other would silently produce an unsampled stream.
+    with_options_set = with_options_set or sampling_interval is not None
     sip, dip, proto, flags = _numeric_cols(table)
     sport = table["sport"].to_numpy(np.int64)
     dport = table["dport"].to_numpy(np.int64)
@@ -321,14 +355,24 @@ def write_ipfix(table: pd.DataFrame, *, records_per_packet: int = 20,
             tpl_body += struct.pack(">I", _IPFIX_ENTERPRISE_NUM)
     tpl_set = struct.pack(">HH", 2, 4 + len(tpl_body)) + tpl_body
 
-    # Options template (scope: exporting process; one option field) and
-    # a matching data set — both must be skipped by the decoder.
-    opt_body = struct.pack(">HHH", _IPFIX_OPTIONS_TEMPLATE_ID, 2, 1)
+    # Options template (scope: exporting process) and a matching data
+    # set — exporter state, never flow rows. With `sampling_interval`
+    # the record also carries IE 34, which the decoder surfaces via
+    # nfx_sampling.
+    n_opt_fields = 3 if sampling_interval is not None else 2
+    opt_body = struct.pack(">HHH", _IPFIX_OPTIONS_TEMPLATE_ID,
+                           n_opt_fields, 1)
     opt_body += struct.pack(">HH", 130, 4)   # scope: exporterIPv4Address
     opt_body += struct.pack(">HH", 41, 8)    # exportedMessageTotalCount
+    rec_len = 12
+    if sampling_interval is not None:
+        opt_body += struct.pack(">HH", 34, 4)   # samplingInterval
+        rec_len += 4
     opt_set = struct.pack(">HH", 3, 4 + len(opt_body)) + opt_body
-    opt_data = struct.pack(">HH", _IPFIX_OPTIONS_TEMPLATE_ID, 4 + 12)
+    opt_data = struct.pack(">HH", _IPFIX_OPTIONS_TEMPLATE_ID, 4 + rec_len)
     opt_data += struct.pack(">IQ", 0x7F000001, 0)
+    if sampling_interval is not None:
+        opt_data += struct.pack(">I", sampling_interval)
 
     out = bytearray()
     seq = 0
@@ -376,17 +420,26 @@ def write_ipfix(table: pd.DataFrame, *, records_per_packet: int = 20,
     return bytes(out)
 
 
+_V9_OPTIONS_TEMPLATE_ID = 400
+
+
 def write_v9(table: pd.DataFrame, *, sys_uptime_ms: int = 3_600_000,
              records_per_packet: int = 20, source_id: int = 0,
              template_every_packet: bool = False,
-             pad_template_flowset: bool = False) -> bytes:
+             pad_template_flowset: bool = False,
+             sampling_interval: int | None = None) -> bytes:
     """Encode a flow table as a NetFlow v9 packet stream: a template
     flowset in the first packet (or every packet), then data flowsets.
     Same input schema as write_v5.
 
     pad_template_flowset appends RFC 3954 §5.2 zero padding after the
     template — real exporters do this; the decoder must treat it as
-    padding, not as a malformed template header."""
+    padding, not as a malformed template header.
+
+    sampling_interval additionally emits an options template flowset
+    (RFC 3954 §6.1: scope + option field specs) plus an options data
+    record carrying SAMPLING_INTERVAL (field 34) — exporter state that
+    must surface through nfx_sampling, never as a flow row."""
     n = len(table)
     sip, dip, proto, flags = _numeric_cols(table)
     sport = table["sport"].to_numpy(np.int64)
@@ -402,6 +455,19 @@ def write_v9(table: pd.DataFrame, *, sys_uptime_ms: int = 3_600_000,
     if pad_template_flowset:
         tpl_body += b"\0" * 4
     tpl_set = struct.pack(">HH", 0, 4 + len(tpl_body)) + tpl_body
+
+    opt_sets = b""
+    n_opt_items = 0
+    if sampling_interval is not None:
+        # Options template: scope System (4 bytes) + SAMPLING_INTERVAL
+        # (34, 4 bytes); then one options data record.
+        opt_body = struct.pack(">HHH", _V9_OPTIONS_TEMPLATE_ID, 4, 4)
+        opt_body += struct.pack(">HH", 1, 4)    # scope spec: System
+        opt_body += struct.pack(">HH", 34, 4)   # option spec
+        opt_sets = struct.pack(">HH", 1, 4 + len(opt_body)) + opt_body
+        opt_sets += struct.pack(">HHII", _V9_OPTIONS_TEMPLATE_ID, 4 + 8,
+                                source_id, sampling_interval)
+        n_opt_items = 2   # header count: 1 options template + 1 record
 
     out = bytearray()
     seq = 0
@@ -432,8 +498,8 @@ def write_v9(table: pd.DataFrame, *, sys_uptime_ms: int = 3_600_000,
         sets = b""
         n_items = cnt
         if first_packet or template_every_packet:
-            sets += tpl_set
-            n_items += 1
+            sets += tpl_set + opt_sets
+            n_items += 1 + n_opt_items
         sets += data_set
         out += struct.pack(">HHIIII", 9, n_items, sys_uptime_ms, unix_secs,
                            seq, source_id)

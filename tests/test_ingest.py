@@ -438,6 +438,80 @@ def test_ipfix_roundtrip_exact(long_form):
 
 
 @needs_decoder
+def test_options_templates_carry_sampling_not_flows():
+    """v9 options template flowsets (RFC 3954 §6.1) and IPFIX options
+    template sets (RFC 7011 §3.4.2.2) decode as exporter state: the
+    sampling interval surfaces through sampling_interval(), their data
+    records never become flow rows, and apply_sampling scales counters
+    the way nfdump does on sampled exporters."""
+    table = _synth_flow_arrays(n=23, seed=11)
+    v9 = nfd.write_v9(table, sampling_interval=64)
+    out = nfd.decode_bytes(v9)
+    assert len(out) == 23                     # options record is not a flow
+    np.testing.assert_array_equal(out["ipkt"].to_numpy(np.int64),
+                                  table["ipkt"].to_numpy())
+    assert nfd.sampling_interval(v9) == 64
+    scaled = nfd.decode_bytes(v9, apply_sampling=True)
+    # scaled counters saturate at the uint32 ABI ceiling, never wrap
+    np.testing.assert_array_equal(
+        scaled["ipkt"].to_numpy(np.int64),
+        np.minimum(table["ipkt"].to_numpy() * 64, 0xFFFFFFFF))
+    np.testing.assert_array_equal(
+        scaled["ibyt"].to_numpy(np.int64),
+        np.minimum(table["ibyt"].to_numpy() * 64, 0xFFFFFFFF))
+    assert (scaled["ibyt"].to_numpy(np.int64) == 0xFFFFFFFF).any()
+
+    ipfix = nfd.write_ipfix(table, sampling_interval=128)
+    assert len(nfd.decode_bytes(ipfix)) == 23
+    assert nfd.sampling_interval(ipfix) == 128
+    # sampling implies the options set even when it was switched off
+    implied = nfd.write_ipfix(table, with_options_set=False,
+                              sampling_interval=128)
+    assert nfd.sampling_interval(implied) == 128
+    # no options record announced a rate: 0 (v5 has no options at all;
+    # the default IPFIX options set carries exporter counters, not IE 34)
+    assert nfd.sampling_interval(nfd.write_v5(table)) == 0
+    assert nfd.sampling_interval(nfd.write_ipfix(table)) == 0
+    # mixed stream: the LAST announcement wins (exporter state refresh)
+    assert nfd.sampling_interval(v9 + ipfix) == 128
+
+
+@needs_decoder
+def test_sampling_scaling_is_per_exporter():
+    """Exporter A's 1-in-64 sampling must scale ONLY exporter A's flows:
+    an unsampled v5 exporter and a v9 source that never announced a
+    rate keep their wire counters in the same capture."""
+    ta = _synth_flow_arrays(n=5, seed=13)
+    tb = _synth_flow_arrays(n=6, seed=14)
+    tc = _synth_flow_arrays(n=7, seed=15)
+    blob = (nfd.write_v9(ta, source_id=1, sampling_interval=64)
+            + nfd.write_v5(tb)
+            + nfd.write_v9(tc, source_id=2))   # never announces a rate
+    out = nfd.decode_bytes(blob, apply_sampling=True)
+    assert len(out) == 18
+    np.testing.assert_array_equal(out["ipkt"].to_numpy(np.int64)[:5],
+                                  ta["ipkt"].to_numpy() * 64)
+    np.testing.assert_array_equal(out["ipkt"].to_numpy(np.int64)[5:11],
+                                  tb["ipkt"].to_numpy())
+    np.testing.assert_array_equal(out["ipkt"].to_numpy(np.int64)[11:],
+                                  tc["ipkt"].to_numpy())
+
+
+@needs_decoder
+def test_malformed_options_template_rejected():
+    """An options template whose scope length is not a multiple of the
+    4-byte spec size is malformed framing, not silently tolerated."""
+    import struct
+
+    opt_body = struct.pack(">HHH", 400, 3, 4)   # scope_len 3: invalid
+    opt_body += struct.pack(">HH", 1, 4) + struct.pack(">HH", 34, 4)
+    opt_set = struct.pack(">HH", 1, 4 + len(opt_body)) + opt_body
+    pkt = struct.pack(">HHIIII", 9, 1, 0, 1467936000, 0, 0) + opt_set
+    with pytest.raises(ValueError):
+        nfd.decode_bytes(bytes(pkt))
+
+
+@needs_decoder
 def test_mixed_v5_v9_ipfix_stream():
     """All three wire formats concatenated in one capture decode in
     stream order, each through its own template state."""
