@@ -143,7 +143,7 @@ def top_suspicious(
     tol: float,
     max_results: int,
     chunk: int = 1 << 20,
-    prune_buf: int = 2048,
+    prune_buf: int = 0,
 ) -> TopK:
     """Bottom-`max_results` events by score among those with score < tol.
 
@@ -152,24 +152,51 @@ def top_suspicious(
     events are pushed to +inf so they never enter the result set. Single
     fused scan — no host round-trips.
 
-    Single-chain estimates take a branch-and-bound fast path
-    (`_bound_pruned_bottom_k`): a per-event LOWER bound on the score
-    prunes almost every event before the expensive gather-dot runs —
-    exact, because pruning only discards events provably outside the
-    bottom-k (docs/PERF.md). Multi-chain (geometric-mean) estimates use
-    the generic full-scoring scan.
+    The chunk's scores are computed through an inner scan over 1/8-chunk
+    slices: with top_k as the gather-dot's direct consumer XLA
+    materializes both gathered [chunk, K] operands in lane-padded
+    [chunk, 128] layout (~6.4x traffic); the inner scan gives the
+    gather-dot a cheap [sub] consumer so it fuses, and only [chunk]
+    f32 scores reach top_k (docs/PERF.md).
+
+    `prune_buf > 0` opts into the branch-and-bound path
+    (`_bound_pruned_bottom_k`, single-chain only): a per-event score
+    lower bound — three flat gathers — prunes events before any
+    gather-dot. Exact in all regimes, but the bound is only TIGHT when
+    θ rows are peaked (fitted posteriors); on diffuse rows the
+    candidate buffer overflows every chunk and the scan degrades to
+    the exhaustive path plus bound overhead (measured 2.8x slower on
+    uniform Dirichlet(0.5) tables — docs/PERF.md). Off by default.
     """
-    if theta.ndim == 2:
+    if prune_buf > 0 and theta.ndim == 2:
         return _bound_pruned_bottom_k(
             theta, phi_wk, doc_ids, word_ids, mask, tol=tol,
             max_results=max_results, chunk=chunk, prune_buf=prune_buf)
 
     def score_chunk(dc, wc, mc):
-        s = score_events(theta, phi_wk, dc, wc)
+        s = _subscan_scores(theta, phi_wk, dc, wc)
         return jnp.where((mc > 0) & (s < tol), s, jnp.inf)
 
     return _scan_bottom_k((doc_ids, word_ids, mask), doc_ids.shape[0],
                           score_chunk, max_results=max_results, chunk=chunk)
+
+
+def _subscan_scores(theta, phi_wk, dc, wc):
+    """score_events over a chunk via an inner scan of 1/8-chunk slices
+    — the fusion-isolating form shared by every full-scoring chunk
+    (docs/PERF.md "keep top_k away from the gather-dot")."""
+    sub = max(dc.shape[0] // 8, 1)
+    if dc.shape[0] % sub:
+        return score_events(theta, phi_wk, dc, wc)
+    ns = dc.shape[0] // sub
+
+    def sub_step(_, xs):
+        sd, sw = xs
+        return None, score_events(theta, phi_wk, sd, sw)
+
+    _, s = jax.lax.scan(sub_step, None,
+                        (dc.reshape(ns, sub), wc.reshape(ns, sub)))
+    return s.reshape(dc.shape[0])
 
 
 def _bound_pruned_bottom_k(theta, phi_wk, doc_ids, word_ids, mask, *,
@@ -236,7 +263,7 @@ def _bound_pruned_bottom_k(theta, phi_wk, doc_ids, word_ids, mask, *,
 
             def full(carry):
                 best_s, best_i = carry
-                s = score_events(theta, phi_wk, dc, wc)
+                s = _subscan_scores(theta, phi_wk, dc, wc)
                 s = jnp.where(valid & (s < tol), s, jnp.inf)
                 return _merge_bottom_k(best_s, best_i, s, idx, max_results)
 
