@@ -204,6 +204,13 @@ def _stream_score(bundle, fitted_edges, theta, phi_wk, *, n_events: int,
 
     unseen_w = v_x - 1
     unseen_d = d_x - 1
+    # Streamed chunks plant a day-proportional share of anomalies, not
+    # a full day's worth per chunk: the streamed part of the run plants
+    # ~one _default_anomalies(n_events) budget, so planted_in_bottom_k
+    # is read against max_results rather than being diluted by
+    # n_chunks x more planted events than result slots.
+    n_chunks = -(-n_events // chunk_events)
+    anomalies_per_chunk = max(1, _default_anomalies(n_events) // n_chunks)
     all_scores: list[np.ndarray] = []
     all_idx: list[np.ndarray] = []
     walls["stream_synth_words"] = 0.0
@@ -225,7 +232,7 @@ def _stream_score(bundle, fitted_edges, theta, phi_wk, *, n_events: int,
                    + w_ids.astype(np.int32))
         else:
             cols = synth_flow_day_arrays(
-                m, n_hosts=n_hosts, n_anomalies=_default_anomalies(m),
+                m, n_hosts=n_hosts, n_anomalies=anomalies_per_chunk,
                 seed=seed + 1000 * c)
             planted.update((cols["anomaly_idx"] + offset).tolist())
             wt = flow_words_from_arrays(
