@@ -81,7 +81,8 @@ def _empty_topk(max_results: int) -> TopK:
 def _merge_bottom_k(best_s, best_i, s, idx, max_results: int):
     """Merge chunk scores into the running bottom-k. Ties keep the
     lower concat position, so incumbents always beat later arrivals at
-    an equal score — both scan paths rely on this for determinism."""
+    an equal score — every _scan_bottom_k entry point relies on this
+    for determinism."""
     cat_s = jnp.concatenate([best_s, s])
     cat_i = jnp.concatenate([best_i, idx])
     neg, pos = jax.lax.top_k(-cat_s, max_results)
@@ -131,8 +132,7 @@ def bottom_k(
         max_results=max_results, chunk=chunk)
 
 
-@functools.partial(jax.jit,
-                   static_argnames=("max_results", "chunk", "prune_buf"))
+@functools.partial(jax.jit, static_argnames=("max_results", "chunk"))
 def top_suspicious(
     theta: jax.Array,
     phi_wk: jax.Array,
@@ -143,7 +143,6 @@ def top_suspicious(
     tol: float,
     max_results: int,
     chunk: int = 1 << 20,
-    prune_buf: int = 0,
 ) -> TopK:
     """Bottom-`max_results` events by score among those with score < tol.
 
@@ -159,19 +158,17 @@ def top_suspicious(
     gather-dot a cheap [sub] consumer so it fuses, and only [chunk]
     f32 scores reach top_k (docs/PERF.md).
 
-    `prune_buf > 0` opts into the branch-and-bound path
-    (`_bound_pruned_bottom_k`, single-chain only): a per-event score
-    lower bound — three flat gathers — prunes events before any
-    gather-dot. Exact in all regimes, but the bound is only TIGHT when
-    θ rows are peaked (fitted posteriors); on diffuse rows the
-    candidate buffer overflows every chunk and the scan degrades to
-    the exhaustive path plus bound overhead (measured 2.8x slower on
-    uniform Dirichlet(0.5) tables — docs/PERF.md). Off by default.
+    A branch-and-bound variant (prune events whose score lower bound
+    `θmax[d]·φ[w, argmax θ[d]]` beats the running k-th best) was built,
+    proven exact, and REJECTED on measurement: the single-coordinate
+    bound underestimates the score so badly that 11-61% of events stay
+    candidates in every regime tried — diffuse tables, peaked tables,
+    even model-generated (fitted-telemetry-like) events — so the scan
+    always fell back to exhaustive scoring plus bound overhead (2.8x
+    slower on chip). docs/PERF.md "round-2 selection experiments" has
+    the full table; don't rebuild it without a fundamentally tighter
+    bound.
     """
-    if prune_buf > 0 and theta.ndim == 2:
-        return _bound_pruned_bottom_k(
-            theta, phi_wk, doc_ids, word_ids, mask, tol=tol,
-            max_results=max_results, chunk=chunk, prune_buf=prune_buf)
 
     def score_chunk(dc, wc, mc):
         s = _subscan_scores(theta, phi_wk, dc, wc)
@@ -197,99 +194,6 @@ def _subscan_scores(theta, phi_wk, dc, wc):
     _, s = jax.lax.scan(sub_step, None,
                         (dc.reshape(ns, sub), wc.reshape(ns, sub)))
     return s.reshape(dc.shape[0])
-
-
-def _bound_pruned_bottom_k(theta, phi_wk, doc_ids, word_ids, mask, *,
-                           tol, max_results, chunk, prune_buf) -> TopK:
-    """Branch-and-bound bottom-k: prune with a cheap score lower bound,
-    fully score only the survivors.
-
-    For every event, `score = Σ_k θ[d,k]·φ[w,k] ≥ θ[d,j]·φ[w,j]` for ANY
-    topic j — in particular j = argmax_k θ[d,k], which needs only three
-    4-byte flat gathers per event (argmax-topic id, its θ value, one φ
-    element) instead of two lane-padded K-row gathers plus a 128-lane
-    dot that wastes 108 lanes (docs/PERF.md "where the time goes"). An
-    event whose lower bound already exceeds the running k-th-best
-    threshold (or tol) provably cannot enter the result, so per chunk
-    only the ≤`prune_buf` best-bounded candidates are fully scored.
-
-    Exactness: the threshold is the current k-th smallest score, which
-    only decreases; `bound > thresh ⇒ score > thresh` now and forever,
-    and ties at the threshold never displace an incumbent (lax.top_k
-    prefers lower concat positions). When a chunk's candidate count
-    exceeds `prune_buf` — cold start while the running set is unfilled,
-    or adversarially ordered data — `lax.cond` falls back to full
-    scoring of that chunk, so the result is identical in all regimes.
-    """
-    n = doc_ids.shape[0]
-    if n == 0:
-        return _empty_topk(max_results)
-    k_topics = theta.shape[-1]
-    j_max = jnp.argmax(theta, axis=-1).astype(jnp.int32)     # [D]
-    t_max = jnp.max(theta, axis=-1)                          # [D]
-    phi_flat = phi_wk.reshape(-1)                            # [V*K]
-
-    def part_scan(carry, arrays, n_part, offset, chunk_part):
-        """Scan one contiguous slice of the event stream with its own
-        chunk size, threading the running bottom-k carry through."""
-        cols, base, n_chunks, chunk_part = _chunked_cols(
-            arrays, n_part, chunk_part)
-        buf = min(prune_buf, chunk_part)
-
-        def step(carry, xs):
-            best_s, best_i = carry
-            dc, wc, mc, ci = xs
-            local = ci * chunk_part + base
-            idx = offset + local
-            valid = (mc > 0) & (local < n_part)
-            # thresh is the worst kept score (best_s ascends out of
-            # top_k); nothing at or above it — or at or above tol —
-            # can qualify, and lb <= score, so lb >= thresh prunes.
-            thresh = jnp.minimum(best_s[-1], tol)
-            jd = j_max[dc]
-            lb = t_max[dc] * phi_flat[wc * jnp.int32(k_topics) + jd]
-            cand = valid & (lb < thresh)
-            n_cand = jnp.sum(cand.astype(jnp.int32))
-
-            def fast(carry):
-                best_s, best_i = carry
-                key = jnp.where(cand, lb, jnp.inf)
-                neg_lb, pos = jax.lax.top_k(-key, buf)  # ALL candidates
-                s_c = score_events(theta, phi_wk, dc[pos], wc[pos])
-                live = jnp.isfinite(neg_lb) & (s_c < thresh)
-                s_c = jnp.where(live, s_c, jnp.inf)
-                return _merge_bottom_k(best_s, best_i, s_c, idx[pos],
-                                       max_results)
-
-            def full(carry):
-                best_s, best_i = carry
-                s = _subscan_scores(theta, phi_wk, dc, wc)
-                s = jnp.where(valid & (s < tol), s, jnp.inf)
-                return _merge_bottom_k(best_s, best_i, s, idx, max_results)
-
-            return jax.lax.cond(n_cand <= buf, fast, full,
-                                (best_s, best_i)), None
-
-        carry, _ = jax.lax.scan(
-            step, carry, (*cols, jnp.arange(n_chunks, dtype=jnp.int32)))
-        return carry
-
-    init = tuple(_empty_topk(max_results))
-    # Warm prefix: the first (up to) `chunk` events run at 1/16 chunk
-    # size, so the threshold tightens on cheap small chunks before the
-    # full-width chunks stream — otherwise chunk 0 always pays the
-    # exhaustive path at full width (thresh starts at +inf) and early
-    # wide chunks overflow the candidate buffer while the threshold is
-    # still loose (expected candidates/chunk ~ k*chunk/events_seen).
-    head_n = min(n, chunk)
-    carry = part_scan(init, (doc_ids[:head_n], word_ids[:head_n],
-                             mask[:head_n]), head_n, 0,
-                      max(chunk // 16, 1))
-    if n > head_n:
-        carry = part_scan(carry, (doc_ids[head_n:], word_ids[head_n:],
-                                  mask[head_n:]), n - head_n, head_n, chunk)
-    out_s, out_i = carry
-    return _finalize_topk(out_s, out_i)
 
 
 _score_events_jit = jax.jit(score_events)
