@@ -219,7 +219,13 @@ def _summary(df: pd.DataFrame, datatype: str, date: str,
     if manifest:
         out["run"] = {k: manifest.get(k) for k in
                       ("n_events", "n_docs", "n_vocab", "n_tokens",
-                       "engine", "config_hash", "seed", "wall_seconds")}
+                       "engine", "config_hash", "seed", "wall_seconds",
+                       "events_per_sec")}
+        # Convergence series (SURVEY.md §5.5; ≙ lda-c's likelihood.dat):
+        # the dashboard draws it so an analyst can see at a glance
+        # whether the model behind today's ranking actually converged.
+        ll = manifest.get("ll_history") or []
+        out["run"]["ll_series"] = [round(float(v), 4) for _, v in ll]
     return out
 
 

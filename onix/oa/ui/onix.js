@@ -66,6 +66,7 @@ function renderTiles(sum) {
     ["documents (IPs)", run.n_docs ?? "—"],
     ["vocabulary", run.n_vocab ?? "—"],
     ["min score", sum.score_min == null ? "—" : fmtScore(sum.score_min)],
+    ["events/sec", run.events_per_sec ?? "—"],
     ["run wall (s)", run.wall_seconds ?? "—"],
   ];
   const box = document.getElementById("tiles");
@@ -74,6 +75,22 @@ function renderTiles(sum) {
     t.append(el("div", { class: "v" }, String(v)), el("div", { class: "l" }, l));
     return t;
   }));
+  // Model-convergence tile: the per-sweep log-likelihood series from the
+  // run manifest (the reference's likelihood.dat) as a sparkline, so a
+  // non-converged model is visible right where the ranking is read.
+  const ll = run.ll_series || [];
+  if (ll.length >= 2) {
+    const t = el("div", { class: "tile", title: "log-likelihood per sweep" });
+    // sparkline() draws non-negative bar heights; log-likelihoods are
+    // negative, so normalize the series into (0.1, 1] — the floor keeps
+    // a flat (already-converged) series visibly non-empty.
+    const lo = Math.min(...ll), hi = Math.max(...ll);
+    const norm = ll.map(v => 0.1 + 0.9 * ((v - lo) / (hi - lo || 1)));
+    const last = ll[ll.length - 1];
+    t.append(sparkline(norm), el("div", { class: "l" },
+                                 `convergence (final ll ${last.toFixed(3)})`));
+    box.append(t);
+  }
 }
 
 function renderBars(elId, values, titleFn) {

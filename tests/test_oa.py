@@ -168,7 +168,8 @@ def test_run_oa_end_to_end(tmp_path, datatype):
     res.with_suffix(".manifest.json").write_text(json.dumps(
         {"n_events": 999, "n_docs": 4, "n_vocab": 5, "n_tokens": 24,
          "engine": "gibbs", "config_hash": "abc", "seed": 0,
-         "wall_seconds": 1.0}))
+         "wall_seconds": 1.0, "events_per_sec": 12345.6,
+         "ll_history": [[-1, -5.1], [9, -4.2], [19, -4.05]]}))
 
     assert run_oa(cfg, date, datatype) == 0
 
@@ -192,6 +193,10 @@ def test_run_oa_end_to_end(tmp_path, datatype):
     assert len(summary["timeline_hourly"]) == 24
     assert sum(summary["timeline_hourly"]) == len(df)
     assert summary["run"]["n_events"] == 999
+    # §5.5 observability surfaces in the dashboard: throughput +
+    # the convergence series (values only — sweep ids are runlog detail)
+    assert summary["run"]["events_per_sec"] == 12345.6
+    assert summary["run"]["ll_series"] == [-5.1, -4.2, -4.05]
 
     graph = json.loads((out / "graph.json").read_text())
     assert graph["nodes"] and graph["links"]
