@@ -82,8 +82,8 @@ function renderTiles(sum) {
   if (ll.length >= 2) {
     const t = el("div", { class: "tile", title: "log-likelihood per sweep" });
     // sparkline() draws non-negative bar heights; log-likelihoods are
-    // negative, so normalize the series into (0.1, 1] — the floor keeps
-    // a flat (already-converged) series visibly non-empty.
+    // negative, so normalize the series into the 0.1..1 range — the
+    // floor keeps a flat, already-converged series visibly non-empty.
     const lo = Math.min(...ll), hi = Math.max(...ll);
     const norm = ll.map(v => 0.1 + 0.9 * ((v - lo) / (hi - lo || 1)));
     const last = ll[ll.length - 1];
