@@ -41,16 +41,31 @@ def _unique_inverse(arr: np.ndarray,
     return u, inv
 
 
+def _sorted_table_lookup(keys: np.ndarray, values: np.ndarray,
+                         ids: np.ndarray | None = None,
+                         fill: int = -1) -> tuple[np.ndarray, np.ndarray]:
+    """One searchsorted pass into an ascending key table. Returns
+    (result, hit_mask): hits map to `ids[pos]` (or the table position
+    when `ids` is None); misses map to `fill`. The single lookup idiom
+    shared by the string path and the packed 10⁹-event streaming path —
+    an edge-handling fix lands in exactly one place."""
+    if len(keys) == 0:
+        miss = np.zeros(len(values), bool)
+        return np.full(len(values), fill, np.int32), miss
+    pos = np.minimum(np.searchsorted(keys, values), len(keys) - 1)
+    ok = keys[pos] == values
+    out = ids[pos] if ids is not None else pos.astype(np.int32)
+    return np.where(ok, out, np.int32(fill)), ok
+
+
 def _lookup_sorted(keys: np.ndarray, values: np.ndarray, strict: bool,
                    what: str) -> np.ndarray:
     """Vectorized sorted-array lookup; unknown values -> -1 (strict=False)."""
-    idx = np.searchsorted(keys, values)
-    idx = np.clip(idx, 0, len(keys) - 1)
-    ok = keys[idx] == values
+    out, ok = _sorted_table_lookup(keys, values)
     if strict and not ok.all():
         missing = np.unique(np.asarray(values)[~ok])[:5]
         raise KeyError(f"unknown {what} (first 5): {missing.tolist()}")
-    return np.where(ok, idx, -1).astype(np.int32)
+    return out.astype(np.int32, copy=False)
 
 
 @dataclasses.dataclass
@@ -90,10 +105,34 @@ class CorpusBundle:
     doc_keys: np.ndarray           # object [D] doc id -> IP string
     token_event: np.ndarray        # int64 [n_real_tokens] token -> event row
     n_real_tokens: int             # tokens from real events (before feedback)
+    # Integer-keyed lookup tables, populated by the packed fast path:
+    # ascending packed word keys / uint32 IPs with their vocab/doc ids.
+    # They let the streaming scale path map a raw 10⁸-token chunk into
+    # the TRAINED id spaces with one searchsorted against a tiny table —
+    # no per-chunk unique sort, no string rendering (docs/PERF.md).
+    word_key_sorted: np.ndarray | None = None   # int64 [V] ascending
+    word_key_ids: np.ndarray | None = None      # int32 [V] -> vocab id
+    doc_u32_sorted: np.ndarray | None = None    # uint32 [D] ascending
+    doc_u32_ids: np.ndarray | None = None       # int32 [D] -> doc id
 
     def doc_index(self, ips: np.ndarray, strict: bool = True) -> np.ndarray:
         """Map IP strings to doc ids; unknown IPs -> -1 (strict=False)."""
         return _lookup_sorted(self.doc_keys, ips, strict, "IPs")
+
+    def word_ids_packed(self, word_key: np.ndarray,
+                        fill: int = -1) -> np.ndarray:
+        """Map packed int64 word keys to trained vocab ids; unseen ->
+        `fill`. O(n log V) against the [V]-sized table — built for
+        full-chunk mapping on the 10⁹-event streaming path."""
+        assert self.word_key_sorted is not None, "bundle lacks packed keys"
+        return _sorted_table_lookup(self.word_key_sorted, word_key,
+                                    self.word_key_ids, fill)[0]
+
+    def doc_ids_u32(self, ip_u32: np.ndarray, fill: int = -1) -> np.ndarray:
+        """Map uint32 IPs to trained doc ids; unseen -> `fill`."""
+        assert self.doc_u32_sorted is not None, "bundle lacks u32 docs"
+        return _sorted_table_lookup(self.doc_u32_sorted, ip_u32,
+                                    self.doc_u32_ids, fill)[0]
 
 
 def build_corpus(words: WordTable,
@@ -165,6 +204,14 @@ def build_corpus(words: WordTable,
         doc_keys=doc_keys,
         token_event=words.event_idx.astype(np.int64),
         n_real_tokens=words.n_rows,
+        # ukeys/udocs come out of _unique_inverse ascending, so they are
+        # the searchsorted tables; wrank/drank carry the final ids.
+        word_key_sorted=(ukeys if words.word_key is not None else None),
+        word_key_ids=(wrank.astype(np.int32)
+                      if words.word_key is not None else None),
+        doc_u32_sorted=(udocs if words.ip_u32 is not None else None),
+        doc_u32_ids=(drank.astype(np.int32)
+                     if words.ip_u32 is not None else None),
     )
 
 

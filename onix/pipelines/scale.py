@@ -191,7 +191,6 @@ def _stream_score(bundle, fitted_edges, theta, phi_wk, *, n_events: int,
     import jax.numpy as jnp
 
     from onix.models import scoring
-    from onix.pipelines.corpus_build import _unique_inverse
 
     theta_x, phi_x = extend_model_for_unseen(theta, phi_wk)
     d_x, v_x = theta_x.shape[0], phi_x.shape[0]
@@ -242,18 +241,16 @@ def _stream_score(bundle, fitted_edges, theta, phi_wk, *, n_events: int,
                 proto_classes=cols["proto_classes"],
                 edges=fitted_edges)
             del cols
-            # Map packed keys / IPs into the TRAINED id spaces at the
-            # unique level (cheap: cardinality is tiny), unknowns to
-            # the UNSEEN rows.
-            ukeys, winv = _unique_inverse(wt.word_key)
-            wid_u = bundle.vocab.ids(wt.render_keys(ukeys), strict=False)
-            wid_u = np.where(wid_u < 0, unseen_w, wid_u).astype(np.int32)
-            udocs, dinv = _unique_inverse(wt.ip_u32)
-            from onix.pipelines.words import u32_to_ips
-            did_u = bundle.doc_index(u32_to_ips(udocs), strict=False)
-            did_u = np.where(did_u < 0, unseen_d, did_u).astype(np.int32)
-            idx = did_u[dinv] * np.int32(v_x) + wid_u[winv]
-            del wt, winv, dinv
+            # Map packed keys / IPs into the TRAINED id spaces with one
+            # searchsorted per column against the bundle's tiny sorted
+            # tables; unknowns go to the UNSEEN rows. No per-chunk
+            # unique sort: at 2x10^8 tokens/chunk the old
+            # unique-then-map path spent most of the 1B run's wall in
+            # these sorts (docs/SCALE_1B_r02.json stream_synth_words).
+            wid = bundle.word_ids_packed(wt.word_key, fill=unseen_w)
+            did = bundle.doc_ids_u32(wt.ip_u32, fill=unseen_d)
+            idx = did * np.int32(v_x) + wid
+            del wt, wid, did
         walls["stream_synth_words"] += time.monotonic() - t
 
         t = time.monotonic()

@@ -38,6 +38,41 @@ def test_scale_streaming_mode(tmp_path):
     assert (tmp_path / "scale.json").exists()
 
 
+def test_bundle_packed_lookup_matches_string_path():
+    """The searchsorted fast maps (packed word key -> vocab id,
+    uint32 IP -> doc id) must agree with the render-then-string lookup
+    they replace on the streaming path, including unseen entries."""
+    import numpy as np
+
+    from onix.pipelines.corpus_build import build_corpus
+    from onix.pipelines.synth import synth_flow_day_arrays
+    from onix.pipelines.words import flow_words_from_arrays, u32_to_ips
+
+    cols = synth_flow_day_arrays(20_000, n_hosts=300, n_anomalies=10,
+                                 seed=4)
+    wt = flow_words_from_arrays(
+        **{k: cols[k] for k in ("sip_u32", "dip_u32", "sport", "dport",
+                                "proto_id", "hour", "ibyt", "ipkt")},
+        proto_classes=cols["proto_classes"])
+    bundle = build_corpus(wt)
+
+    cols2 = synth_flow_day_arrays(8_000, n_hosts=500, n_anomalies=10,
+                                  seed=99)   # other hosts -> unseen docs
+    wt2 = flow_words_from_arrays(
+        **{k: cols2[k] for k in ("sip_u32", "dip_u32", "sport", "dport",
+                                 "proto_id", "hour", "ibyt", "ipkt")},
+        proto_classes=cols2["proto_classes"], edges=wt.edges)
+
+    got_w = bundle.word_ids_packed(wt2.word_key)
+    want_w = bundle.vocab.ids(wt2.render_keys(wt2.word_key), strict=False)
+    np.testing.assert_array_equal(got_w, want_w)
+    got_d = bundle.doc_ids_u32(wt2.ip_u32)
+    want_d = bundle.doc_index(u32_to_ips(wt2.ip_u32), strict=False)
+    np.testing.assert_array_equal(got_d, want_d)
+    assert (got_w >= 0).any() and (got_w < 0).any()   # both regimes hit
+    assert (got_d >= 0).any() and (got_d < 0).any()
+
+
 def test_scale_streaming_unseen_score_at_prior_rarity():
     """An event whose word was never seen in training must score MORE
     suspicious than any seen word, through the PRODUCTION extension
