@@ -53,6 +53,20 @@ def test_js_endpoints_match_server_contract():
         assert path.startswith(("/data/", "${dir}/")), path
 
 
+def test_js_consumes_run_health_fields():
+    """The run-health tiles read summary.run fields the OA engine
+    emits; renaming either side must break this pin. The ll sparkline
+    must normalize (raw log-likelihoods are negative and would render
+    blank bars)."""
+    from onix.oa import engine as oa_engine
+    import inspect
+    assert "ll_series" in JS and "events_per_sec" in JS
+    src = inspect.getsource(oa_engine._summary)
+    assert "ll_series" in src and "events_per_sec" in src
+    assert re.search(r"Math\.min\(\s*\.\.\.ll", JS), \
+        "convergence sparkline must min-normalize the negative series"
+
+
 def test_js_braces_and_parens_balanced():
     """Cheap parse-health check: unbalanced delimiters mean a syntax
     error that would kill the whole dashboard silently."""
