@@ -150,19 +150,23 @@ def make_block_step(*, alpha: float, eta: float, n_vocab: int,
         ndk = n_dk[d].astype(jnp.float32) - ohf
         nwk = n_wk[w].astype(jnp.float32) - ohf
         nk = n_k.astype(jnp.float32)[None, :] - ohf
-        # Categorical sampling in LOG space via Gumbel-argmax. An
-        # inverse-CDF formulation (cumsum + 1 uniform/token, 20x fewer
-        # PRNG bits) was measured at identical tokens/s — the sweep is
-        # scatter/gather-bound, not sampler-bound — and rejected
-        # because a linear-space f32 cumsum rounds away topics whose
-        # conditional probability is below ~2^-24 of the total, making
-        # rare-topic transitions exactly impossible; log space keeps
-        # the full dynamic range.
-        logp = (jnp.log(ndk + alpha)
-                + jnp.log(jnp.maximum(nwk + eta, 1e-10))
-                - jnp.log(nk + v_eta))
-        g = jax.random.gumbel(skey, logp.shape, dtype=jnp.float32)
-        z_new = jnp.argmax(logp + g, axis=-1).astype(jnp.int32)
+        # Categorical sampling via the exponential race: z = argmax
+        # p_k / e_k with e_k ~ Exp(1) — the Gumbel-argmax trick in
+        # LINEAR space (log(p/e) = log p + gumbel(u) for the same
+        # uniforms, so the argmax is identical up to float rounding)
+        # at one log per element instead of four. Per-element products
+        # keep full relative precision — no cumsum, so no rare-topic
+        # rounding (the reason an inverse-CDF formulation was
+        # rejected: a linear f32 cumsum makes transitions to topics
+        # below ~2^-24 of the total exactly impossible). Measured
+        # 1.75x faster on CPU (where the test suite and demo live);
+        # TPU re-measurement pending — believed scatter-bound there.
+        # Study + revert criterion: docs/PERF.md "exponential race".
+        p = ((ndk + alpha) * jnp.maximum(nwk + eta, 1e-10)
+             / (nk + v_eta))
+        u = jax.random.uniform(skey, p.shape, dtype=jnp.float32,
+                               minval=1e-38)
+        z_new = jnp.argmax(p / -jnp.log(u), axis=-1).astype(jnp.int32)
         z_new = jnp.where(m > 0, z_new, z_old)      # padding keeps sentinel
         # Dense one-hot delta rows, NOT per-element scalar scatters:
         # XLA's TPU scatter vectorizes the K lane dimension of row
