@@ -109,16 +109,32 @@ def ipfix_blob(long_varlen=False, strip_template=False):
     return hdr + sets
 
 
-def dns_pcap_blob(truncate=0):
-    """One-response DNS pcap (Ethernet/IPv4/UDP), optionally torn."""
+def dns_pcap_blob(truncate=0, ipv6=False, ext_headers=False):
+    """One-response DNS pcap (Ethernet/IPv4 or /IPv6/UDP), optionally
+    torn; ext_headers prepends a hop-by-hop extension header to the v6
+    packet so the chain walk is exercised sanitized."""
     name = b"\x03www\x07example\x03com\x00"
     dns = struct.pack(">HHHHHH", 0x1234, 0x8180, 1, 0, 0, 0) + name + \
         struct.pack(">HH", 1, 1)
     udp = struct.pack(">HHHH", 53, 40000, 8 + len(dns), 0) + dns
-    ip = struct.pack(">BBHHHBBHII", 0x45, 0, 20 + len(udp), 0, 0, 64, 17,
-                     0, 0xC0000235, 0x0A000001)
-    eth = b"\x02" * 6 + b"\x04" * 6 + struct.pack(">H", 0x0800)
-    pkt = eth + ip + udp
+    if ipv6:
+        payload = udp
+        nh = 17
+        if ext_headers:
+            payload = struct.pack(">BB", 17, 0) + b"\0" * 6 + payload
+            nh = 0                       # hop-by-hop first
+        ip = struct.pack(">IHBB", 6 << 28, len(payload), nh, 64)
+        ip += bytes.fromhex("20010db8000000000000000000000053")
+        ip += bytes.fromhex("20010db8000000000000000000000001")
+        ip += payload
+        etype = 0x86DD
+        pkt_l3 = ip
+    else:
+        pkt_l3 = struct.pack(">BBHHHBBHII", 0x45, 0, 20 + len(udp), 0, 0,
+                             64, 17, 0, 0xC0000235, 0x0A000001) + udp
+        etype = 0x0800
+    eth = b"\x02" * 6 + b"\x04" * 6 + struct.pack(">H", etype)
+    pkt = eth + pkt_l3
     hdr = struct.pack("<IHHiIII", 0xA1B2C3D4, 2, 4, 0, 0, 1 << 16, 1)
     rec = struct.pack("<IIII", 1467936000, 0, len(pkt), len(pkt))
     blob = hdr + rec + pkt
@@ -135,6 +151,11 @@ def main() -> int:
     # -- pcapdns ----------------------------------------------------------
     for name, blob, rc in [
         ("dns response", dns_pcap_blob(), 0),
+        ("dns response over ipv6", dns_pcap_blob(ipv6=True), 0),
+        ("ipv6 + hop-by-hop extension header",
+         dns_pcap_blob(ipv6=True, ext_headers=True), 0),
+        ("ipv6 torn mid-extension",
+         dns_pcap_blob(ipv6=True, ext_headers=True, truncate=30), 1),
         ("torn record", dns_pcap_blob(truncate=9), 1),
         ("not a pcap", b"\x00" * 48, 1),
         ("header only", dns_pcap_blob()[:24], 0),   # empty capture is fine

@@ -14,10 +14,12 @@
 //
 // Format coverage: classic pcap (magic a1b2c3d4 / d4c3b2a1, plus the
 // a1b23c4d nanosecond variant), Ethernet II with optional single
-// 802.1Q VLAN tag, IPv4 (any IHL, non-fragmented), UDP src or dst port
-// 53. Question-section names are plain label sequences per RFC 1035
-// §4.1.2 (compression pointers, legal but rare in questions, terminate
-// the name defensively). Malformed packets are skipped, never fatal —
+// 802.1Q VLAN tag, IPv4 (any IHL, non-fragmented) and IPv6 (RFC 8200,
+// chainable extension headers walked, addresses printed in RFC 5952
+// canonical form), UDP src or dst port 53. Question-section names are
+// plain label sequences per RFC 1035 §4.1.2 (compression pointers,
+// legal but rare in questions, terminate the name defensively).
+// Malformed packets are skipped, never fatal —
 // a capture with junk in the middle still yields its good rows
 // (tshark's behavior too).
 
@@ -46,6 +48,41 @@ uint16_t rd16(const uint8_t* p, bool swap) {
 void ip_str(uint32_t ip, char* out) {
   std::snprintf(out, 16, "%u.%u.%u.%u", (ip >> 24) & 255, (ip >> 16) & 255,
                 (ip >> 8) & 255, ip & 255);
+}
+
+// RFC 5952 canonical text form (lowercase hex, longest zero run of >=2
+// groups compressed to "::", leftmost on ties) — matches what tshark
+// prints for ipv6.src/dst, so the TSV contract is identical for v6
+// rows. `out` must hold >= 46 bytes.
+void ip6_str(const uint8_t* addr, char* out) {
+  uint16_t g[8];
+  for (int i = 0; i < 8; ++i)
+    g[i] = (uint16_t)((addr[2 * i] << 8) | addr[2 * i + 1]);
+  int best = -1, best_len = 0;
+  for (int i = 0; i < 8;) {
+    if (g[i] == 0) {
+      int j = i;
+      while (j < 8 && g[j] == 0) ++j;
+      if (j - i > best_len) { best = i; best_len = j - i; }
+      i = j;
+    } else {
+      ++i;
+    }
+  }
+  if (best_len < 2) best = -1;   // a single zero group is not compressed
+  char* p = out;
+  for (int i = 0; i < 8;) {
+    if (i == best) {
+      *p++ = ':';
+      *p++ = ':';
+      i += best_len;
+      continue;
+    }
+    if (p != out && p[-1] != ':') *p++ = ':';
+    p += std::snprintf(p, 6, "%x", g[i]);
+    ++i;
+  }
+  *p = '\0';
 }
 
 // Parse the first question name at `off`; returns false on malformed.
@@ -113,27 +150,51 @@ extern "C" int64_t pcapdns_extract(const uint8_t* buf, int64_t len,
       etype = be16(pkt + l2 + 2);
       l2 += 4;
     }
-    if (etype != 0x0800) continue;    // IPv4 only
-
-    if (incl < l2 + 20) continue;
-    const uint8_t* ip = pkt + l2;
-    if ((ip[0] >> 4) != 4) continue;
-    const size_t ihl = (size_t)(ip[0] & 0x0F) * 4;
-    if (ihl < 20 || incl < l2 + ihl + 8) continue;
-    if (ip[9] != 17) continue;        // UDP
-    const uint16_t frag = be16(ip + 6);
-    if (frag & 0x1FFF) continue;      // non-first fragment
-    const uint32_t src = ((uint32_t)ip[12] << 24) | (ip[13] << 16) |
-                         (ip[14] << 8) | ip[15];
-    const uint32_t dst = ((uint32_t)ip[16] << 24) | (ip[17] << 16) |
-                         (ip[18] << 8) | ip[19];
-
-    const uint8_t* udp = ip + ihl;
+    const uint8_t* udp;
+    char a[46], b[46];
+    if (etype == 0x0800) {            // IPv4
+      if (incl < l2 + 20) continue;
+      const uint8_t* ip = pkt + l2;
+      if ((ip[0] >> 4) != 4) continue;
+      const size_t ihl = (size_t)(ip[0] & 0x0F) * 4;
+      if (ihl < 20 || incl < l2 + ihl + 8) continue;
+      if (ip[9] != 17) continue;      // UDP
+      const uint16_t frag = be16(ip + 6);
+      if (frag & 0x1FFF) continue;    // non-first fragment
+      ip_str(((uint32_t)ip[12] << 24) | (ip[13] << 16) | (ip[14] << 8) |
+                 ip[15], a);
+      ip_str(((uint32_t)ip[16] << 24) | (ip[17] << 16) | (ip[18] << 8) |
+                 ip[19], b);
+      udp = ip + ihl;
+    } else if (etype == 0x86DD) {     // IPv6 (RFC 8200)
+      if (incl < l2 + 40) continue;
+      const uint8_t* ip6 = pkt + l2;
+      if ((ip6[0] >> 4) != 6) continue;
+      uint8_t nh = ip6[6];
+      size_t l3 = 40;
+      // Walk chainable extension headers (hop-by-hop 0, routing 43,
+      // destination options 60 — all share the (next, len8) shape);
+      // fragments and anything else end the walk.
+      for (int hops = 0;
+           hops < 4 && (nh == 0 || nh == 43 || nh == 60); ++hops) {
+        if (incl < l2 + l3 + 8) { nh = 0xFF; break; }
+        const uint8_t* eh = pkt + l2 + l3;
+        nh = eh[0];
+        l3 += ((size_t)eh[1] + 1) * 8;
+      }
+      if (nh != 17) continue;         // UDP
+      if (incl < l2 + l3 + 8) continue;
+      ip6_str(ip6 + 8, a);
+      ip6_str(ip6 + 24, b);
+      udp = ip6 + l3;
+    } else {
+      continue;                       // other L3
+    }
     const uint16_t sport = be16(udp);
     const uint16_t dport = be16(udp + 2);
     if (sport != 53 && dport != 53) continue;
     const size_t udp_len = be16(udp + 4);
-    if (udp_len < 8 || l2 + ihl + udp_len > incl) continue;
+    if (udp_len < 8 || (size_t)(udp - pkt) + udp_len > incl) continue;
 
     const uint8_t* dns = udp + 8;
     const size_t dns_len = udp_len - 8;
@@ -149,9 +210,6 @@ extern "C" int64_t pcapdns_extract(const uint8_t* buf, int64_t len,
     const uint16_t qtype = be16(dns + qoff);
     const uint16_t rcode = flags & 0x000F;
 
-    char a[16], b[16];
-    ip_str(src, a);
-    ip_str(dst, b);
     const double ts = (double)ts_sec +
                       (double)ts_frac / (nanos ? 1e9 : 1e6);
     std::fprintf(out, "%.6f\t%u\t%s\t%s\t%s\t%u\t%u\n", ts, orig, a, b,

@@ -22,12 +22,32 @@ import pandas as pd
 _NATIVE_DIR = pathlib.Path(__file__).parent.parent.parent / "native" / "pcapdns"
 _BIN_PATH = _NATIVE_DIR / "build" / "pcapdns"
 
+# tshark extracts v4 and v6 addresses through separate fields; the v6
+# columns are merged back into the 7-column TSV contract the native
+# extractor emits (RFC 5952 canonical text on both paths).
 TSHARK_ARGS = [
     "-T", "fields", "-e", "frame.time_epoch", "-e", "frame.len",
-    "-e", "ip.src", "-e", "ip.dst", "-e", "dns.qry.name",
-    "-e", "dns.qry.type", "-e", "dns.flags.rcode",
-    "-Y", "dns.flags.response == 1 && ip && udp",
+    "-e", "ip.src", "-e", "ipv6.src", "-e", "ip.dst", "-e", "ipv6.dst",
+    "-e", "dns.qry.name", "-e", "dns.qry.type", "-e", "dns.flags.rcode",
+    "-Y", "dns.flags.response == 1 && (ip || ipv6) && udp",
 ]
+
+
+def _merge_tshark_v6(tsv: str) -> str:
+    """Collapse the (ip.src, ipv6.src) and (ip.dst, ipv6.dst) column
+    pairs into single src/dst columns — exactly one of each pair is
+    non-empty per row (the display filter requires ip or ipv6)."""
+    out = []
+    for line in tsv.splitlines():
+        if not line.strip():
+            continue
+        f = line.split("\t")
+        if len(f) != 9:      # unexpected shape: let the parser complain
+            out.append(line)
+            continue
+        out.append("\t".join([f[0], f[1], f[2] or f[3], f[4] or f[5],
+                              f[6], f[7], f[8]]))
+    return "\n".join(out) + ("\n" if out else "")
 
 
 class PcapUnavailable(RuntimeError):
@@ -54,7 +74,7 @@ def extract_dns_tsv(pcap_path: str | pathlib.Path) -> str:
         p = subprocess.run([tshark, "-r", pcap_path, *TSHARK_ARGS],
                            capture_output=True, text=True, timeout=600)
         if p.returncode == 0:
-            return p.stdout
+            return _merge_tshark_v6(p.stdout)
         # fall through: a tshark that cannot read the file gets the
         # native decoder's (stricter) error instead
     _build_native()
@@ -102,9 +122,11 @@ def _ip_u32(s: str) -> int:
 
 def write_dns_pcap(table: pd.DataFrame, nanos: bool = False) -> bytes:
     """Encode dns rows (ip_src?, ip_dst, dns_qry_name, dns_qry_type,
-    dns_qry_rcode, frame_time or epoch) as an Ethernet/IPv4/UDP pcap of
-    DNS responses. frame_len in the OUTPUT equals the synthesized
-    packet's length (self-consistent round trip)."""
+    dns_qry_rcode, frame_time or epoch) as an Ethernet/IP/UDP pcap of
+    DNS responses; rows whose addresses contain ':' become IPv6
+    packets. frame_len in the OUTPUT equals the synthesized packet's
+    length (self-consistent round trip)."""
+    import socket
     magic = 0xA1B23C4D if nanos else 0xA1B2C3D4
     out = bytearray(struct.pack("<IHHiIII", magic, 2, 4, 0, 0, 1 << 16, 1))
     if "frame_time_epoch" in table:
@@ -113,17 +135,31 @@ def write_dns_pcap(table: pd.DataFrame, nanos: bool = False) -> bytes:
         epochs = (pd.to_datetime(table["frame_time"]).astype(np.int64)
                   / 1e9).to_numpy()
     srcs = (table["ip_src"] if "ip_src" in table
-            else pd.Series(["192.0.2.53"] * len(table)))
+            else table["ip_dst"].map(       # default server follows the
+                lambda d: "2001:db8::53"    # row's address family
+                if ":" in str(d) else "192.0.2.53"))
     for i in range(len(table)):
         dns = _dns_response(str(table["dns_qry_name"].iloc[i]),
                             int(table["dns_qry_type"].iloc[i]),
                             int(table["dns_qry_rcode"].iloc[i]))
         udp = struct.pack(">HHHH", 53, 33333, 8 + len(dns), 0) + dns
-        total = 20 + len(udp)
-        ip = struct.pack(">BBHHHBBHII", 0x45, 0, total, 0, 0, 64, 17, 0,
-                         _ip_u32(str(srcs.iloc[i])),
-                         _ip_u32(str(table["ip_dst"].iloc[i])))
-        eth = b"\x02" * 6 + b"\x04" * 6 + struct.pack(">H", 0x0800)
+        src_s = str(srcs.iloc[i])
+        dst_s = str(table["ip_dst"].iloc[i])
+        if (":" in src_s) != (":" in dst_s):
+            raise ValueError(
+                f"row {i}: mixed address families ({src_s!r}, {dst_s!r}) "
+                "cannot share one packet")
+        if ":" in src_s:
+            ip = struct.pack(">IHBB", 6 << 28, len(udp), 17, 64)
+            ip += socket.inet_pton(socket.AF_INET6, src_s)
+            ip += socket.inet_pton(socket.AF_INET6, dst_s)
+            etype = 0x86DD
+        else:
+            total = 20 + len(udp)
+            ip = struct.pack(">BBHHHBBHII", 0x45, 0, total, 0, 0, 64, 17, 0,
+                             _ip_u32(src_s), _ip_u32(dst_s))
+            etype = 0x0800
+        eth = b"\x02" * 6 + b"\x04" * 6 + struct.pack(">H", etype)
         pkt = eth + ip + udp
         sec = int(epochs[i])
         frac = epochs[i] - sec

@@ -54,6 +54,45 @@ def test_pcap_roundtrip(tmp_path):
     assert out["frame_time"].iloc[0] == "2016-07-08 00:00:00"
 
 
+def test_pcap_ipv6_roundtrip(tmp_path):
+    """IPv6 DNS replies decode with RFC 5952 canonical addresses in a
+    capture that mixes v4 and v6 packets; the canonical-form edges
+    (leftmost-longest :: rule, uncompressed single zero group) hold."""
+    t = _table(n=6)
+    v6_dst = ["2001:db8::1", "fe80::1", "2001:0:0:1::1",
+              "2001:db8:1:2:3:4:5:0", "::1", "2001:db8::2"]
+    t6 = t.copy()
+    t6["ip_src"] = ["2001:db8::53"] * 6
+    t6["ip_dst"] = v6_dst
+    p = tmp_path / "dns6.pcap"
+    p.write_bytes(pcap.write_dns_pcap(t) + pcap.write_dns_pcap(t6)[24:])
+    out = pcap.parse_dns_pcap(p)
+    assert len(out) == 12
+    assert out["ip_dst"].tolist()[6:] == v6_dst
+    assert out["ip_dst"].tolist()[:6] == t["ip_dst"].tolist()
+    assert out["dns_qry_name"].tolist()[6:] == t6["dns_qry_name"].tolist()
+
+
+def test_merge_tshark_v6_columns():
+    """The tshark branch extracts v4/v6 addresses via separate fields;
+    the merge must collapse them into the native extractor's 7-column
+    contract (exactly one of each pair is populated per row)."""
+    tsv = ("1.5\t90\t192.0.2.1\t\t10.0.0.2\t\tx.org\t1\t0\n"
+           "2.5\t110\t\t2001:db8::53\t\t2001:db8::1\ty.org\t28\t3\n")
+    got = pcap._merge_tshark_v6(tsv).splitlines()
+    assert got[0].split("\t") == ["1.5", "90", "192.0.2.1", "10.0.0.2",
+                                  "x.org", "1", "0"]
+    assert got[1].split("\t") == ["2.5", "110", "2001:db8::53",
+                                  "2001:db8::1", "y.org", "28", "3"]
+
+
+def test_write_dns_pcap_rejects_mixed_family_row():
+    t = _table(n=4)
+    t["ip_dst"] = ["2001:db8::1"] * 4  # v6 dst, v4 src from _table
+    with pytest.raises(ValueError, match="mixed address families"):
+        pcap.write_dns_pcap(t)
+
+
 def test_pcap_nanosecond_variant(tmp_path):
     t = _table(n=5)
     p = tmp_path / "dns_ns.pcap"
