@@ -218,11 +218,6 @@ class StreamingScorer:
         grown[:cap] = self._gamma
         self._gamma = grown
 
-    def _theta(self) -> np.ndarray:
-        """Padded-capacity doc-topic estimate; never-seen rows are the
-        uniform prior (maximally non-committal for brand-new IPs)."""
-        return self._gamma / self._gamma.sum(1, keepdims=True)
-
     # -- the streaming step -----------------------------------------------
 
     def process(self, table: pd.DataFrame) -> BatchResult:
@@ -256,15 +251,26 @@ class StreamingScorer:
         self._gamma[dm[real]] = gm[real]
 
         # Incremental scoring of THIS batch's events under the updated
-        # model (token padding reuses the batch's pow2 shape, so the
-        # scoring program compiles once per shape too).
-        theta = self._theta()
+        # model. Only the batch's OWN doc rows are normalized and
+        # shipped — the full padded-capacity gamma grows with every doc
+        # the stream has ever seen, so using it here would make each
+        # batch cost O(total docs) on a long-running stream. Rows are
+        # padded to the batch's pow2 doc shape (never-indexed filler at
+        # the uniform prior), so the scoring program still compiles
+        # once per (token, doc) shape pair, not per batch.
+        # dm[real] is the batch's sorted unique global doc ids, and the
+        # batch's padded local doc/word id arrays are exactly the token
+        # columns scoring needs — make_minibatch already computed all of
+        # them; no second unique pass over the tokens.
+        uniq_d = dm[real]
+        k = self._gamma.shape[1]
+        theta_b = np.full((pad_docs, k), 1.0 / k, np.float32)
+        rows = self._gamma[uniq_d]
+        theta_b[:len(uniq_d)] = rows / rows.sum(1, keepdims=True)
         phi = np.asarray(phi_estimate(self.state))
-        d_pad = np.zeros(pad_to, np.int32)
-        w_pad = np.zeros(pad_to, np.int32)
-        d_pad[:t] = did
-        w_pad[:t] = wid
-        tok_scores = score_all(theta, phi, d_pad, w_pad, chunk=pad_to)[:t]
+        tok_scores = score_all(theta_b, phi, np.asarray(batch.doc_ids),
+                               np.asarray(batch.word_ids),
+                               chunk=pad_to)[:t]
 
         ev_scores = np.full(n_events, np.inf, np.float64)
         np.minimum.at(ev_scores, words.event_idx, tok_scores)
