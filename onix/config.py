@@ -119,6 +119,19 @@ class PipelineConfig:
 
 
 @dataclass
+class IngestConfig:
+    """Telemetry decoding options (SURVEY.md §2.1 #1-#2).
+
+    apply_sampling scales flow packet/byte counters by the announcing
+    exporter's sampling interval (NetFlow v9 / IPFIX options records,
+    field 34; per source/domain id) — nfdump-style counter scaling for
+    sampled exporters. Off by default: raw wire counters are the honest
+    record of what was exported."""
+
+    apply_sampling: bool = False
+
+
+@dataclass
 class StoreConfig:
     """Storage substrate: partitioned Parquet in place of HDFS+Hive.
 
@@ -155,6 +168,7 @@ class OnixConfig:
     lda: LDAConfig = field(default_factory=LDAConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)
     pipeline: PipelineConfig = field(default_factory=PipelineConfig)
+    ingest: IngestConfig = field(default_factory=IngestConfig)
     store: StoreConfig = field(default_factory=StoreConfig)
     oa: OAConfig = field(default_factory=OAConfig)
 
@@ -233,6 +247,7 @@ _NESTED = {
     (OnixConfig, "lda"): LDAConfig,
     (OnixConfig, "mesh"): MeshConfig,
     (OnixConfig, "pipeline"): PipelineConfig,
+    (OnixConfig, "ingest"): IngestConfig,
     (OnixConfig, "store"): StoreConfig,
     (OnixConfig, "oa"): OAConfig,
 }

@@ -145,7 +145,8 @@ def worker_loop(cfg: OnixConfig, datatype: str,
             if digest is None:
                 continue
             try:
-                counts = ingest_file(store, datatype, path)
+                counts = ingest_file(store, datatype, path,
+                                     apply_sampling=cfg.ingest.apply_sampling)
                 claims.commit(digest)
                 stats["files"] += 1
                 stats["rows"] += sum(counts.values())

@@ -226,11 +226,14 @@ def decode_nfcapd(path: str | pathlib.Path) -> pd.DataFrame:
     })
 
 
-def decode_file(path: str | pathlib.Path) -> pd.DataFrame:
+def decode_file(path: str | pathlib.Path,
+                apply_sampling: bool = False) -> pd.DataFrame:
     data = pathlib.Path(path).read_bytes()
     if is_nfcapd(data):
+        # nfcapd passthrough prints whatever nfdump recorded; sampling
+        # scaling there is nfdump's own concern, not reproduced here.
         return decode_nfcapd(path)
-    return decode_bytes(data)
+    return decode_bytes(data, apply_sampling=apply_sampling)
 
 
 # -- v5 packet writer (synthetic captures + round-trip tests) --------------

@@ -26,10 +26,11 @@ DEFAULT_PATTERNS = ("*.nf5", "*.tsv", "*.log", "*.csv", "*.pcap",
                     "nfcapd.2*")
 
 
-def decode(datatype: str, path: str | pathlib.Path) -> pd.DataFrame:
+def decode(datatype: str, path: str | pathlib.Path,
+           apply_sampling: bool = False) -> pd.DataFrame:
     if datatype == "flow":
         from onix.ingest.nfdecode import decode_file
-        return decode_file(path)
+        return decode_file(path, apply_sampling=apply_sampling)
     if datatype == "dns":
         # .pcap goes through tshark-or-native extraction (SURVEY.md
         # §3.2 DNS variant); anything else is pre-extracted tshark TSV.
@@ -53,11 +54,12 @@ def _day_of(datatype: str, table: pd.DataFrame) -> pd.Series:
 
 
 def ingest_file(store: Store, datatype: str,
-                path: str | pathlib.Path) -> dict[str, int]:
+                path: str | pathlib.Path,
+                apply_sampling: bool = False) -> dict[str, int]:
     """Decode one raw file and append its rows to the day partitions it
     spans (Store.append allocates part numbers atomically, so parallel
     worker threads AND processes never collide). Returns {date: n_rows}."""
-    table = decode(datatype, path)
+    table = decode(datatype, path, apply_sampling=apply_sampling)
     out: dict[str, int] = {}
     if not len(table):
         return out
@@ -71,7 +73,8 @@ def run_ingest(cfg: OnixConfig, datatype: str, paths: list[str]) -> int:
     store = Store(cfg.store.root)
     total = 0
     for p in paths:
-        counts = ingest_file(store, datatype, p)
+        counts = ingest_file(store, datatype, p,
+                             apply_sampling=cfg.ingest.apply_sampling)
         for date, n in sorted(counts.items()):
             print(f"{p}: {n} rows -> {datatype} {date}")
             total += n

@@ -128,7 +128,8 @@ class IngestWatcher:
 
     def _work(self, path: pathlib.Path) -> None:
         try:
-            counts = ingest_file(self.store, self.datatype, path)
+            counts = ingest_file(self.store, self.datatype, path,
+                                 apply_sampling=self.cfg.ingest.apply_sampling)
             self.ledger.commit(path)
             with self._stats_lock:
                 self.stats["files"] += 1

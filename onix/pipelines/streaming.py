@@ -325,7 +325,8 @@ def run_stream(cfg: OnixConfig, datatype: str, paths: list[str],
             batch_idx += 1
             if batch_idx <= done:
                 continue
-            table = decode(datatype, p)
+            table = decode(datatype, p,
+                           apply_sampling=cfg.ingest.apply_sampling)
             res = scorer.process(table)
             total_events += res.n_events
             if epoch == epochs - 1 and len(res.alerts):

@@ -498,6 +498,29 @@ def test_sampling_scaling_is_per_exporter():
 
 
 @needs_decoder
+def test_ingest_apply_sampling_config(tmp_path):
+    """ingest.apply_sampling=true scales stored flow counters by the
+    announcing exporter's rate — the operator-facing path of the
+    options-record support (config override -> run_ingest -> decoder)."""
+    from onix.config import load_config
+    from onix.ingest.run import run_ingest
+    from onix.store import Store
+
+    table = _synth_flow_arrays(n=9, seed=21)
+    cap = tmp_path / "cap.nf"
+    cap.write_bytes(nfd.write_v9(table, sampling_interval=4))
+    for setting, factor in (("false", 1), ("true", 4)):
+        root = tmp_path / f"store_{setting}"
+        cfg = load_config(None, [f"store.root={root}",
+                                 f"ingest.apply_sampling={setting}"])
+        assert run_ingest(cfg, "flow", [str(cap)]) == 0
+        stored = Store(cfg.store.root).read("flow", "2016-07-08")
+        np.testing.assert_array_equal(
+            stored["ipkt"].to_numpy(np.int64),
+            np.minimum(table["ipkt"].to_numpy() * factor, 0xFFFFFFFF))
+
+
+@needs_decoder
 def test_malformed_options_template_rejected():
     """An options template whose scope length is not a multiple of the
     4-byte spec size is malformed framing, not silently tolerated."""
