@@ -40,6 +40,9 @@ Prints ONE JSON line:
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
 
 import numpy as np
@@ -73,13 +76,13 @@ def _dirichlet(rng, k, n):
     return rng.dirichlet(np.full(k, 0.5), size=n).astype(np.float32)
 
 
-def bench_scoring_uniform(jax, jnp):
+def bench_scoring_uniform(jax, jnp, small=False):
     """Headline: uniform-random events, fused scan+top-k, r01 shape."""
     from onix.models.scoring import top_suspicious
 
     n_docs, n_vocab, k = 100_000, 65_536, 20
-    n_events = 1 << 24
-    reps = 8
+    n_events = 1 << 22 if small else 1 << 24
+    reps = 2 if small else 8
     max_results = 1000
 
     rng = np.random.default_rng(0)
@@ -130,15 +133,15 @@ def bench_scoring_uniform(jax, jnp):
     }
 
 
-def bench_gibbs_sweep(jax, jnp):
+def bench_gibbs_sweep(jax, jnp, small=False):
     """Hot loop #2: tokens sampled per second per chip, full sweeps
     chained inside one program (state evolves — nothing to hoist)."""
     from onix.models import lda_gibbs
 
     n_docs, n_vocab, k = 200_000, 4_096, 20
-    n_tokens = 1 << 23            # 8.4M tokens ~ a large day per chip
+    n_tokens = 1 << 21 if small else 1 << 23   # 8.4M ~ a large day/chip
     block = 1 << 16
-    reps = 4
+    reps = 2 if small else 4
 
     rng = np.random.default_rng(0)
     nb = n_tokens // block
@@ -184,14 +187,14 @@ def _zipf_pairs(rng, n_events, n_docs, n_vocab, a=1.3):
     return d, w
 
 
-def bench_scoring_zipf(jax, jnp, n_docs, n_vocab, tag):
+def bench_scoring_zipf(jax, jnp, n_docs, n_vocab, tag, small=False):
     """Product-path scoring (score_all strategy selection + host
     selection exactly as run_scoring does) on Zipf telemetry.
     Host-inclusive wall — this is the honest end-to-end number."""
     from onix.models.scoring import score_all, select_suspicious
 
     k = 20
-    n_events = 1 << 24
+    n_events = 1 << 22 if small else 1 << 24
     rng = np.random.default_rng(1)
     theta = _dirichlet(rng, k, n_docs)
     phi_wk = _dirichlet(rng, k, n_vocab)
@@ -216,30 +219,92 @@ def bench_scoring_zipf(jax, jnp, n_docs, n_vocab, tag):
     }
 
 
+def _probe_backend(timeout_s: float = 240.0):
+    """Probe the default JAX backend in a SUBPROCESS so a down device
+    tunnel can only cost `timeout_s`, never hang or kill the bench
+    (round 2 lost its measurement to `jax.devices()` raising through
+    `main()`; the tunnel has also been observed to block >120 s).
+    Returns (platform | None, error | None)."""
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print('PLAT=' + jax.devices()[0].platform)"],
+            capture_output=True, text=True, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return None, f"backend probe timed out after {timeout_s:.0f}s"
+    except Exception as e:                      # noqa: BLE001
+        return None, f"backend probe failed to launch: {e!r}"
+    for line in r.stdout.splitlines():
+        if line.startswith("PLAT="):
+            return line[5:].strip(), None
+    tail = (r.stderr or r.stdout).strip().splitlines()
+    return None, tail[-1][:300] if tail else f"probe rc={r.returncode}"
+
+
 def main() -> None:
+    # The judged line must print no matter what the backend does: probe
+    # first, fall back to CPU (smaller shapes) if the accelerator is
+    # unreachable, and never let one component's failure eat the rest.
+    platform, probe_err = _probe_backend()
+    fallback = platform is None or platform == "cpu"
+
     import jax
     import jax.numpy as jnp
 
-    dev = jax.devices()[0]
-    rate, uniform_detail = bench_scoring_uniform(jax, jnp)
-    sweep_detail = bench_gibbs_sweep(jax, jnp)
+    if platform is None:
+        # The ambient sitecustomize imports jax (and pins the
+        # accelerator platform) at interpreter startup, so the env var
+        # is already captured — the live config update is the only
+        # switch that still works here (same as tests/conftest.py).
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        jax.config.update("jax_platforms", "cpu")
+
+    detail = {"platform": platform or "cpu (fallback: backend unavailable)"}
+    if probe_err:
+        detail["backend_error"] = probe_err
+    try:
+        detail["device"] = str(jax.devices()[0])
+    except Exception as e:                      # noqa: BLE001
+        detail["device"] = f"unavailable: {e!r}"
+
+    rate = 0.0
+    errors = {}
+
+    def run(name, fn):
+        try:
+            return fn()
+        except Exception as e:                  # noqa: BLE001
+            errors[name] = repr(e)[:300]
+            return None
+
+    out = run("scoring_uniform",
+              lambda: bench_scoring_uniform(jax, jnp, small=fallback))
+    if out is not None:
+        rate, detail["scoring_uniform"] = out
+    detail["gibbs_sweep"] = run(
+        "gibbs_sweep", lambda: bench_gibbs_sweep(jax, jnp, small=fallback))
     # table strategy engages: D*V = 5.2e7 <= TABLE_MAX_ELEMS
-    zipf_table = bench_scoring_zipf(jax, jnp, 100_000, 512, "theta_phi_table")
+    detail["scoring_zipf_table"] = run(
+        "scoring_zipf_table",
+        lambda: bench_scoring_zipf(jax, jnp, 100_000, 512,
+                                   "theta_phi_table", small=fallback))
     # dedup strategy engages: D*V = 2.1e9 too big for a table
-    zipf_dedup = bench_scoring_zipf(jax, jnp, 1_000_000, 2_048, "pair_dedup")
+    detail["scoring_zipf_dedup"] = run(
+        "scoring_zipf_dedup",
+        lambda: bench_scoring_zipf(jax, jnp, 1_000_000, 2_048,
+                                   "pair_dedup", small=fallback))
+    if errors:
+        detail["errors"] = errors
+    if fallback:
+        detail["note"] = ("CPU fallback shapes — value is NOT the judged "
+                          "per-chip rate; see backend_error")
 
     print(json.dumps({
         "metric": "netflow_events_scored_per_sec_per_chip",
         "value": round(rate, 1),
         "unit": "events/s/chip",
         "vs_baseline": round(rate / BASELINE_EVENTS_PER_SEC_20NODE, 3),
-        "detail": {
-            "device": str(dev),
-            "scoring_uniform": uniform_detail,
-            "gibbs_sweep": sweep_detail,
-            "scoring_zipf_table": zipf_table,
-            "scoring_zipf_dedup": zipf_dedup,
-        },
+        "detail": detail,
     }))
 
 

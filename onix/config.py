@@ -108,6 +108,8 @@ class PipelineConfig:
     tol: float = 1.1            # score threshold: events with score < tol survive
     max_results: int = 2000     # top-N ascending by score emitted for OA
     dupfactor: int = 1000       # analyst-labeled rows duplicated x this in corpus
+    stream_max_docs: int = 0    # streaming doc-state bound (0 = unbounded):
+    #                             LRU-evict idle IPs past this population
 
     def validate(self) -> None:
         if self.datatype not in DATATYPES:
@@ -116,6 +118,8 @@ class PipelineConfig:
             raise ValueError("max_results must be >=1")
         if self.dupfactor < 1:
             raise ValueError("dupfactor must be >=1")
+        if self.stream_max_docs < 0:
+            raise ValueError("stream_max_docs must be >=0")
 
 
 @dataclass

@@ -255,6 +255,28 @@ def table_pair_bottom_k(
                           score_chunk, max_results=max_results, chunk=chunk)
 
 
+@functools.partial(jax.jit, static_argnames=("max_results", "chunk"))
+def table_bottom_k(
+    table_flat: jax.Array,   # float32 [D*V] from score_table().ravel()
+    idx: jax.Array,          # int32 [N] flat index d*V + w per event
+    *,
+    tol: float,
+    max_results: int,
+    chunk: int = 1 << 21,
+) -> TopK:
+    """Fused single-token scoring + selection, entirely on device: the
+    dns/proxy analog of `table_pair_bottom_k` (one document — the
+    client IP — per event, so score = one flat table gather). Only the
+    final [max_results] rows leave the device on the 10⁸⁺-event path."""
+
+    def score_chunk(ii):
+        s = table_flat[ii]
+        return jnp.where(s < tol, s, jnp.inf)
+
+    return _scan_bottom_k((idx,), idx.shape[0], score_chunk,
+                          max_results=max_results, chunk=chunk)
+
+
 # Dedup pays once the device scan shrinks enough to cover the host-side
 # np.unique sort; real telemetry is Zipf over (ip, word) pairs, so the
 # unique-pair count is typically a small fraction of the event count

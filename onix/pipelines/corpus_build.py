@@ -224,6 +224,14 @@ def _flow_pair_layout(bundle: CorpusBundle, n_events: int) -> bool:
             and np.array_equal(te[n_events:], te[:n_events]))
 
 
+def _single_token_layout(bundle: CorpusBundle, n_events: int) -> bool:
+    """True when token i IS event i — the dns/proxy layout (one client-IP
+    document per event)."""
+    te = bundle.token_event
+    return (te.shape[0] == n_events
+            and np.array_equal(te, np.arange(n_events)))
+
+
 def select_suspicious_events(bundle: CorpusBundle, theta, phi_wk,
                              n_events: int, *, tol: float,
                              max_results: int):
@@ -245,13 +253,18 @@ def select_suspicious_events(bundle: CorpusBundle, theta, phi_wk,
     chains = theta_a.shape[0] if theta_a.ndim == 3 else 1
     corpus = bundle.corpus
     n_real = bundle.n_real_tokens
-    if (_flow_pair_layout(bundle, n_events)
-            and chains * n_docs * n_vocab <= scoring.TABLE_MAX_ELEMS):
+    table_fits = chains * n_docs * n_vocab <= scoring.TABLE_MAX_ELEMS
+    single = _single_token_layout(bundle, n_events)
+    if table_fits and (single or _flow_pair_layout(bundle, n_events)):
         table = scoring.score_table(jnp.asarray(theta),
                                     jnp.asarray(phi_wk)).ravel()
         d = corpus.doc_ids[:n_real]
         w = corpus.word_ids[:n_real]
         idx = d.astype(np.int64) * n_vocab + w
+        if single:
+            return scoring.table_bottom_k(
+                table, jnp.asarray(idx.astype(np.int32)),
+                tol=tol, max_results=max_results)
         return scoring.table_pair_bottom_k(
             table, jnp.asarray(idx[:n_events].astype(np.int32)),
             jnp.asarray(idx[n_events:].astype(np.int32)),

@@ -1,18 +1,20 @@
-"""Scale demonstration: a full synthetic flow day end-to-end at 10⁸+ rows.
+"""Scale demonstration: a full synthetic telemetry day end-to-end at 10⁸+ rows.
 
 BASELINE.json configs[3] is "1B-row synthetic netflow, 20 topics,
 multi-chip doc-sharded Gibbs, faster end-to-end than the 20-node MPI
 baseline" (the reference's own scale claim is "filter billion of events
-to a few thousands", README.md:42). This runner executes the WHOLE
-pipeline — columnar synthesis → packed word creation → integer corpus
-build → sharded Gibbs → scoring scan → bottom-k — with per-stage
-wall-clock recorded into a manifest artifact.
+to a few thousands", README.md:42); configs[1]/[2] are the DNS and
+proxy SuspiciousConnects paths, which this runner exercises at the same
+scale (`datatype=`). It executes the WHOLE pipeline — columnar
+synthesis → packed word creation → integer corpus build → sharded
+Gibbs → scoring scan → bottom-k — with per-stage wall-clock recorded
+into a manifest artifact.
 
-Every stage is the production code path: `flow_words_from_arrays` /
+Every stage is the production code path: `*_words_from_arrays` /
 `build_corpus` (zero per-row Python), `ShardedGibbsLDA` (the psum
-engine), `select_suspicious_events` (fused device score+pair-min+
-bottom-k — only the winners cross the device tunnel). Nothing here is
-a special-cased benchmark kernel.
+engine), `select_suspicious_events` (fused device score + pair-min /
+gather + bottom-k — only the winners cross the device tunnel). Nothing
+here is a special-cased benchmark kernel.
 """
 
 from __future__ import annotations
@@ -27,14 +29,39 @@ import numpy as np
 
 from onix.config import LDAConfig
 from onix.pipelines.corpus_build import build_corpus, select_suspicious_events
-from onix.pipelines.synth import synth_flow_day_arrays
-from onix.pipelines.words import flow_words_from_arrays
+from onix.pipelines.synth import SYNTH_ARRAYS
+from onix.pipelines.words import (dns_words_from_arrays,
+                                  flow_words_from_arrays,
+                                  proxy_words_from_arrays)
+
+_FLOW_COLS = ("sip_u32", "dip_u32", "sport", "dport", "proto_id", "hour",
+              "ibyt", "ipkt")
+_DNS_COLS = ("client_u32", "qname_codes", "qnames", "qtype", "rcode",
+             "frame_len", "hour")
+_PROXY_COLS = ("client_u32", "uri_codes", "uris", "host_codes", "hosts",
+               "ua_codes", "agents", "respcode", "hour")
+
+
+def _words_from_cols(datatype: str, cols: dict, edges: dict | None = None):
+    """Columnar word creation for any datatype — always the
+    *_words_from_arrays production path (zero per-row Python)."""
+    if datatype == "flow":
+        return flow_words_from_arrays(
+            **{k: cols[k] for k in _FLOW_COLS},
+            proto_classes=cols["proto_classes"], edges=edges)
+    if datatype == "dns":
+        return dns_words_from_arrays(
+            **{k: cols[k] for k in _DNS_COLS}, edges=edges)
+    if datatype == "proxy":
+        return proxy_words_from_arrays(
+            **{k: cols[k] for k in _PROXY_COLS}, edges=edges)
+    raise ValueError(f"unknown datatype {datatype!r}")
 
 
 def run_scale(n_events: int, n_hosts: int | None = None,
               n_anomalies: int | None = None, n_sweeps: int = 20,
               n_topics: int = 20, max_results: int = 3000, seed: int = 0,
-              train_events: int | None = None,
+              train_events: int | None = None, datatype: str = "flow",
               out_path: str | pathlib.Path | None = None) -> dict:
     """End-to-end scale run; returns (and optionally writes) the manifest.
 
@@ -75,15 +102,12 @@ def run_scale(n_events: int, n_hosts: int | None = None,
     t_all = time.monotonic()
 
     t = time.monotonic()
-    cols = synth_flow_day_arrays(train_events, n_hosts=n_hosts,
-                                 n_anomalies=n_anomalies, seed=seed)
+    cols = SYNTH_ARRAYS[datatype](train_events, n_hosts=n_hosts,
+                                  n_anomalies=n_anomalies, seed=seed)
     walls["synthesize"] = time.monotonic() - t
 
     t = time.monotonic()
-    wt = flow_words_from_arrays(
-        **{k: cols[k] for k in ("sip_u32", "dip_u32", "sport", "dport",
-                                "proto_id", "hour", "ibyt", "ipkt")},
-        proto_classes=cols["proto_classes"])
+    wt = _words_from_cols(datatype, cols)
     walls["word_creation"] = time.monotonic() - t
 
     t = time.monotonic()
@@ -119,13 +143,18 @@ def run_scale(n_events: int, n_hosts: int | None = None,
         top_idx, top_scores = _stream_score(
             bundle, wt.edges, theta, phi_wk, n_events=n_events,
             chunk_events=train_events, n_hosts=n_hosts, seed=seed,
-            max_results=max_results, planted=planted, walls=walls)
+            max_results=max_results, planted=planted, walls=walls,
+            datatype=datatype)
 
     walls["total"] = time.monotonic() - t_all
     hits = len(planted & set(top_idx[top_idx >= 0].tolist()))
     finite = top_scores[np.isfinite(top_scores)]
+    cfg_of = {"flow": "configs[3] (synthetic flow day)",
+              "dns": "configs[1] at scale (synthetic dns day)",
+              "proxy": "configs[2] at scale (synthetic proxy day)"}
     manifest = {
-        "config": "BASELINE configs[3] scale demo (synthetic flow day)",
+        "config": f"BASELINE {cfg_of[datatype]}",
+        "datatype": datatype,
         "n_events": n_events,
         "train_events": train_events,
         "n_hosts": n_hosts,
@@ -178,7 +207,8 @@ def extend_model_for_unseen(theta, phi_wk):
 
 def _stream_score(bundle, fitted_edges, theta, phi_wk, *, n_events: int,
                   chunk_events: int, n_hosts: int, seed: int,
-                  max_results: int, planted: set, walls: dict):
+                  max_results: int, planted: set, walls: dict,
+                  datatype: str = "flow"):
     """Stream the FULL day through the fused device scorer in
     chunk_events-sized pieces against a model fitted on chunk 0.
 
@@ -230,16 +260,11 @@ def _stream_score(bundle, fitted_edges, theta, phi_wk, *, n_events: int,
             idx = (d_ids.astype(np.int32) * np.int32(v_x)
                    + w_ids.astype(np.int32))
         else:
-            cols = synth_flow_day_arrays(
+            cols = SYNTH_ARRAYS[datatype](
                 m, n_hosts=n_hosts, n_anomalies=anomalies_per_chunk,
                 seed=seed + 1000 * c)
             planted.update((cols["anomaly_idx"] + offset).tolist())
-            wt = flow_words_from_arrays(
-                **{kk: cols[kk] for kk in ("sip_u32", "dip_u32", "sport",
-                                           "dport", "proto_id", "hour",
-                                           "ibyt", "ipkt")},
-                proto_classes=cols["proto_classes"],
-                edges=fitted_edges)
+            wt = _words_from_cols(datatype, cols, edges=fitted_edges)
             del cols
             # Map packed keys / IPs into the TRAINED id spaces with one
             # searchsorted per column against the bundle's tiny sorted
@@ -254,9 +279,13 @@ def _stream_score(bundle, fitted_edges, theta, phi_wk, *, n_events: int,
         walls["stream_synth_words"] += time.monotonic() - t
 
         t = time.monotonic()
-        top = scoring.table_pair_bottom_k(
-            table, jnp.asarray(idx[:m]), jnp.asarray(idx[m:]),
-            tol=1.0, max_results=max_results)
+        if datatype == "flow":   # [src|dst] halves: fused pair-min path
+            top = scoring.table_pair_bottom_k(
+                table, jnp.asarray(idx[:m]), jnp.asarray(idx[m:]),
+                tol=1.0, max_results=max_results)
+        else:                    # one client-IP token per event
+            top = scoring.table_bottom_k(
+                table, jnp.asarray(idx), tol=1.0, max_results=max_results)
         ti = np.asarray(top.indices)
         ts = np.asarray(top.scores)
         keep = ti >= 0
@@ -281,7 +310,9 @@ def main(argv: list[str] | None = None) -> int:
     import argparse
 
     ap = argparse.ArgumentParser(
-        description="onix scale demo — end-to-end synthetic flow day")
+        description="onix scale demo — end-to-end synthetic telemetry day")
+    ap.add_argument("--datatype", choices=("flow", "dns", "proxy"),
+                    default="flow")
     ap.add_argument("--events", type=float, default=1e8)
     ap.add_argument("--hosts", type=int, default=None)
     ap.add_argument("--sweeps", type=int, default=20)
@@ -295,7 +326,7 @@ def main(argv: list[str] | None = None) -> int:
                   n_sweeps=args.sweeps, seed=args.seed,
                   train_events=(None if args.train_events is None
                                 else int(args.train_events)),
-                  out_path=args.out)
+                  datatype=args.datatype, out_path=args.out)
     print(json.dumps(m, indent=2))
     return 0
 

@@ -283,14 +283,7 @@ def synth_dns_day(n_events: int = 20000, n_hosts: int = 120,
     frame_len = (80 + 1.2 * np.char.str_len(qname.astype(str))
                  + rng.integers(0, 12, n_bg)).astype(np.int32)
 
-    alphabet = list("abcdefghijklmnopqrstuvwxyz0123456789")
-
-    def dga():
-        n = rng.integers(18, 40)
-        return "".join(rng.choice(alphabet, n)) + "." + \
-            rng.choice(["biz", "info", "notld", "xy"])
-
-    a_qname = np.array([dga() for _ in range(n_anomalies)], dtype=object)
+    a_qname = _dga_names(rng, n_anomalies)
     a_hour = rng.uniform(0, 6, n_anomalies)
     a_qtype = rng.choice([16, 10, 255], n_anomalies).astype(np.int32)  # TXT/NULL/ANY
     a_rcode = rng.choice([0, 3], n_anomalies).astype(np.int32)
@@ -308,6 +301,98 @@ def synth_dns_day(n_events: int = 20000, n_hosts: int = 120,
         "dns_qry_rcode": col(rcode, a_rcode),
     })
     return _shuffle(table, n_bg, n_events, rng)
+
+
+def _dga_names(rng: np.random.Generator, n: int) -> np.ndarray:
+    """DGA/tunnel-shaped names: long high-entropy random labels under
+    junk TLDs — each one its own campaign (heterogeneous in word space,
+    same rationale as the flow anomaly recipe)."""
+    alphabet = np.array(list("abcdefghijklmnopqrstuvwxyz0123456789"))
+    tlds = np.array(["biz", "info", "notld", "xy"], dtype=object)
+    lens = rng.integers(18, 40, n)
+    return np.array(
+        ["".join(rng.choice(alphabet, m)) + "." + tlds[rng.integers(0, 4)]
+         for m in lens], dtype=object)
+
+
+def synth_dns_day_arrays(n_events: int, n_hosts: int = 100_000,
+                         n_anomalies: int | None = None, seed: int = 0,
+                         chunk: int = 10_000_000) -> dict:
+    """Columnar DNS day for the 10⁸-row configs[1] path: same
+    role-mixture background and DGA-shaped anomalies as `synth_dns_day`
+    but DICTIONARY-ENCODED — `qnames` is the unique name table (profile
+    pool + one DGA name per anomaly, tiny vs rows), `qname_codes` the
+    per-row index, everything else numeric. Rows are background-first,
+    anomalies last (`anomaly_idx` says where), matching
+    synth_flow_day_arrays' contract."""
+    if n_anomalies is None:
+        n_anomalies = max(30, n_events // 10_000)
+    n_anomalies = min(n_anomalies, n_events)
+    rng = np.random.default_rng(seed)
+    n_prof = len(_DNS_PROFILES)
+    mix_cum = _host_mixture(rng, n_hosts, n_prof).cumsum(axis=1).astype(np.float32)
+
+    # Flattened unique background name table: per profile, subs x doms.
+    names: list[str] = []
+    prof_name_lo = np.zeros(n_prof + 1, np.int64)
+    prof_qts: list[np.ndarray] = []
+    for p, (doms, subs, qts, _mu, _sd) in enumerate(_DNS_PROFILES):
+        for s in subs:
+            for d in doms:
+                names.append(f"{s}.{d}" if s else d)
+        prof_name_lo[p + 1] = len(names)
+        prof_qts.append(np.asarray(qts, np.int64))
+    peak_of = np.array([p[3] for p in _DNS_PROFILES], np.float32)
+    hsd_of = np.array([p[4] for p in _DNS_PROFILES], np.float32)
+    n_names_of = np.diff(prof_name_lo)
+    # Per-profile qtype pools ragged -> rectangular for vectorized draw.
+    qt_w = max(len(q) for q in prof_qts)
+    qt_table = np.stack([np.pad(q, (0, qt_w - len(q)), mode="edge")
+                         for q in prof_qts])
+    qt_n = np.array([len(q) for q in prof_qts], np.int64)
+
+    host_base = np.uint32(10 << 24)
+    n_bg = n_events - n_anomalies
+    out = {
+        "client_u32": np.empty(n_events, np.uint32),
+        "qname_codes": np.empty(n_events, np.int64),
+        "qtype": np.empty(n_events, np.int32),
+        "rcode": np.empty(n_events, np.int32),
+        "frame_len": np.empty(n_events, np.int32),
+        "hour": np.empty(n_events, np.float32),
+    }
+    uniq_len = np.fromiter((len(s) for s in names), np.int64, len(names))
+    for lo in range(0, n_bg, chunk):
+        hi = min(lo + chunk, n_bg)
+        m = hi - lo
+        h_idx = rng.integers(0, n_hosts, m)
+        u = rng.random(m, np.float32)
+        prof = np.clip((mix_cum[h_idx] < u[:, None]).sum(axis=1),
+                       0, n_prof - 1)
+        codes = prof_name_lo[prof] + rng.integers(0, n_names_of[prof])
+        out["client_u32"][lo:hi] = host_base + h_idx.astype(np.uint32)
+        out["qname_codes"][lo:hi] = codes
+        out["qtype"][lo:hi] = qt_table[prof, rng.integers(0, qt_n[prof])]
+        out["rcode"][lo:hi] = 0
+        out["frame_len"][lo:hi] = (80 + 1.2 * uniq_len[codes]
+                                   + rng.integers(0, 12, m)).astype(np.int32)
+        out["hour"][lo:hi] = np.clip(
+            rng.normal(peak_of[prof], hsd_of[prof]), 0, 23.99)
+
+    a = slice(n_bg, n_events)
+    a_names = _dga_names(rng, n_anomalies)
+    a_len = np.fromiter((len(s) for s in a_names), np.int64, n_anomalies)
+    out["client_u32"][a] = host_base + rng.integers(
+        0, n_hosts, n_anomalies).astype(np.uint32)
+    out["qname_codes"][a] = len(names) + np.arange(n_anomalies)
+    out["qtype"][a] = rng.choice([16, 10, 255], n_anomalies)  # TXT/NULL/ANY
+    out["rcode"][a] = rng.choice([0, 3], n_anomalies)
+    out["frame_len"][a] = (120 + 4 * a_len).astype(np.int32)
+    out["hour"][a] = rng.uniform(0, 6, n_anomalies)
+    out["qnames"] = np.concatenate(
+        [np.asarray(names, dtype=object), a_names])
+    out["anomaly_idx"] = np.arange(n_bg, n_events, dtype=np.int64)
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -412,4 +497,92 @@ def synth_proxy_day(n_events: int = 20000, n_hosts: int = 120,
     return _shuffle(table, n_bg, n_events, rng)
 
 
+def synth_proxy_day_arrays(n_events: int, n_hosts: int = 100_000,
+                           n_anomalies: int | None = None, seed: int = 0,
+                           chunk: int = 10_000_000) -> dict:
+    """Columnar proxy day for the 10⁸-row configs[2] path:
+    dictionary-encoded `uris`/`hosts`/`agents` unique tables with
+    per-row codes, background-first/anomalies-last like the flow and
+    DNS array generators."""
+    if n_anomalies is None:
+        n_anomalies = max(30, n_events // 10_000)
+    n_anomalies = min(n_anomalies, n_events)
+    rng = np.random.default_rng(seed)
+    n_prof = len(_PROXY_PROFILES)
+    mix_cum = _host_mixture(rng, n_hosts, n_prof).cumsum(axis=1).astype(np.float32)
+
+    uris: list[str] = []
+    hosts: list[str] = []
+    uri_lo = np.zeros(n_prof + 1, np.int64)
+    host_lo = np.zeros(n_prof + 1, np.int64)
+    for p, (sites, paths, _ct, _mu) in enumerate(_PROXY_PROFILES):
+        uris.extend(paths)
+        hosts.extend(sites)
+        uri_lo[p + 1] = len(uris)
+        host_lo[p + 1] = len(hosts)
+    peak_of = np.array([p[3] for p in _PROXY_PROFILES], np.float32)
+    n_uris_of = np.diff(uri_lo)
+    n_hosts_of = np.diff(host_lo)
+
+    host_base = np.uint32(10 << 24)
+    n_bg = n_events - n_anomalies
+    out = {
+        "client_u32": np.empty(n_events, np.uint32),
+        "uri_codes": np.empty(n_events, np.int64),
+        "host_codes": np.empty(n_events, np.int64),
+        "ua_codes": np.empty(n_events, np.int64),
+        "respcode": np.empty(n_events, np.int32),
+        "hour": np.empty(n_events, np.float32),
+    }
+    for lo in range(0, n_bg, chunk):
+        hi = min(lo + chunk, n_bg)
+        m = hi - lo
+        h_idx = rng.integers(0, n_hosts, m)
+        u = rng.random(m, np.float32)
+        prof = np.clip((mix_cum[h_idx] < u[:, None]).sum(axis=1),
+                       0, n_prof - 1)
+        out["client_u32"][lo:hi] = host_base + h_idx.astype(np.uint32)
+        out["uri_codes"][lo:hi] = uri_lo[prof] + rng.integers(0, n_uris_of[prof])
+        out["host_codes"][lo:hi] = host_lo[prof] + rng.integers(0, n_hosts_of[prof])
+        out["ua_codes"][lo:hi] = rng.integers(0, len(_AGENTS), m)
+        out["respcode"][lo:hi] = rng.choice(
+            np.array([200, 304, 404], np.int32), m, p=[.85, .1, .05])
+        out["hour"][lo:hi] = np.clip(rng.normal(peak_of[prof], 2.5), 0, 23.99)
+
+    # Anomaly campaigns: beaconing to raw-IP hosts with junk URIs and
+    # rare per-campaign agents — same recipe as synth_proxy_day.
+    junk_alpha = np.array(list("abcdefghijklmnopqrstuvwxyz0123456789%2F"))
+    camp_len = [(30, 60), (60, 120), (120, 400), (25, 45), (200, 400)]
+    camp = rng.integers(0, len(camp_len), n_anomalies)
+    a_uris = np.array(
+        ["/" + "".join(rng.choice(junk_alpha,
+                                  rng.integers(*camp_len[c])))
+         for c in camp], dtype=object)
+    a_hosts = np.array(
+        [f"198.51.{rng.integers(0, 100)}.{rng.integers(1, 255)}"
+         for _ in range(n_anomalies)], dtype=object)
+    a_agents_u, a_ua_codes = np.unique(np.array(
+        [f"tool{c}/{rng.integers(1, 9)}.{rng.integers(0, 9)}"
+         for c in camp], dtype=object), return_inverse=True)
+
+    a = slice(n_bg, n_events)
+    out["client_u32"][a] = host_base + rng.integers(
+        0, n_hosts, n_anomalies).astype(np.uint32)
+    out["uri_codes"][a] = len(uris) + np.arange(n_anomalies)
+    out["host_codes"][a] = len(hosts) + np.arange(n_anomalies)
+    out["ua_codes"][a] = len(_AGENTS) + a_ua_codes
+    out["respcode"][a] = rng.choice(np.array([200, 503], np.int32),
+                                    n_anomalies)
+    out["hour"][a] = np.clip(camp * 1.7 + rng.uniform(0, 1.5, n_anomalies),
+                             0, 23.99)
+    out["uris"] = np.concatenate([np.asarray(uris, dtype=object), a_uris])
+    out["hosts"] = np.concatenate([np.asarray(hosts, dtype=object), a_hosts])
+    out["agents"] = np.concatenate(
+        [np.asarray(list(_AGENTS), dtype=object), a_agents_u])
+    out["anomaly_idx"] = np.arange(n_bg, n_events, dtype=np.int64)
+    return out
+
+
 SYNTH = {"flow": synth_flow_day, "dns": synth_dns_day, "proxy": synth_proxy_day}
+SYNTH_ARRAYS = {"flow": synth_flow_day_arrays, "dns": synth_dns_day_arrays,
+                "proxy": synth_proxy_day_arrays}

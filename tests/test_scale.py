@@ -93,3 +93,27 @@ def test_scale_streaming_unseen_score_at_prior_rarity():
     # Unseen word column is the per-row minimum for EVERY document,
     # including the unseen-document row.
     assert (table[:, 6] <= table[:, :6].min(axis=1) + 1e-9).all()
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("datatype", ["dns", "proxy"])
+def test_scale_datatypes(datatype, tmp_path):
+    """configs[1]/[2] at scale: the dns/proxy columnar pipeline runs
+    end-to-end (incl. the fused single-token device selection) and
+    surfaces the planted anomalies."""
+    m = run_scale(40_000, n_hosts=300, n_sweeps=6, datatype=datatype,
+                  out_path=tmp_path / "scale.json")
+    assert m["datatype"] == datatype
+    assert m["planted_in_bottom_k"] >= 0.8 * m["planted_anomalies"]
+    assert (tmp_path / "scale.json").exists()
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("datatype", ["dns", "proxy"])
+def test_scale_streaming_datatypes(datatype):
+    """Streaming mode for dns/proxy: train on a prefix, stream-score the
+    full day through table_bottom_k (single-token layout)."""
+    m = run_scale(90_000, train_events=45_000, n_hosts=300, n_sweeps=6,
+                  datatype=datatype)
+    assert m["walls_seconds"]["stream_score"] > 0
+    assert m["planted_in_bottom_k"] >= 0.7 * m["planted_anomalies"]

@@ -204,3 +204,86 @@ def test_run_stream_resume_skips_processed_files(tmp_path):
                             / "stream")
     # 2 from the first run + only the 1 unseen file from the second.
     assert final._batch_no == 3
+
+
+def test_doc_table_bulk_load_million_keys():
+    """Vectorized restore: a 10⁶-IP doc table loads in one bulk pass
+    (round 2 replayed checkpointed IPs one np.unique call at a time)."""
+    import time
+
+    keys = [f"10.{i >> 16 & 255}.{i >> 8 & 255}.{i & 255}"
+            for i in range(1_000_000)]
+    dt = DocTable()
+    t0 = time.perf_counter()
+    dt.load(keys)
+    elapsed = time.perf_counter() - t0
+    assert dt.n_docs == 1_000_000
+    assert elapsed < 5.0            # bulk, not per-key replay
+    # Existing keys resolve to their loaded ids, new keys append.
+    out = dt.ids(np.array(["10.0.0.5", "99.9.9.9"], dtype=object))
+    assert out[0] == 5 and out[1] == 1_000_000
+
+
+def test_streaming_eviction_bounds_docs_and_checkpoint(tmp_path):
+    """A stream that sees an unbounded IP population keeps per-doc state
+    (and checkpoint size) bounded by max_docs, evicting least-recently-
+    seen docs; docs hot in the latest batches survive."""
+    cfg = _cfg(checkpoint_every=1)
+    sc = StreamingScorer(cfg, "flow", n_buckets=1 << 12,
+                         checkpoint_dir=tmp_path / "ck", max_docs=600)
+    for b in range(6):
+        # Every batch brings ~400 fresh client IPs (disjoint /16s) plus
+        # a stable set of servers.
+        table, _ = synth_flow_day(n_events=800, n_hosts=200, n_anomalies=4,
+                                  seed=b)
+        table = table.copy()
+        table["sip"] = [f"10.{b}.{i % 200}.{i // 200}"
+                        for i in range(len(table))]
+        sc.process(table)
+    assert sc.docs.n_docs <= 600
+    assert sc._gamma.shape[0] <= 1024          # pow2 cap over max_docs
+    assert sc._last_seen.shape[0] == sc._gamma.shape[0]
+    # The latest batch's client IPs survived eviction (membership check
+    # — ids() would insert a missing key and mask the failure).
+    assert "10.5.0.0" in sc.docs.keys
+    # Checkpoint carries columnar doc state trimmed to n_docs, no JSON
+    # doc_keys blob.
+    import json
+
+    ck_dir = next((tmp_path / "ck").iterdir())
+    js = sorted(ck_dir.glob("ckpt-*.json"))[-1]
+    meta = json.loads(js.read_text())
+    assert "doc_keys" not in meta
+    with np.load(js.with_suffix(".npz")) as z:
+        assert z["doc_keys"].shape[0] == sc.docs.n_docs == z["gamma"].shape[0]
+        assert z["last_seen"].shape[0] == sc.docs.n_docs
+
+
+def test_streaming_checkpoint_restore_after_eviction(tmp_path):
+    """Resume after eviction: restored table, gamma, and last_seen stay
+    id-aligned and scoring continues identically to an uninterrupted
+    run."""
+    cfg = _cfg(checkpoint_every=1)
+
+    def feed(sc, n):
+        outs = []
+        for b in range(n):
+            table, _ = synth_flow_day(n_events=400, n_hosts=150,
+                                      n_anomalies=4, seed=10 + b)
+            outs.append(sc.process(table).scores)
+        return outs
+
+    ref = StreamingScorer(cfg, "flow", n_buckets=1 << 12, max_docs=120)
+    r_all = feed(ref, 4)
+
+    a = StreamingScorer(cfg, "flow", n_buckets=1 << 12,
+                        checkpoint_dir=tmp_path / "ck", max_docs=120)
+    feed(a, 3)
+    b = StreamingScorer(cfg, "flow", n_buckets=1 << 12,
+                        checkpoint_dir=tmp_path / "ck", max_docs=120)
+    assert b._batch_no == 3
+    assert b.docs.keys == a.docs.keys
+    table, _ = synth_flow_day(n_events=400, n_hosts=150, n_anomalies=4,
+                              seed=13)
+    np.testing.assert_allclose(b.process(table).scores, r_all[3],
+                               rtol=1e-5)

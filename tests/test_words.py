@@ -205,3 +205,89 @@ def test_synth_days_word_pipeline(datatype):
     bundle = build_corpus(wt)
     assert bundle.corpus.n_vocab > 10
     assert bundle.corpus.n_docs > 10
+
+
+def test_dns_words_numeric_path_equivalent():
+    """dns_words_from_arrays (the 10⁸-row dictionary-encoded path) must
+    build the exact same corpus as the string path on the same data."""
+    from onix.ingest.nfdecode import str_to_ip
+    from onix.pipelines.synth import synth_dns_day_arrays, _times, DEMO_DATE
+    from onix.pipelines.words import dns_words_from_arrays
+    from onix.store import hour_of
+
+    cols = synth_dns_day_arrays(3000, n_hosts=200, n_anomalies=15, seed=7)
+    # Same event rows rendered as the tshark-style string table; hour
+    # goes through the same minute-truncating render both ways so the
+    # two paths see identical values.
+    times = _times(DEMO_DATE, cols["hour"].astype(np.float64))
+    hour = hour_of(pd.Series(times))
+    table = pd.DataFrame({
+        "frame_time": times,
+        "frame_len": cols["frame_len"],
+        "ip_dst": np.array([f"10.{(v >> 16) & 255}.{(v >> 8) & 255}.{v & 255}"
+                            for v in cols["client_u32"]], dtype=object),
+        "dns_qry_name": cols["qnames"][cols["qname_codes"]],
+        "dns_qry_type": cols["qtype"],
+        "dns_qry_rcode": cols["rcode"],
+    })
+    ref = build_corpus(dns_words(table))
+    got = build_corpus(dns_words_from_arrays(
+        client_u32=str_to_ip(table["ip_dst"].astype(str)),
+        qname_codes=cols["qname_codes"], qnames=cols["qnames"],
+        qtype=cols["qtype"], rcode=cols["rcode"],
+        frame_len=cols["frame_len"], hour=hour))
+    np.testing.assert_array_equal(ref.vocab.words, got.vocab.words)
+    np.testing.assert_array_equal(ref.doc_keys, got.doc_keys)
+    np.testing.assert_array_equal(ref.corpus.doc_ids, got.corpus.doc_ids)
+    np.testing.assert_array_equal(ref.corpus.word_ids, got.corpus.word_ids)
+
+
+def test_proxy_words_numeric_path_equivalent():
+    """proxy_words_from_arrays must build the exact same corpus as the
+    string path on the same data (incl. the row-count-weighted
+    user-agent commonness fit)."""
+    from onix.ingest.nfdecode import str_to_ip
+    from onix.pipelines.synth import (DEMO_DATE, _times,
+                                      synth_proxy_day_arrays)
+    from onix.pipelines.words import proxy_words_from_arrays
+    from onix.store import hour_of
+
+    cols = synth_proxy_day_arrays(3000, n_hosts=200, n_anomalies=15, seed=8)
+    times = _times(DEMO_DATE, cols["hour"].astype(np.float64))
+    hour = hour_of(pd.Series(times))
+    clientip = np.array([f"10.{(v >> 16) & 255}.{(v >> 8) & 255}.{v & 255}"
+                         for v in cols["client_u32"]], dtype=object)
+    table = pd.DataFrame({
+        "p_date": np.full(3000, DEMO_DATE),
+        "p_time": [t.split(" ")[1] for t in times],
+        "clientip": clientip,
+        "host": cols["hosts"][cols["host_codes"]],
+        "useragent": cols["agents"][cols["ua_codes"]],
+        "respcode": cols["respcode"],
+        "uripath": cols["uris"][cols["uri_codes"]],
+    })
+    ref = build_corpus(proxy_words(table))
+    got = build_corpus(proxy_words_from_arrays(
+        client_u32=str_to_ip(table["clientip"].astype(str)),
+        uri_codes=cols["uri_codes"], uris=cols["uris"],
+        host_codes=cols["host_codes"], hosts=cols["hosts"],
+        ua_codes=cols["ua_codes"], agents=cols["agents"],
+        respcode=cols["respcode"], hour=hour))
+    np.testing.assert_array_equal(ref.vocab.words, got.vocab.words)
+    np.testing.assert_array_equal(ref.doc_keys, got.doc_keys)
+    np.testing.assert_array_equal(ref.corpus.doc_ids, got.corpus.doc_ids)
+    np.testing.assert_array_equal(ref.corpus.word_ids, got.corpus.word_ids)
+
+
+@pytest.mark.parametrize("datatype", ["dns", "proxy"])
+def test_synth_arrays_generators_scale_shape(datatype):
+    """The columnar dns/proxy generators: unique tables stay tiny vs
+    rows, codes index them, anomalies land at the tail."""
+    gen = synth.SYNTH_ARRAYS[datatype]
+    cols = gen(50_000, n_hosts=500, n_anomalies=25, seed=2)
+    uniq_key = {"dns": "qnames", "proxy": "uris"}[datatype]
+    code_key = {"dns": "qname_codes", "proxy": "uri_codes"}[datatype]
+    assert len(cols[uniq_key]) < 5_000
+    assert cols[code_key].max() < len(cols[uniq_key])
+    assert cols["client_u32"].shape == (50_000,)
+    assert cols["anomaly_idx"].tolist() == list(range(50_000 - 25, 50_000))
