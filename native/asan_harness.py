@@ -109,6 +109,37 @@ def ipfix_blob(long_varlen=False, strip_template=False):
     return hdr + sets
 
 
+def nfcapd_blob(compressed=False, bad_version=False, torn=False,
+                v6_row=False, huge_record_size=False):
+    """Minimal nfcapd layout-v1 file: header, stat record, one type-2
+    block with an extension-map record + two common records."""
+    def common(flags, sport):
+        body = struct.pack("<HHHHIIBBBBHH", flags, 0, 100, 200,
+                           1467979200, 1467979260, 0, 0x18, 6, 0,
+                           sport, 443)
+        if flags & 0x1:
+            body += b"\x20\x01" + b"\x00" * 14 + b"\x20\x02" + b"\x00" * 14
+        else:
+            body += struct.pack("<II", 0x0A000001, 0x0A000002)
+        body += struct.pack("<Q" if flags & 0x2 else "<I", 12)
+        body += struct.pack("<Q" if flags & 0x4 else "<I", 3400)
+        return struct.pack("<HH", 1, 4 + len(body)) + body
+
+    ext_map = struct.pack("<HHHH", 2, 12, 0, 4) + struct.pack("<HH", 4, 0)
+    recs = [ext_map, common(0, 1025), common(0x2 | 0x4, 2048)]
+    if v6_row:
+        recs.append(common(0x1, 53))
+    if huge_record_size:
+        recs.append(struct.pack("<HH", 1, 60000))   # size past block end
+    payload = b"".join(recs)
+    block = struct.pack("<IIHH", len(recs), len(payload), 2, 0) + payload
+    hdr = struct.pack("<HHII", 0xA50C, 7 if bad_version else 1,
+                      0x1 if compressed else 0, 1)
+    hdr += b"asan".ljust(128, b"\0")
+    out = hdr + struct.pack("<Q", 2) + b"\0" * 128 + block
+    return out[:len(out) - 9] if torn else out
+
+
 def dns_pcap_blob(truncate=0, ipv6=False, ext_headers=False):
     """One-response DNS pcap (Ethernet/IPv4 or /IPv6/UDP), optionally
     torn; ext_headers prepends a hop-by-hop extension header to the v6
@@ -190,6 +221,15 @@ def main() -> int:
         ("ipfix unknown template skipped", ipfix_blob(strip_template=True), 0),
         ("ipfix truncated", ipfix_blob()[:-5], 1),
         ("mixed v5+v9+ipfix", v5_blob() + v9_blob() + ipfix_blob(), 0),
+        # nfcapd container (clean-room reader): happy, v6-skip,
+        # compressed gate, torn block, bad version, lying record size
+        ("nfcapd v1 happy path", nfcapd_blob(), 0),
+        ("nfcapd v1 with ipv6 row", nfcapd_blob(v6_row=True), 0),
+        ("nfcapd compressed flag", nfcapd_blob(compressed=True), 1),
+        ("nfcapd torn block", nfcapd_blob(torn=True), 1),
+        ("nfcapd bad layout version", nfcapd_blob(bad_version=True), 1),
+        ("nfcapd record size past block end",
+         nfcapd_blob(huge_record_size=True), 1),
     ]:
         p = tmp / "cap.bin"
         p.write_bytes(blob)

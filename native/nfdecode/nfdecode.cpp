@@ -85,7 +85,22 @@ enum V9Field : uint16_t {
   kLastSwitched = 21,
   kFirstSwitched = 22,
   kSamplingInterval = 34,  // options-record field: exporter sample rate
+  // Sampler-table announcements (the other common way exporters state
+  // their rate): v9 FLOW_SAMPLER_RANDOM_INTERVAL / IPFIX
+  // samplerRandomInterval share id 50; IPFIX samplingPacketInterval is
+  // 305. Fields 48 (sampler id) and 49 (sampler mode) carry no
+  // interval and are deliberately not announcement triggers.
+  kSamplerRandomInterval = 50,
+  kSamplingPacketInterval = 305,
 };
+
+// True for any options-record field that announces a 1-in-N sampling
+// interval — field 34 alone missed sampler-table exporters, which then
+// silently stayed unscaled under apply_sampling (ADVICE r2).
+inline bool is_sampling_announce(uint16_t type) {
+  return type == kSamplingInterval || type == kSamplerRandomInterval ||
+         type == kSamplingPacketInterval;
+}
 
 // Exporter metadata extracted from options records (RFC 3954 §6.1 /
 // RFC 7011 §3.4.2.2). Options data carries exporter state, not flows —
@@ -99,8 +114,12 @@ struct StreamMeta {
   uint32_t sampling_interval = 0;  // last announced by ANY exporter
   std::map<uint64_t, uint32_t> by_exporter;
   bool apply = false;              // scale counters at decode time
+  bool first_wins = false;         // pre-scan mode: keep each exporter's
+  //                                  FIRST announcement (the best guess
+  //                                  for flows ahead of it in-stream)
   void announce(uint64_t exporter_key, uint32_t interval) {
     sampling_interval = interval;
+    if (first_wins && by_exporter.count(exporter_key)) return;
     by_exporter[exporter_key] = interval;
   }
   uint32_t factor(uint64_t exporter_key) const {
@@ -248,7 +267,7 @@ bool parse_v9_packet(const uint8_t* p, size_t pkt_len, V9Templates* tpls,
         for (size_t r = 0; r < n_rec; ++r) {
           const uint8_t* rec = body + r * tpl.record_len;
           for (const V9FieldSpec& f : tpl.fields) {
-            if (f.type == kSamplingInterval && meta)
+            if (is_sampling_announce(f.type) && meta)
               meta->announce(kV9ExporterTag | source_id,
                              (uint32_t)beN(rec + f.offset, f.len));
           }
@@ -437,6 +456,8 @@ bool parse_ipfix_packet(const uint8_t* p, size_t pkt_len,
               const uint64_t v = beN(body + r, (uint16_t)flen);
               switch (f.type) {
                 case kSamplingInterval:
+                case kSamplerRandomInterval:
+                case kSamplingPacketInterval:
                   if (tpl.options && meta)
                     meta->announce(kIpfixExporterTag | domain_id,
                                    (uint32_t)v);
@@ -626,36 +647,46 @@ int64_t nfx_count(const uint8_t* buf, int64_t len) {
 // field / IPFIX IE 34): 0 when no options record announced a rate, -1
 // on malformed framing. This is a stream-level summary; actual counter
 // scaling is per exporter via nfx_decode_scaled.
-int64_t nfx_sampling(const uint8_t* buf, int64_t len) {
-  if (!buf || len < 0) return -1;
+// Shared walk: parse every packet, feed options records into `meta`,
+// drop the flows. Used by nfx_sampling and as the sampling PRE-SCAN of
+// nfx_decode_scaled. Defined outside the anonymous namespace's sinks so
+// both entry points stay one-pass-each over the buffer.
+static bool scan_sampling_meta(const uint8_t* buf, int64_t len,
+                               StreamMeta* meta) {
   size_t off = 0;
   V9Templates tpls;
   IpfixTemplates itpls;
-  StreamMeta meta;
   auto drop_sink = [](const V9Record&, double, double) { return true; };
   while (off < (size_t)len) {
     const uint16_t ver = ((size_t)len - off >= 2) ? be16(buf + off) : 0;
     if (ver == kVersion) {
       PacketView pv;
       const size_t used = parse_header(buf + off, (size_t)len - off, &pv);
-      if (used == 0) return -1;
+      if (used == 0) return false;
       off += used;   // v5 has no options records
     } else if (ver == kV9Version) {
       const size_t used = v9_packet_extent(buf + off, (size_t)len - off);
-      if (used == 0) return -1;
-      if (!parse_v9_packet(buf + off, used, &tpls, &meta, drop_sink))
-        return -1;
+      if (used == 0) return false;
+      if (!parse_v9_packet(buf + off, used, &tpls, meta, drop_sink))
+        return false;
       off += used;
     } else if (ver == kIpfixVersion) {
       const size_t used = ipfix_packet_extent(buf + off, (size_t)len - off);
-      if (used == 0) return -1;
-      if (!parse_ipfix_packet(buf + off, used, &itpls, &meta, drop_sink))
-        return -1;
+      if (used == 0) return false;
+      if (!parse_ipfix_packet(buf + off, used, &itpls, meta, drop_sink))
+        return false;
       off += used;
     } else {
-      return -1;
+      return false;
     }
   }
+  return true;
+}
+
+int64_t nfx_sampling(const uint8_t* buf, int64_t len) {
+  if (!buf || len < 0) return -1;
+  StreamMeta meta;
+  if (!scan_sampling_meta(buf, len, &meta)) return -1;
   return (int64_t)meta.sampling_interval;
 }
 
@@ -681,6 +712,21 @@ static int64_t nfx_decode_impl(const uint8_t* buf, int64_t len, int64_t n,
   IpfixTemplates itpls;
   StreamMeta meta;
   meta.apply = apply_sampling;
+  if (apply_sampling) {
+    // Pre-scan the whole stream for sampling announcements so flows
+    // decoded BEFORE an exporter's (periodically refreshed) options
+    // record still scale — single-pass decoding left everything ahead
+    // of a mid-file announcement at raw wire counters (ADVICE r2).
+    // The pre-scan seeds each exporter's FIRST announced interval (the
+    // best guess for flows ahead of it); in-stream announcements then
+    // override in order, so a genuine mid-capture rate change still
+    // applies from its announcement on.
+    StreamMeta pre;
+    pre.first_wins = true;
+    if (!scan_sampling_meta(buf, len, &pre)) return -1;
+    meta.by_exporter = pre.by_exporter;
+    meta.sampling_interval = pre.sampling_interval;
+  }
   auto write_sink = [&](const V9Record& r, double t0, double t1) {
     if (i >= n) return false;
     sip[i] = r.sip;
@@ -753,6 +799,184 @@ int64_t nfx_decode_scaled(const uint8_t* buf, int64_t len, int64_t n,
 }  // extern "C"
 
 // ---------------------------------------------------------------------------
+// nfcapd v1 (nfdump's on-disk container; the reference's flow landing
+// format — SURVEY.md §2.1 #2, /root/reference/README.md:83). Clean-room
+// reader for the layout-version-1 structure, stable across nfdump
+// 1.6.x: little-endian file header (magic 0xA50C, version, flags,
+// block count, 128-byte ident), a 136-byte stat record, then data
+// blocks of {NumRecords, size, id, flags} headers framing typed
+// records. Flow rows are CommonRecordType(1): a 28-byte fixed head
+// (flags, ext-map id, msec_first/last, first/last seconds, fwd_status,
+// tcp_flags, proto, tos, ports) followed by the required extensions in
+// fixed order — addresses (v4 2x u32 / v6 2x 16B per flags bit 0),
+// packets (u32/u64 per bit 1), bytes (u32/u64 per bit 2) — and then
+// optional extensions this reader skips via the record's size field
+// (so unknown extension maps can never desync framing). Extension-map
+// (2), exporter (7/8) and sampler (9) records are skipped whole.
+//
+// Scope: UNCOMPRESSED little-endian files (nfcapd's default). The
+// compression flags (LZO/BZ2/LZ4) return kNfcapdCompressed so the
+// Python layer can fall back to an installed nfdump; a big-endian
+// writer's file returns kNfcapdByteOrder.
+
+namespace {
+
+constexpr uint16_t kNfcapdMagic = 0xA50C;
+constexpr size_t kNfcapdFileHeader = 140;  // magic..ident[128]
+constexpr size_t kNfcapdStatRecord = 136;
+constexpr size_t kNfcapdBlockHeader = 12;
+constexpr uint32_t kNfcapdCompressionFlags = 0x1 | 0x8 | 0x10;  // lzo|bz2|lz4
+constexpr uint16_t kCommonRecordType = 1;
+constexpr uint16_t kFlagIpv6Addr = 0x1;
+constexpr uint16_t kFlagPkts64 = 0x2;
+constexpr uint16_t kFlagBytes64 = 0x4;
+
+inline uint16_t le16(const uint8_t* p) { return (uint16_t)(p[0] | p[1] << 8); }
+inline uint32_t le32(const uint8_t* p) {
+  return (uint32_t)p[0] | (uint32_t)p[1] << 8 | (uint32_t)p[2] << 16 |
+         (uint32_t)p[3] << 24;
+}
+inline uint64_t le64(const uint8_t* p) {
+  return (uint64_t)le32(p) | ((uint64_t)le32(p + 4) << 32);
+}
+
+// Walk every common record; sink(rec, t0, t1) -> false aborts. Returns
+// 0 on success or a negative nfcapd_* error code.
+template <typename Sink>
+int64_t nfcapd_walk(const uint8_t* buf, int64_t len, Sink&& sink) {
+  if (!buf || len < (int64_t)(kNfcapdFileHeader + kNfcapdStatRecord))
+    return -1;
+  const uint16_t magic = le16(buf);
+  if (magic != kNfcapdMagic)
+    return be16(buf) == kNfcapdMagic ? -3 : -1;  // BE writer vs not nfcapd
+  const uint16_t version = le16(buf + 2);
+  if (version != 1) return -4;  // other layout (nfdump 1.7's v2): the
+  //                               caller can try an installed nfdump
+  const uint32_t flags = le32(buf + 4);
+  if (flags & kNfcapdCompressionFlags) return -2;
+  const uint32_t n_blocks = le32(buf + 8);
+  size_t off = kNfcapdFileHeader + kNfcapdStatRecord;
+  for (uint32_t b = 0; b < n_blocks; ++b) {
+    if (off + kNfcapdBlockHeader > (size_t)len) return -1;
+    const uint32_t n_rec = le32(buf + off);
+    const uint32_t blk_size = le32(buf + off + 4);
+    const uint16_t blk_id = le16(buf + off + 8);
+    off += kNfcapdBlockHeader;
+    if (off + blk_size > (size_t)len) return -1;
+    if (blk_id != 2) {  // only DATA_BLOCK_TYPE_2 carries flow records
+      off += blk_size;
+      continue;
+    }
+    size_t r = off;
+    const size_t blk_end = off + blk_size;
+    for (uint32_t i = 0; i < n_rec; ++i) {
+      if (r + 4 > blk_end) return -1;
+      const uint16_t rtype = le16(buf + r);
+      const uint16_t rsize = le16(buf + r + 2);
+      if (rsize < 4 || r + rsize > blk_end) return -1;
+      if (rtype == kCommonRecordType) {
+        if (rsize < 28) return -1;
+        const uint8_t* c = buf + r;
+        const uint16_t rflags = le16(c + 4);
+        const uint16_t msec_first = le16(c + 8);
+        const uint16_t msec_last = le16(c + 10);
+        const uint32_t first = le32(c + 12);
+        const uint32_t last = le32(c + 16);
+        V9Record out;
+        out.tcp_flags = c[21];
+        out.proto = c[22];
+        out.sport = le16(c + 24);
+        out.dport = le16(c + 26);
+        size_t d = 28;  // required extensions follow the fixed head
+        bool skip = false;
+        if (rflags & kFlagIpv6Addr) {
+          // v6 flow: no u32 rendering in the flow schema — skip the
+          // row (consistently in count and decode).
+          skip = true;
+        } else {
+          if (d + 8 > rsize) return -1;
+          out.sip = le32(c + d);
+          out.dip = le32(c + d + 4);
+          d += 8;
+        }
+        if (!skip) {
+          const size_t pkt_w = (rflags & kFlagPkts64) ? 8 : 4;
+          const size_t byt_w = (rflags & kFlagBytes64) ? 8 : 4;
+          if (d + pkt_w + byt_w > rsize) return -1;
+          const uint64_t pk =
+              pkt_w == 8 ? le64(c + d) : (uint64_t)le32(c + d);
+          d += pkt_w;
+          const uint64_t by =
+              byt_w == 8 ? le64(c + d) : (uint64_t)le32(c + d);
+          // Saturate at the uint32 ABI ceiling like the sampling
+          // scaler: a pinned max is visibly wrong, a wrapped small
+          // number silently wrong.
+          out.dpkts = pk > 0xFFFFFFFFULL ? 0xFFFFFFFFU : (uint32_t)pk;
+          out.doctets = by > 0xFFFFFFFFULL ? 0xFFFFFFFFU : (uint32_t)by;
+          const double t0 = (double)first + msec_first / 1000.0;
+          const double t1 = (double)last + msec_last / 1000.0;
+          if (!sink(out, t0, t1)) return 0;
+        }
+      }
+      // Types 2 (extension map), 7/8 (exporter), 9 (sampler), and any
+      // unknown record: skipped whole by declared size.
+      r += rsize;
+    }
+    off = blk_end;
+  }
+  return off == (size_t)len ? 0 : -1;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Count flow rows in an nfcapd v1 file. Negative codes: -1 malformed,
+// -2 compressed (use the nfdump passthrough), -3 big-endian writer,
+// -4 unsupported layout version (nfdump 1.7's v2 — passthrough).
+int64_t nfcapd_count(const uint8_t* buf, int64_t len) {
+  int64_t n = 0;
+  const int64_t rc = nfcapd_walk(
+      buf, len, [&](const V9Record&, double, double) {
+        ++n;
+        return true;
+      });
+  return rc < 0 ? rc : n;
+}
+
+// Decode an nfcapd v1 file into caller-allocated arrays of length `n`
+// (from nfcapd_count). Same output schema as nfx_decode.
+int64_t nfcapd_decode(const uint8_t* buf, int64_t len, int64_t n,
+                      uint32_t* sip, uint32_t* dip, uint16_t* sport,
+                      uint16_t* dport, uint8_t* proto, uint8_t* tcp_flags,
+                      uint32_t* dpkts, uint32_t* doctets, double* start_ts,
+                      double* end_ts) {
+  if (!sip || !dip || !sport || !dport || !proto || !tcp_flags || !dpkts ||
+      !doctets || !start_ts || !end_ts)
+    return -1;
+  int64_t i = 0;
+  const int64_t rc = nfcapd_walk(
+      buf, len, [&](const V9Record& r, double t0, double t1) {
+        if (i >= n) return false;
+        sip[i] = r.sip;
+        dip[i] = r.dip;
+        sport[i] = r.sport;
+        dport[i] = r.dport;
+        proto[i] = r.proto;
+        tcp_flags[i] = r.tcp_flags;
+        dpkts[i] = r.dpkts;
+        doctets[i] = r.doctets;
+        start_ts[i] = t0;
+        end_ts[i] = t1;
+        ++i;
+        return true;
+      });
+  return rc < 0 ? rc : i;
+}
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
 // CLI: nfdecode <capture.nf5>  — stream CSV to stdout, one row per flow,
 // schema matching the ingest path's flow table (onix/ingest/nfdecode.py).
 // ---------------------------------------------------------------------------
@@ -779,9 +1003,29 @@ int main(int argc, char** argv) {
   }
   std::fclose(f);
 
-  const int64_t n = nfx_count(buf.data(), sz);
+  // nfcapd container files (LE magic 0xA50C) route to the container
+  // reader; everything else is a wire-format packet stream.
+  const bool container =
+      sz >= 2 && ((buf[0] == 0x0C && buf[1] == 0xA5) ||
+                  (buf[0] == 0xA5 && buf[1] == 0x0C));  // LE or BE writer
+  auto count_fn = container ? nfcapd_count : nfx_count;
+  auto decode_fn = container ? nfcapd_decode : nfx_decode;
+  const int64_t n = count_fn(buf.data(), sz);
+  if (n == -2) {
+    std::fprintf(stderr, "compressed nfcapd file (use nfdump)\n");
+    return 1;
+  }
+  if (n == -3) {
+    std::fprintf(stderr, "big-endian nfcapd file not supported\n");
+    return 1;
+  }
+  if (n == -4) {
+    std::fprintf(stderr, "unsupported nfcapd layout version (use nfdump)\n");
+    return 1;
+  }
   if (n < 0) {
-    std::fprintf(stderr, "malformed netflow v5/v9/ipfix stream\n");
+    std::fprintf(stderr, container ? "malformed nfcapd file\n"
+                                   : "malformed netflow v5/v9/ipfix stream\n");
     return 1;
   }
   // n == 0 is legal (e.g. data sets whose template was never seen):
@@ -792,9 +1036,9 @@ int main(int argc, char** argv) {
   std::vector<uint16_t> sport(cap), dport(cap);
   std::vector<uint8_t> proto(cap), flags(cap);
   std::vector<double> t0(cap), t1(cap);
-  if (nfx_decode(buf.data(), sz, n, sip.data(), dip.data(), sport.data(),
-                 dport.data(), proto.data(), flags.data(), dpkts.data(),
-                 doctets.data(), t0.data(), t1.data()) != n) {
+  if (decode_fn(buf.data(), sz, n, sip.data(), dip.data(), sport.data(),
+                dport.data(), proto.data(), flags.data(), dpkts.data(),
+                doctets.data(), t0.data(), t1.data()) != n) {
     std::fprintf(stderr, "decode error\n");
     return 1;
   }

@@ -84,10 +84,24 @@ class MeshConfig:
 
     dp: int = 1                 # data-parallel axis size (documents/tokens)
     mp: int = 1                 # model-parallel axis size (vocabulary shards)
+    # Multi-host runtime (SURVEY.md §2.3 — replaces mpiexec+machinefile).
+    # On a TPU pod leave these empty: jax.distributed.initialize
+    # auto-detects the coordinator from the TPU metadata. Off-pod (CPU
+    # tests, GPU clusters) set all three; the sharded engine then calls
+    # multihost_init() before building the mesh.
+    coordinator: str = ""       # host:port of process 0; "" = auto/single
+    num_processes: int = 0      # 0 = auto (single host unless on a pod)
+    process_id: int = -1        # -1 = auto
 
     def validate(self) -> None:
         if self.dp < 1 or self.mp < 1:
             raise ValueError("mesh axis sizes must be >=1")
+        manual = (bool(self.coordinator), self.num_processes > 0,
+                  self.process_id >= 0)
+        if any(manual) and not all(manual):
+            raise ValueError(
+                "mesh.coordinator, mesh.num_processes, and mesh.process_id "
+                "must be set together for an explicit multi-host launch")
 
     @property
     def n_devices(self) -> int:
@@ -127,10 +141,12 @@ class IngestConfig:
     """Telemetry decoding options (SURVEY.md §2.1 #1-#2).
 
     apply_sampling scales flow packet/byte counters by the announcing
-    exporter's sampling interval (NetFlow v9 / IPFIX options records,
-    field 34; per source/domain id) — nfdump-style counter scaling for
-    sampled exporters. Off by default: raw wire counters are the honest
-    record of what was exported."""
+    exporter's sampling interval (NetFlow v9 / IPFIX options records:
+    field 34 or the sampler-table IEs 50/305; per source/domain id,
+    with a pre-scan so flows ahead of a mid-file announcement scale
+    too) — nfdump-style counter scaling for sampled exporters. Off by
+    default: raw wire counters are the honest record of what was
+    exported."""
 
     apply_sampling: bool = False
 

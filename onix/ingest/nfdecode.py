@@ -6,11 +6,13 @@ flow table. The writers generate spec-conformant packet streams for
 round-trip tests and synthetic captures (SURVEY.md §4.1 "C++ decoder
 round-trip on synthesized nfcapd records").
 
-nfcapd files (nfdump's private on-disk container, not a wire format)
-are handled by subprocess passthrough to an installed `nfdump` binary —
-the same pattern as the DNS path's tshark passthrough — because
-reimplementing a proprietary container without its spec would be
-guesswork; the open wire formats are decoded natively.
+nfcapd files (nfdump's on-disk container — the reference's flow landing
+format, /root/reference/README.md:83) decode NATIVELY for uncompressed
+layout-v1 files via the clean-room reader in native/nfdecode; only
+block-compressed files (LZO/BZ2/LZ4) fall back to subprocess
+passthrough to an installed `nfdump` binary — the same pattern as the
+DNS path's tshark passthrough. `write_nfcapd` emits the same structure
+so CI decodes a pinned committed fixture without the tool.
 """
 
 from __future__ import annotations
@@ -76,16 +78,23 @@ def load_library() -> ctypes.CDLL:
     lib.nfx_sampling.argtypes = [u8, ctypes.c_int64]
     lib.nfx_decode_scaled.restype = ctypes.c_int64
     lib.nfx_decode_scaled.argtypes = list(lib.nfx_decode.argtypes)
+    # nfcapd v1 container (clean-room reader; uncompressed files).
+    lib.nfcapd_count.restype = ctypes.c_int64
+    lib.nfcapd_count.argtypes = [u8, ctypes.c_int64]
+    lib.nfcapd_decode.restype = ctypes.c_int64
+    lib.nfcapd_decode.argtypes = list(lib.nfx_decode.argtypes)
     _lib = lib
     return lib
 
 
 def sampling_interval(data: bytes) -> int:
     """Exporter sampling interval from the stream's options records
-    (NetFlow v9 field / IPFIX IE 34, carried in options data sets —
-    RFC 3954 §6.1 / RFC 7011 §3.4.2.2). Returns 0 when no options
-    record announced one (v5 has no options mechanism). Last value in
-    stream order wins, matching how exporters refresh exporter state."""
+    (NetFlow v9 field / IPFIX IE 34, the sampler-table IEs 50
+    samplerRandomInterval / 305 samplingPacketInterval; carried in
+    options data sets — RFC 3954 §6.1 / RFC 7011 §3.4.2.2). Returns 0
+    when no options record announced one (v5 has no options mechanism).
+    Last value in stream order wins, matching how exporters refresh
+    exporter state."""
     lib = load_library()
     buf = np.frombuffer(data, np.uint8)
     bp = buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
@@ -116,19 +125,31 @@ def decode_bytes(data: bytes, apply_sampling: bool = False) -> pd.DataFrame:
     ingest flow table.
 
     With `apply_sampling`, packet/byte counters are scaled by the
-    ANNOUNCING exporter's sampling interval (options records, field 34;
-    per v9 source id / IPFIX domain id, so one exporter's rate never
-    inflates another's flows) — the equivalent of running the
-    reference's nfdump fork with counter scaling on a sampled exporter.
-    Off by default: raw wire counters are the honest record of what was
-    exported."""
+    ANNOUNCING exporter's sampling interval (options records, field 34
+    or the sampler-table IEs 50/305; per v9 source id / IPFIX domain
+    id, so one exporter's rate never inflates another's flows) — the
+    equivalent of running the reference's nfdump fork with counter
+    scaling on a sampled exporter. The decoder PRE-SCANS the stream for
+    announcements, so flows ahead of a mid-capture (periodic-refresh)
+    options record scale by the exporter's first announced rate rather
+    than staying raw. Off by default: raw wire counters are the honest
+    record of what was exported."""
     lib = load_library()
     buf = np.frombuffer(data, np.uint8)
     bp = buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
     n = lib.nfx_count(bp, len(data))
     if n < 0:
         raise ValueError("malformed netflow v5/v9 stream")
-    arrays = {
+    arrays = _flow_arrays(n)
+    decode = lib.nfx_decode_scaled if apply_sampling else lib.nfx_decode
+    wrote = _call_decode(decode, bp, len(data), n, arrays)
+    if wrote != n:
+        raise ValueError(f"decode error: wrote {wrote} of {n}")
+    return _arrays_to_table(arrays, n)
+
+
+def _flow_arrays(n: int) -> dict[str, np.ndarray]:
+    return {
         "sip": np.empty(n, np.uint32), "dip": np.empty(n, np.uint32),
         "sport": np.empty(n, np.uint16), "dport": np.empty(n, np.uint16),
         "proto": np.empty(n, np.uint8), "tcp_flags": np.empty(n, np.uint8),
@@ -136,20 +157,27 @@ def decode_bytes(data: bytes, apply_sampling: bool = False) -> pd.DataFrame:
         "start_ts": np.empty(n, np.float64), "end_ts": np.empty(n, np.float64),
     }
 
+
+def _call_decode(fn, bp, n_bytes: int, n: int,
+                 arrays: dict[str, np.ndarray]) -> int:
+    """Invoke one of the native decode entry points (they all share the
+    10-output-pointer ABI) over the _flow_arrays columns — ONE copy of
+    the pointer-order contract for every decode path."""
     def p(name, ct):
         return arrays[name].ctypes.data_as(ctypes.POINTER(ct))
 
-    decode = lib.nfx_decode_scaled if apply_sampling else lib.nfx_decode
-    wrote = decode(
-        bp, len(data), n,
+    return fn(
+        bp, n_bytes, n,
         p("sip", ctypes.c_uint32), p("dip", ctypes.c_uint32),
         p("sport", ctypes.c_uint16), p("dport", ctypes.c_uint16),
         p("proto", ctypes.c_uint8), p("tcp_flags", ctypes.c_uint8),
         p("ipkt", ctypes.c_uint32), p("ibyt", ctypes.c_uint32),
         p("start_ts", ctypes.c_double), p("end_ts", ctypes.c_double))
-    if wrote != n:
-        raise ValueError(f"decode error: wrote {wrote} of {n}")
 
+
+def _arrays_to_table(arrays: dict[str, np.ndarray], n: int) -> pd.DataFrame:
+    """Decoded column arrays -> the ingest flow table schema (shared by
+    the wire-format and nfcapd-container decode paths)."""
     ts = pd.to_datetime(arrays["start_ts"], unit="s")
     return pd.DataFrame({
         "treceived": ts.strftime("%Y-%m-%d %H:%M:%S"),
@@ -167,20 +195,52 @@ def decode_bytes(data: bytes, apply_sampling: bool = False) -> pd.DataFrame:
     })
 
 
-#: nfcapd file magic (uint16 0xA50C, written little-endian by nfdump).
-_NFCAPD_MAGIC = b"\x0c\xa5"
+#: nfcapd file magic (uint16 0xA50C) in both byte orders — a BE-host
+#: file must route to the container reader so the byte-order diagnostic
+#: fires instead of a misleading "malformed wire stream".
+_NFCAPD_MAGICS = (b"\x0c\xa5", b"\xa5\x0c")
 
 
 def is_nfcapd(data: bytes) -> bool:
-    return data[:2] == _NFCAPD_MAGIC
+    return data[:2] in _NFCAPD_MAGICS
 
 
 def decode_nfcapd(path: str | pathlib.Path) -> pd.DataFrame:
-    """Decode an nfcapd file via an installed `nfdump` binary.
+    """Decode an nfcapd file: natively for uncompressed layout-v1 files
+    (the clean-room reader in native/nfdecode — the reference's landing
+    format no longer requires an external binary, VERDICT r2 next #7),
+    with subprocess passthrough to an installed `nfdump` for compressed
+    files (LZO/BZ2/LZ4) and other layout versions (nfdump 1.7's v2) —
+    those stay the format owner's concern. Raises DecoderUnavailable
+    when a file needs the absent tool.
 
-    nfcapd is nfdump's internal storage container (compressed blocks,
-    private record layout), not one of the open export formats — the
-    honest interop path is the tool that owns the format. Raises
+    Counters come back exactly as stored: nfdump applies any sampling
+    scaling when it captures/stores, so there is nothing left to scale
+    here (the wire-format paths' apply_sampling has no container
+    equivalent)."""
+    data = pathlib.Path(path).read_bytes()
+    lib = load_library()
+    buf = np.frombuffer(data, np.uint8)
+    bp = buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+    n = lib.nfcapd_count(bp, len(data))
+    if n == -1:
+        raise ValueError(f"malformed nfcapd file: {path}")
+    if n == -3:
+        raise ValueError(
+            f"{path}: nfcapd file written by a big-endian host is not "
+            "supported (nfcapd is host-byte-order on disk)")
+    if n < 0:   # -2 compressed / -4 other layout version: needs the tool
+        return _decode_nfcapd_nfdump(path)
+    arrays = _flow_arrays(n)
+    wrote = _call_decode(lib.nfcapd_decode, bp, len(data), n, arrays)
+    if wrote != n:
+        raise ValueError(f"nfcapd decode error: wrote {wrote} of {n}")
+    return _arrays_to_table(arrays, n)
+
+
+def _decode_nfcapd_nfdump(path: str | pathlib.Path) -> pd.DataFrame:
+    """Compressed-nfcapd passthrough via an installed `nfdump` binary —
+    same pattern as the DNS path's tshark passthrough. Raises
     DecoderUnavailable when nfdump is not installed."""
     try:
         # -N: plain numbers — without it nfdump scales big counters to
@@ -191,9 +251,10 @@ def decode_nfcapd(path: str | pathlib.Path) -> pd.DataFrame:
             check=True, capture_output=True, text=True, timeout=600)
     except FileNotFoundError as e:
         raise DecoderUnavailable(
-            "nfcapd file needs the nfdump tool installed (nfcapd is "
-            "nfdump's private container; onix decodes the open v5/v9/"
-            "IPFIX wire formats natively)") from e
+            "this nfcapd file (COMPRESSED or layout v2+) needs the "
+            "nfdump tool installed — onix reads uncompressed layout-v1 "
+            "natively; re-store with `nfdump -r file -w out -z=no` "
+            "(nfdump 1.6.x) to drop the compression") from e
     except subprocess.CalledProcessError as e:
         raise ValueError(f"nfdump failed on {path}: {e.stderr}") from e
     rows = [ln.split(",") for ln in proc.stdout.splitlines()
@@ -230,8 +291,9 @@ def decode_file(path: str | pathlib.Path,
                 apply_sampling: bool = False) -> pd.DataFrame:
     data = pathlib.Path(path).read_bytes()
     if is_nfcapd(data):
-        # nfcapd passthrough prints whatever nfdump recorded; sampling
-        # scaling there is nfdump's own concern, not reproduced here.
+        # Container files carry counters as nfdump stored them (any
+        # sampling scaling already applied at capture) — apply_sampling
+        # is a wire-format concern and has no effect here.
         return decode_nfcapd(path)
     return decode_bytes(data, apply_sampling=apply_sampling)
 
@@ -330,7 +392,8 @@ def write_ipfix(table: pd.DataFrame, *, records_per_packet: int = 20,
                 domain_id: int = 0, template_every_packet: bool = False,
                 varlen_long_form: bool = False,
                 with_options_set: bool = True,
-                sampling_interval: int | None = None) -> bytes:
+                sampling_interval: int | None = None,
+                sampling_field: int = 34) -> bytes:
     """Encode a flow table as an IPFIX (NetFlow v10) message stream.
     Same input schema as write_v5/write_v9.
 
@@ -369,7 +432,9 @@ def write_ipfix(table: pd.DataFrame, *, records_per_packet: int = 20,
     opt_body += struct.pack(">HH", 41, 8)    # exportedMessageTotalCount
     rec_len = 12
     if sampling_interval is not None:
-        opt_body += struct.pack(">HH", 34, 4)   # samplingInterval
+        # IE 34 by default; tests also exercise the sampler-table IEs
+        # (50 samplerRandomInterval / 305 samplingPacketInterval).
+        opt_body += struct.pack(">HH", sampling_field, 4)
         rec_len += 4
     opt_set = struct.pack(">HH", 3, 4 + len(opt_body)) + opt_body
     opt_data = struct.pack(">HH", _IPFIX_OPTIONS_TEMPLATE_ID, 4 + rec_len)
@@ -430,7 +495,8 @@ def write_v9(table: pd.DataFrame, *, sys_uptime_ms: int = 3_600_000,
              records_per_packet: int = 20, source_id: int = 0,
              template_every_packet: bool = False,
              pad_template_flowset: bool = False,
-             sampling_interval: int | None = None) -> bytes:
+             sampling_interval: int | None = None,
+             sampling_field: int = 34) -> bytes:
     """Encode a flow table as a NetFlow v9 packet stream: a template
     flowset in the first packet (or every packet), then data flowsets.
     Same input schema as write_v5.
@@ -466,7 +532,7 @@ def write_v9(table: pd.DataFrame, *, sys_uptime_ms: int = 3_600_000,
         # (34, 4 bytes); then one options data record.
         opt_body = struct.pack(">HHH", _V9_OPTIONS_TEMPLATE_ID, 4, 4)
         opt_body += struct.pack(">HH", 1, 4)    # scope spec: System
-        opt_body += struct.pack(">HH", 34, 4)   # option spec
+        opt_body += struct.pack(">HH", sampling_field, 4)   # option spec
         opt_sets = struct.pack(">HH", 1, 4 + len(opt_body)) + opt_body
         opt_sets += struct.pack(">HHII", _V9_OPTIONS_TEMPLATE_ID, 4 + 8,
                                 source_id, sampling_interval)
@@ -512,3 +578,87 @@ def write_v9(table: pd.DataFrame, *, sys_uptime_ms: int = 3_600_000,
         if n == 0:
             break
     return bytes(out)
+
+
+# -- nfcapd v1 writer (fixtures + round-trip tests) ------------------------
+#
+# Emits the same on-disk structure the clean-room reader parses
+# (native/nfdecode: file header 0xA50C/v1, stat record, type-2 data
+# blocks of type-1 common records with the required extensions in
+# order). The writer exists so CI can commit and decode a pinned binary
+# fixture (tests/fixtures/) without an nfdump install; it deliberately
+# exercises the layout's degrees of freedom — 32/64-bit counter flags,
+# optional-extension tails, extension-map/exporter records to skip,
+# IPv6 rows the flow schema drops.
+
+
+def write_nfcapd(table: pd.DataFrame, *, ident: str = "onix-fixture",
+                 records_per_block: int = 100, with_extras: bool = True,
+                 n_v6_rows: int = 0, compressed_flag: bool = False) -> bytes:
+    """Encode a flow table as an uncompressed nfcapd layout-v1 file.
+    Same input schema as write_v5. `n_v6_rows` appends IPv6 flow
+    records (skipped by the v4 flow schema); `compressed_flag` sets the
+    LZO bit WITHOUT compressing — for testing the passthrough gate."""
+    n = len(table)
+    sip, dip, proto, flags = _numeric_cols(table)
+    sport = table["sport"].to_numpy(np.int64)
+    dport = table["dport"].to_numpy(np.int64)
+    ipkt = table["ipkt"].to_numpy(np.int64)
+    ibyt = table["ibyt"].to_numpy(np.int64)
+    start = table["start_ts"].to_numpy(np.float64)
+    end = table["end_ts"].to_numpy(np.float64)
+
+    def common_record(i: int) -> bytes:
+        rflags = 0
+        if ipkt[i] > 0xFFFFFFFF:
+            rflags |= 0x2                       # FLAG_PKG_64
+        if ibyt[i] > 0xFFFFFFFF:
+            rflags |= 0x4                       # FLAG_BYTES_64
+        first, msec_first = int(start[i]), int(round((start[i] % 1) * 1000))
+        last, msec_last = int(end[i]), int(round((end[i] % 1) * 1000))
+        body = struct.pack("<HHHHIIBBBBHH", rflags, 0, msec_first % 1000,
+                           msec_last % 1000, first, last, 0,
+                           int(flags[i]) & 0xFF, int(proto[i]) & 0xFF, 0,
+                           int(sport[i]) & 0xFFFF, int(dport[i]) & 0xFFFF)
+        body += struct.pack("<II", int(sip[i]), int(dip[i]))
+        body += struct.pack("<Q" if rflags & 0x2 else "<I", int(ipkt[i]))
+        body += struct.pack("<Q" if rflags & 0x4 else "<I", int(ibyt[i]))
+        if with_extras:
+            # An optional extension tail (e.g. EX_IO_SNMP_2 in/out
+            # interfaces) the reader must skip via the size field.
+            body += struct.pack("<HH", 7, 11)
+        return struct.pack("<HH", 1, 4 + len(body)) + body
+
+    def v6_record() -> bytes:
+        body = struct.pack("<HHHHIIBBBBHH", 0x1, 0, 0, 0, int(start[0]) if n
+                           else 0, int(end[0]) if n else 0, 0, 0, 17, 0,
+                           53, 53)
+        body += b"\x20\x01\x0d\xb8" + b"\x00" * 12      # src 2001:db8::
+        body += b"\x20\x01\x0d\xb8" + b"\x00" * 11 + b"\x01"
+        body += struct.pack("<II", 3, 300)              # pkts, bytes
+        return struct.pack("<HH", 1, 4 + len(body)) + body
+
+    # Extension-map + exporter records the reader must skip whole.
+    ext_map = struct.pack("<HHHH", 2, 12, 0, 4) + struct.pack("<HH", 4, 0)
+    exporter = struct.pack("<HH", 7, 12) + b"\x00" * 8
+
+    records: list[bytes] = [ext_map, exporter]
+    records += [common_record(i) for i in range(n)]
+    records += [v6_record() for _ in range(n_v6_rows)]
+
+    blocks = b""
+    n_blocks = 0
+    for lo in range(0, max(len(records), 1), records_per_block):
+        chunk = records[lo:lo + records_per_block]
+        if not chunk:
+            break
+        payload = b"".join(chunk)
+        blocks += struct.pack("<IIHH", len(chunk), len(payload), 2, 0)
+        blocks += payload
+        n_blocks += 1
+
+    flags_word = 0x1 if compressed_flag else 0
+    header = struct.pack("<HHII", 0xA50C, 1, flags_word, n_blocks)
+    header += ident.encode()[:127].ljust(128, b"\0")
+    stat = struct.pack("<Q", n) + b"\0" * 128            # numflows + rest
+    return header + stat + blocks

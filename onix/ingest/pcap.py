@@ -25,18 +25,28 @@ _BIN_PATH = _NATIVE_DIR / "build" / "pcapdns"
 # tshark extracts v4 and v6 addresses through separate fields; the v6
 # columns are merged back into the 7-column TSV contract the native
 # extractor emits (RFC 5952 canonical text on both paths).
+# `ip.proto != 41` drops IPv4-tunneled IPv6 (6in4/6to4/ISATAP): for
+# those frames tshark populates BOTH address pairs (outer v4 + inner
+# v6) while the native extractor skips them (outer proto 41, not UDP)
+# — excluding them keeps the two branches' output identical for the
+# same capture (ADVICE r2). `!ip` keeps native v6: for a plain IPv6
+# frame the ip layer is absent, so the clause passes.
 TSHARK_ARGS = [
     "-T", "fields", "-e", "frame.time_epoch", "-e", "frame.len",
     "-e", "ip.src", "-e", "ipv6.src", "-e", "ip.dst", "-e", "ipv6.dst",
     "-e", "dns.qry.name", "-e", "dns.qry.type", "-e", "dns.flags.rcode",
-    "-Y", "dns.flags.response == 1 && (ip || ipv6) && udp",
+    "-Y", ("dns.flags.response == 1 && (ip || ipv6) && udp"
+           " && (!ip || ip.proto != 41)"),
 ]
 
 
 def _merge_tshark_v6(tsv: str) -> str:
     """Collapse the (ip.src, ipv6.src) and (ip.dst, ipv6.dst) column
-    pairs into single src/dst columns — exactly one of each pair is
-    non-empty per row (the display filter requires ip or ipv6)."""
+    pairs into single src/dst columns. Exactly one of each pair is
+    non-empty per row: the display filter requires ip or ipv6 and
+    excludes proto-41 tunnels, the only frames that populate both. The
+    ipv6 side still wins on a both-populated row (innermost layer —
+    defense against filter drift)."""
     out = []
     for line in tsv.splitlines():
         if not line.strip():
@@ -45,7 +55,7 @@ def _merge_tshark_v6(tsv: str) -> str:
         if len(f) != 9:      # unexpected shape: let the parser complain
             out.append(line)
             continue
-        out.append("\t".join([f[0], f[1], f[2] or f[3], f[4] or f[5],
+        out.append("\t".join([f[0], f[1], f[3] or f[2], f[5] or f[4],
                               f[6], f[7], f[8]]))
     return "\n".join(out) + ("\n" if out else "")
 

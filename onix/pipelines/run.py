@@ -70,8 +70,17 @@ def fit_engine(cfg: OnixConfig, bundle: CorpusBundle, engine: str) -> dict:
         return {"theta": fit["theta"], "phi_wk": fit["phi_wk"],
                 "ll_history": fit["ll_history"]}
     if engine == "sharded":
-        from onix.parallel.mesh import make_mesh
+        from onix.parallel.mesh import make_mesh, multihost_init
         from onix.parallel.sharded_gibbs import ShardedGibbsLDA
+        # Multi-host first (SURVEY.md §2.3): on a pod every host runs
+        # this same CLI and the runtime wires them into one job; the
+        # mesh below then spans the GLOBAL device set. Explicit
+        # coordinator config (CPU/GPU clusters) feeds straight through.
+        multihost_init(
+            coordinator=cfg.mesh.coordinator or None,
+            num_processes=cfg.mesh.num_processes or None,
+            process_id=(cfg.mesh.process_id
+                        if cfg.mesh.process_id >= 0 else None))
         mesh = make_mesh(dp=cfg.mesh.dp, mp=cfg.mesh.mp)
         model = ShardedGibbsLDA(cfg.lda, corpus.n_vocab, mesh=mesh)
         fit = model.fit(corpus, checkpoint_dir=ck_dir)

@@ -579,11 +579,174 @@ def test_ipfix_unknown_template_and_truncation():
 
 @needs_decoder
 def test_nfcapd_magic_dispatch(tmp_path, monkeypatch):
-    """An nfcapd-magic file routes to the nfdump passthrough; without
-    nfdump installed that is a clear DecoderUnavailable, never a
-    misparse as wire format."""
+    """An nfcapd-magic file routes to the native container reader; a
+    truncated/garbage one is a clear ValueError, never a misparse as
+    wire format (and never a silent empty table)."""
     p = tmp_path / "nfcapd.202607080000"
     p.write_bytes(b"\x0c\xa5" + b"\x00" * 64)
     monkeypatch.setenv("PATH", str(tmp_path))   # hide any real nfdump
-    with pytest.raises(nfd.DecoderUnavailable):
+    with pytest.raises(ValueError, match="nfcapd"):
+        nfd.decode_file(p)
+
+
+@needs_decoder
+def test_sampling_prescan_covers_preannouncement_flows():
+    """ADVICE r2: an options announcement arriving mid-stream (the
+    periodic-refresh case) must scale flows decoded BEFORE it too —
+    apply_sampling pre-scans the capture for announcements instead of
+    relying on single-pass order."""
+    head = _synth_flow_arrays(n=9, seed=20)
+    tail = _synth_flow_arrays(n=7, seed=21)
+    # Same exporter (source_id 0): the head stream carries no options
+    # record; the announcement first appears in the tail's packet.
+    stream = nfd.write_v9(head) + nfd.write_v9(tail, sampling_interval=16)
+    scaled = nfd.decode_bytes(stream, apply_sampling=True)
+    want = np.concatenate([head["ipkt"].to_numpy(), tail["ipkt"].to_numpy()])
+    np.testing.assert_array_equal(
+        scaled["ipkt"].to_numpy(np.int64),
+        np.minimum(want * 16, 0xFFFFFFFF))
+    # A mid-capture rate CHANGE still applies from its announcement on:
+    # flows ahead of the first announcement take the FIRST rate.
+    two = (nfd.write_v9(head, sampling_interval=4)
+           + nfd.write_v9(tail, sampling_interval=16))
+    scaled2 = nfd.decode_bytes(two, apply_sampling=True)
+    np.testing.assert_array_equal(
+        scaled2["ipkt"].to_numpy(np.int64)[:9],
+        np.minimum(head["ipkt"].to_numpy() * 4, 0xFFFFFFFF))
+    np.testing.assert_array_equal(
+        scaled2["ipkt"].to_numpy(np.int64)[9:],
+        np.minimum(tail["ipkt"].to_numpy() * 16, 0xFFFFFFFF))
+
+
+@needs_decoder
+def test_sampler_table_fields_announce_interval():
+    """ADVICE r2: exporters announcing rates via the sampler-table
+    fields — v9/IPFIX 50 (samplerRandomInterval) and IPFIX 305
+    (samplingPacketInterval) — must scale like field 34 announcers."""
+    table = _synth_flow_arrays(n=5, seed=22)
+    for maker, field in ((nfd.write_v9, 50), (nfd.write_ipfix, 50),
+                         (nfd.write_ipfix, 305)):
+        data = maker(table, sampling_interval=32, sampling_field=field)
+        assert nfd.sampling_interval(data) == 32, (maker.__name__, field)
+        scaled = nfd.decode_bytes(data, apply_sampling=True)
+        np.testing.assert_array_equal(
+            scaled["ipkt"].to_numpy(np.int64),
+            np.minimum(table["ipkt"].to_numpy() * 32, 0xFFFFFFFF))
+    # Sampler id/mode fields (48/49) carry no interval: not triggers.
+    quiet = nfd.write_v9(table, sampling_interval=7, sampling_field=49)
+    assert nfd.sampling_interval(quiet) == 0
+
+
+@needs_decoder
+def test_nfcapd_native_roundtrip():
+    """VERDICT r2 next #7: uncompressed nfcapd v1 decodes natively —
+    no external nfdump. Round trip through write_nfcapd covers 32/64-bit
+    counter flags, optional-extension tails, skip-whole records
+    (extension map, exporter), and IPv6 rows the v4 schema drops."""
+    table = _synth_flow_arrays(n=57, seed=30)
+    table = table.copy()
+    table.loc[3, "ibyt"] = 0x1_2345_6789          # forces FLAG_BYTES_64
+    table.loc[4, "ipkt"] = 0x2_0000_0001          # forces FLAG_PKG_64
+    data = nfd.write_nfcapd(table, records_per_block=20, n_v6_rows=3)
+    import tempfile
+    with tempfile.NamedTemporaryFile(suffix=".nfcapd", delete=False) as f:
+        f.write(data)
+        path = f.name
+    out = nfd.decode_file(path)
+    assert len(out) == 57                          # v6 rows skipped
+    np.testing.assert_array_equal(
+        out["sip"].to_numpy(object),
+        nfd.ip_to_str(table["sip"].to_numpy(np.uint32)).astype(object))
+    np.testing.assert_array_equal(out["sport"].to_numpy(np.int64),
+                                  table["sport"].to_numpy())
+    np.testing.assert_array_equal(out["dport"].to_numpy(np.int64),
+                                  table["dport"].to_numpy())
+    # 64-bit counters saturate at the uint32 ABI ceiling.
+    want_ibyt = np.minimum(table["ibyt"].to_numpy(), 0xFFFFFFFF)
+    want_ipkt = np.minimum(table["ipkt"].to_numpy(), 0xFFFFFFFF)
+    np.testing.assert_array_equal(out["ibyt"].to_numpy(np.int64), want_ibyt)
+    np.testing.assert_array_equal(out["ipkt"].to_numpy(np.int64), want_ipkt)
+    # Times survive to the second (treceived is the ingest contract).
+    want_ts = pd.to_datetime(
+        table["start_ts"].to_numpy(np.int64), unit="s").strftime(
+        "%Y-%m-%d %H:%M:%S")
+    np.testing.assert_array_equal(out["treceived"].to_numpy(object),
+                                  np.asarray(want_ts, dtype=object))
+
+
+@needs_decoder
+def test_nfcapd_committed_fixture_decodes():
+    """The pinned binary fixture (committed, never regenerated in CI)
+    decodes to its recorded expectation — guards the reader against
+    reader/writer co-drift."""
+    import pathlib
+    fx = pathlib.Path(__file__).parent / "fixtures"
+    out = nfd.decode_file(fx / "nfcapd.201607081200")
+    want = pd.read_csv(fx / "nfcapd.201607081200.expected.csv")
+    assert len(out) == len(want)
+    for col in ("sip", "dip", "sport", "dport", "proto", "ipkt", "ibyt",
+                "treceived"):
+        np.testing.assert_array_equal(out[col].to_numpy(),
+                                      want[col].to_numpy(), err_msg=col)
+
+
+@needs_decoder
+def test_nfcapd_compressed_falls_back_loudly():
+    """A compressed-flagged nfcapd file routes to the nfdump
+    passthrough; without the tool installed that is a DecoderUnavailable
+    with install guidance, never a silent wrong decode."""
+    import shutil
+    import tempfile
+    table = _synth_flow_arrays(n=5, seed=31)
+    data = nfd.write_nfcapd(table, compressed_flag=True)
+    with tempfile.NamedTemporaryFile(suffix=".nfcapd", delete=False) as f:
+        f.write(data)
+        path = f.name
+    if shutil.which("nfdump"):
+        pytest.skip("real nfdump present; passthrough path exercised there")
+    with pytest.raises(nfd.DecoderUnavailable, match="COMPRESSED"):
+        nfd.decode_file(path)
+
+
+@needs_decoder
+def test_nfcapd_malformed_rejected():
+    table = _synth_flow_arrays(n=5, seed=32)
+    data = nfd.write_nfcapd(table)
+    import tempfile
+
+    def decode_of(blob):
+        with tempfile.NamedTemporaryFile(suffix=".nfc", delete=False) as f:
+            f.write(blob)
+            return f.name
+
+    # Truncated mid-block refuses; an unknown layout version routes to
+    # the passthrough (DecoderUnavailable without the tool — covered in
+    # test_nfcapd_v2_layout_falls_back), never a silent wrong decode.
+    with pytest.raises(ValueError):
+        nfd.decode_file(decode_of(data[:len(data) - 7]))
+    with pytest.raises((ValueError, nfd.DecoderUnavailable)):
+        nfd.decode_file(decode_of(data[:2] + b"\x07\x00" + data[4:]))
+
+
+@needs_decoder
+def test_nfcapd_v2_layout_falls_back(tmp_path, monkeypatch):
+    """nfdump 1.7's layout v2 (same magic, version 2) routes to the
+    nfdump passthrough, not a hard malformed error."""
+    table = _synth_flow_arrays(n=4, seed=33)
+    data = bytearray(nfd.write_nfcapd(table))
+    data[2:4] = (2).to_bytes(2, "little")      # layoutVersion = 2
+    p = tmp_path / "nfcapd.202607080000"
+    p.write_bytes(bytes(data))
+    monkeypatch.setenv("PATH", str(tmp_path))  # hide any real nfdump
+    with pytest.raises(nfd.DecoderUnavailable, match="layout"):
+        nfd.decode_file(p)
+
+
+@needs_decoder
+def test_nfcapd_big_endian_diagnosed(tmp_path):
+    """A BE-host nfcapd file gets the byte-order diagnostic, not a
+    misleading 'malformed wire stream'."""
+    p = tmp_path / "nfcapd.be"
+    p.write_bytes(b"\xa5\x0c" + b"\x00" * 300)
+    with pytest.raises(ValueError, match="big-endian"):
         nfd.decode_file(p)

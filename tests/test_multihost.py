@@ -1,0 +1,92 @@
+"""Multi-host runtime actually exercised (VERDICT r2 next #6).
+
+The reference ran 20 MPI ranks over ssh + a machinefile (SURVEY.md
+§2.3); onix's equivalent is jax.distributed + a global mesh. These
+tests launch a REAL 2-process jax.distributed job on the CPU backend
+(gRPC over localhost) through `multihost_init` — the same entry the
+sharded engine calls — so a regression in init, global-mesh
+construction, or the cross-host psum fails here, not on a pod.
+"""
+
+import os
+import pathlib
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_REPO = pathlib.Path(__file__).parent.parent
+_WORKER = pathlib.Path(__file__).parent / "multihost_worker.py"
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.slow
+def test_two_process_distributed_psum():
+    port = _free_port()
+    addr = f"127.0.0.1:{port}"
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=2",
+        PYTHONPATH=f"{_REPO}:{os.environ.get('PYTHONPATH', '')}",
+    )
+    procs = [subprocess.Popen([sys.executable, str(_WORKER), str(i), addr],
+                              env=env, stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, text=True)
+             for i in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=180)
+            outs.append(out)
+    finally:
+        for p in procs:
+            p.kill()
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {i} failed:\n{out}"
+        assert "MULTIHOST_OK" in out, f"worker {i} output:\n{out}"
+
+
+def test_multihost_init_single_process_auto_is_noop():
+    """Auto mode on a single host: explicit False, nothing mutated."""
+    import jax
+
+    from onix.parallel.mesh import multihost_init
+
+    assert multihost_init() is False
+    assert jax.process_count() == 1
+
+
+def test_multihost_init_fails_loudly_on_bad_explicit_config():
+    """An explicit coordinator that cannot be reached must fail LOUDLY
+    — the runtime aborts the process (XLA's distributed client
+    LOG(FATAL)s on a registration deadline). What it must never do is
+    the round-2 failure mode: swallow the error and let a pod job run
+    single-process on 1/N of the data."""
+    port = _free_port()
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=f"{_REPO}:{os.environ.get('PYTHONPATH', '')}")
+    code = (
+        "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+        "from onix.parallel.mesh import multihost_init\n"
+        "try:\n"
+        f"    multihost_init(coordinator='127.0.0.1:{port}',"
+        " num_processes=2, process_id=1, init_timeout_s=5)\n"
+        "except Exception as e:\n"
+        "    print('RAISED', type(e).__name__)\n"
+        "else:\n"
+        "    print('NO_RAISE', jax.process_count())\n"
+    )
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=120)
+    # Either a Python-level raise or a fatal runtime abort is fine;
+    # silently continuing single-process is the regression.
+    assert "NO_RAISE" not in out.stdout, out.stdout + out.stderr
+    assert out.returncode != 0 or "RAISED" in out.stdout, (
+        out.stdout + out.stderr)
