@@ -38,8 +38,13 @@ def score_events(theta: jax.Array, phi_wk: jax.Array,
     100k-event flow rehearsal (docs/OVERLAP.md).
     """
     if theta.ndim == 2:
-        return jnp.sum(theta[doc_ids] * phi_wk[word_ids], axis=-1)
-    p = jnp.sum(theta[:, doc_ids] * phi_wk[:, word_ids], axis=-1)
+        # Upcast AFTER the gather: with bf16 tables-at-rest the gather
+        # moves half the bytes and the dot still accumulates in f32
+        # (free when the tables are already f32).
+        return jnp.sum(theta[doc_ids].astype(jnp.float32)
+                       * phi_wk[word_ids].astype(jnp.float32), axis=-1)
+    p = jnp.sum(theta[:, doc_ids].astype(jnp.float32)
+                * phi_wk[:, word_ids].astype(jnp.float32), axis=-1)
     return jnp.exp(jnp.log(jnp.maximum(p, 1e-38)).mean(axis=0))
 
 
@@ -90,14 +95,24 @@ def _merge_bottom_k(best_s, best_i, s, idx, max_results: int):
 
 
 def _scan_bottom_k(arrays: tuple, n: int, score_chunk, *,
-                   max_results: int, chunk: int) -> TopK:
+                   max_results: int, chunk: int,
+                   merge_buffer: int | None = None) -> TopK:
     """Shared running-bottom-k machinery: chunk the input arrays
     together, score each chunk with `score_chunk(*chunk_cols)` (which
     must already return +inf for rows it rejects), mask the tail pad by
     global index, and merge a running bottom-`max_results` through one
     `lax.scan`. Every selection entry point (bottom_k, top_suspicious,
     table_pair_bottom_k) is this scan plus a per-chunk score function —
-    a fix to the selection logic lands in exactly one place."""
+    a fix to the selection logic lands in exactly one place.
+
+    `merge_buffer=B` turns on the two-phase merge: count the chunk's
+    candidates (scores below the running k-th best); when they fit in
+    B, merge only the chunk's bottom-B instead of concatenating the
+    whole chunk into top_k. Once the threshold tightens (a few chunks
+    in), expected candidates per chunk fall toward k/chunks_seen, so
+    the steady-state merge is O(k+B), not O(k+chunk). EXACT either way:
+    count > B falls back to the full merge inside the same lax.cond —
+    never a lossy cap (PERF.md lever 4)."""
     if n == 0:     # static shape: resolved at trace time, not per-call
         return _empty_topk(max_results)
     cols, base, n_chunks, chunk = _chunked_cols(arrays, n, chunk)
@@ -107,7 +122,22 @@ def _scan_bottom_k(arrays: tuple, n: int, score_chunk, *,
         *cs, ci = xs
         idx = ci * chunk + base
         s = jnp.where(idx < n, score_chunk(*cs), jnp.inf)
-        return _merge_bottom_k(best_s, best_i, s, idx, max_results), None
+        if merge_buffer is None or merge_buffer >= chunk:
+            return _merge_bottom_k(best_s, best_i, s, idx, max_results), None
+
+        def small_merge():
+            # All candidates fit the buffer: the chunk's bottom-B is a
+            # superset of them (anything outside is >= the threshold
+            # and loses to an incumbent at the final top_k's tie rule).
+            neg, pos = jax.lax.top_k(-s, merge_buffer)
+            return _merge_bottom_k(best_s, best_i, -neg, idx[pos],
+                                   max_results)
+
+        n_cand = jnp.sum(s < best_s[-1])    # running k-th best
+        return jax.lax.cond(
+            n_cand <= merge_buffer, small_merge,
+            lambda: _merge_bottom_k(best_s, best_i, s, idx, max_results)), \
+            None
 
     (out_s, out_i), _ = jax.lax.scan(
         step, tuple(_empty_topk(max_results)),
@@ -115,13 +145,15 @@ def _scan_bottom_k(arrays: tuple, n: int, score_chunk, *,
     return _finalize_topk(out_s, out_i)
 
 
-@functools.partial(jax.jit, static_argnames=("max_results", "chunk"))
+@functools.partial(jax.jit,
+                   static_argnames=("max_results", "chunk", "merge_buffer"))
 def bottom_k(
     scores: jax.Array,        # float32 [N] precomputed event scores
     *,
     tol: float,
     max_results: int,
     chunk: int = 1 << 20,
+    merge_buffer: int | None = None,
 ) -> TopK:
     """Bottom-`max_results` among precomputed scores < tol — the selection
     half of `top_suspicious` for callers that aggregate scores before
@@ -129,10 +161,11 @@ def bottom_k(
     return _scan_bottom_k(
         (scores,), scores.shape[0],
         lambda sc: jnp.where(sc < tol, sc, jnp.inf),
-        max_results=max_results, chunk=chunk)
+        max_results=max_results, chunk=chunk, merge_buffer=merge_buffer)
 
 
-@functools.partial(jax.jit, static_argnames=("max_results", "chunk"))
+@functools.partial(jax.jit, static_argnames=("max_results", "chunk",
+                                             "merge_buffer", "table_dtype"))
 def top_suspicious(
     theta: jax.Array,
     phi_wk: jax.Array,
@@ -143,6 +176,8 @@ def top_suspicious(
     tol: float,
     max_results: int,
     chunk: int = 1 << 20,
+    merge_buffer: int | None = None,
+    table_dtype: str | None = None,
 ) -> TopK:
     """Bottom-`max_results` events by score among those with score < tol.
 
@@ -168,14 +203,24 @@ def top_suspicious(
     slower on chip). docs/PERF.md "round-2 selection experiments" has
     the full table; don't rebuild it without a fundamentally tighter
     bound.
+
+    `merge_buffer` enables the exact two-phase merge (_scan_bottom_k);
+    `table_dtype="bfloat16"` stores the gathered tables at half width
+    (measured 1.52x on the materialization-bound r2 form — scores then
+    round at bf16 precision, so keep it off where the 0.95 overlap bar
+    is being judged unless the overlap study revalidates it).
     """
+    if table_dtype is not None:
+        theta = theta.astype(table_dtype)
+        phi_wk = phi_wk.astype(table_dtype)
 
     def score_chunk(dc, wc, mc):
         s = _subscan_scores(theta, phi_wk, dc, wc)
         return jnp.where((mc > 0) & (s < tol), s, jnp.inf)
 
     return _scan_bottom_k((doc_ids, word_ids, mask), doc_ids.shape[0],
-                          score_chunk, max_results=max_results, chunk=chunk)
+                          score_chunk, max_results=max_results, chunk=chunk,
+                          merge_buffer=merge_buffer)
 
 
 def _subscan_scores(theta, phi_wk, dc, wc):
@@ -228,7 +273,8 @@ def _gather_scores(table_flat: jax.Array, d: jax.Array, w: jax.Array,
 # 512 MB — small next to 16 GB HBM, large enough for D=200k x V=640.
 TABLE_MAX_ELEMS = 1 << 27
 
-@functools.partial(jax.jit, static_argnames=("max_results", "chunk"))
+@functools.partial(jax.jit,
+                   static_argnames=("max_results", "chunk", "merge_buffer"))
 def table_pair_bottom_k(
     table_flat: jax.Array,   # float32 [D*V] from score_table().ravel()
     idx_src: jax.Array,      # int32 [N] flat index d_src*V + w per event
@@ -237,6 +283,7 @@ def table_pair_bottom_k(
     tol: float,
     max_results: int,
     chunk: int = 1 << 21,
+    merge_buffer: int | None = None,
 ) -> TopK:
     """Fused flow-event scoring + selection, entirely on device: per
     event, score = min over its two tokens (src-doc and dst-doc gather
@@ -252,10 +299,12 @@ def table_pair_bottom_k(
         return jnp.where(s < tol, s, jnp.inf)
 
     return _scan_bottom_k((idx_src, idx_dst), idx_src.shape[0],
-                          score_chunk, max_results=max_results, chunk=chunk)
+                          score_chunk, max_results=max_results, chunk=chunk,
+                          merge_buffer=merge_buffer)
 
 
-@functools.partial(jax.jit, static_argnames=("max_results", "chunk"))
+@functools.partial(jax.jit,
+                   static_argnames=("max_results", "chunk", "merge_buffer"))
 def table_bottom_k(
     table_flat: jax.Array,   # float32 [D*V] from score_table().ravel()
     idx: jax.Array,          # int32 [N] flat index d*V + w per event
@@ -263,6 +312,7 @@ def table_bottom_k(
     tol: float,
     max_results: int,
     chunk: int = 1 << 21,
+    merge_buffer: int | None = None,
 ) -> TopK:
     """Fused single-token scoring + selection, entirely on device: the
     dns/proxy analog of `table_pair_bottom_k` (one document — the
@@ -274,7 +324,8 @@ def table_bottom_k(
         return jnp.where(s < tol, s, jnp.inf)
 
     return _scan_bottom_k((idx,), idx.shape[0], score_chunk,
-                          max_results=max_results, chunk=chunk)
+                          max_results=max_results, chunk=chunk,
+                          merge_buffer=merge_buffer)
 
 
 # Dedup pays once the device scan shrinks enough to cover the host-side

@@ -22,6 +22,8 @@ const COLS = {
           "uripath", "respcode", "useragent", "geo_country", "rep"],
 };
 const REP_COLS = new Set(["rep", "src_rep", "dst_rep"]);
+// Per-row event-time field (the same columns engine.py's summary uses).
+const TIME_KEYS = { flow: "treceived", dns: "frame_time", proxy: "p_time" };
 // Which row fields correspond to a graph edge's (source, target) — must
 // match onix/oa/engine.py _graph().
 const EDGE_KEYS = {
@@ -252,6 +254,64 @@ function showDrill(link) {
   openDrill(`${link.source} → ${link.target}`, rows);
 }
 
+function hourFracOf(row) {
+  // "2016-07-08 13:45:00" or "13:45:00" -> 13.75; null when unparsable.
+  const m = String(row[TIME_KEYS[TYPE]] ?? "").match(/(\d{1,2}):(\d{2})/);
+  return m ? Number(m[1]) + Number(m[2]) / 60 : null;
+}
+
+function renderEventTimeline(rows) {
+  // Per-EVENT timeline (VERDICT r2 next #9): every suspicious row as a
+  // dot at (time of day, score on a log axis). The hourly bars above
+  // aggregate; this is the analyst's beacon-spotting view — periodic
+  // dots in a horizontal line are a beacon, a burst is an exfil
+  // window. Click a dot to open that event in the drill panel.
+  const box = document.getElementById("event-timeline");
+  const pts = rows.map(r => ({ r, h: hourFracOf(r), s: Number(r.score) }))
+    .filter(p => p.h !== null && p.s > 0);
+  if (!pts.length) {
+    box.replaceChildren(el("div", { class: "empty" }, "no events"));
+    return;
+  }
+  const svgW = 460, svgH = 150, padL = 34, padB = 16, padT = 6;
+  const svg = svgEl("svg", { viewBox: `0 0 ${svgW} ${svgH}`, width: "100%" });
+  const lo = Math.min(...pts.map(p => p.s)), hi = Math.max(...pts.map(p => p.s));
+  const ll = Math.log(lo), lh = Math.log(hi * 1.0001);
+  const yOf = s => padT + (svgH - padT - padB)
+    * (1 - (Math.log(s) - ll) / (lh - ll || 1));
+  const xOf = h => padL + (svgW - padL - 6) * h / 24;
+  for (let hh = 0; hh <= 24; hh += 6) {
+    svg.append(svgEl("line", { class: "grid", x1: xOf(hh), x2: xOf(hh),
+                               y1: padT, y2: svgH - padB }));
+    const t = svgEl("text", { x: xOf(hh) - 8, y: svgH - 3 });
+    t.textContent = `${String(hh).padStart(2, "0")}:00`;
+    svg.append(t);
+  }
+  [lo, hi].forEach(s => {
+    const t = svgEl("text", { x: 1, y: yOf(s) + 3 });
+    t.textContent = fmtScore(s);
+    svg.append(t);
+  });
+  // Hot = the lowest-score decile — the same "most suspicious first"
+  // emphasis as the graph's hot edges.
+  const sorted = [...pts].sort((a, b) => a.s - b.s);
+  const hotCut = sorted[Math.max(0, Math.floor(sorted.length / 10) - 1)].s;
+  for (const p of pts) {
+    const c = svgEl("circle", {
+      class: "evt" + (p.s <= hotCut ? " hot" : ""),
+      cx: xOf(p.h).toFixed(1), cy: yOf(p.s).toFixed(1), r: 2.5,
+    });
+    const t = svgEl("title");
+    t.textContent = `rank ${p.r.rank} · score ${fmtScore(p.s)} · ` +
+      `${p.r[TIME_KEYS[TYPE]]}`;
+    c.append(t);
+    c.addEventListener("click", () => openDrill(`event rank ${p.r.rank}`,
+                                                [p.r]));
+    svg.append(c);
+  }
+  box.replaceChildren(svg);
+}
+
 function sparkline(values, w = 120, h = 26) {
   const svg = svgEl("svg", { viewBox: `0 0 ${w} ${h}`, class: "spark" });
   const max = Math.max(1, ...values);
@@ -382,11 +442,26 @@ async function load() {
   labels.clear();
   document.getElementById("save").disabled = true;
   document.getElementById("drill-panel").hidden = true;
+  // In-dashboard notebook for the current datatype (the reference
+  // hosts investigation notebooks next to the dashboards): installed
+  // by `onix setup` under the data dir, served at /data/notebooks/.
+  const nb = document.getElementById("notebook-link");
+  nb.href = `/data/notebooks/${TYPE}_threat_investigation.ipynb`;
+  nb.setAttribute("download", `${TYPE}_threat_investigation.ipynb`);
   renderTiles(sum);
   renderBars("hist", sum.histogram.counts,
     (i, v) => `bin ${i}: ${v} events`);
   renderBars("timeline", sum.timeline_hourly,
     (i, v) => `${String(i).padStart(2, "0")}:00: ${v} events`);
+  // Hour drill-down: a bar click opens that hour's suspicious rows.
+  document.querySelectorAll("#timeline rect.bar").forEach((bar, hh) => {
+    bar.classList.add("clickable");
+    bar.addEventListener("click", () => {
+      const rows = allRows.filter(r => Math.floor(hourFracOf(r) ?? -1) === hh);
+      openDrill(`hour ${String(hh).padStart(2, "0")}:00`, rows);
+    });
+  });
+  renderEventTimeline(rows);
   renderGraph(graph);
   renderStoryboard(story);
   renderTable(rows, date);

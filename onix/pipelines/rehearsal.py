@@ -39,19 +39,20 @@ JUDGED_BAR = 0.95
 def run_rehearsal(n_events: int = 100_000, n_sweeps: int = 300,
                   n_chains: int = 8, n_oracle_runs: int = 8,
                   n_topics: int = 20, alpha: float = 0.5, eta: float = 0.05,
-                  seed: int = 5, out_path=None) -> dict:
+                  seed: int = 5, datatype: str = "flow",
+                  out_path=None) -> dict:
     from onix import oracle
     from onix.config import LDAConfig
     from onix.models.lda_gibbs import GibbsLDA
     from onix.models.scoring import score_all
     from onix.pipelines.corpus_build import build_corpus
-    from onix.pipelines.synth import synth_flow_day
-    from onix.pipelines.words import flow_words
+    from onix.pipelines.synth import SYNTH
+    from onix.pipelines.words import WORD_FNS
 
-    day, planted = synth_flow_day(
+    day, planted = SYNTH[datatype](
         n_events=n_events, n_hosts=max(120, n_events // 250),
         n_anomalies=max(30, n_events // 650), seed=seed)
-    bundle = build_corpus(flow_words(day))
+    bundle = build_corpus(WORD_FNS[datatype](day))
     corpus = bundle.corpus
     sc = corpus.to_doc_word_counts()
 
@@ -115,6 +116,7 @@ def run_rehearsal(n_events: int = 100_000, n_sweeps: int = 300,
             for kk in (100, 500, 1000, 2000)},
         "planted_hit_at_k": hits,
         "config": {
+            "datatype": datatype,
             "n_events": n_events, "n_docs": int(corpus.n_docs),
             "n_vocab": int(corpus.n_vocab),
             "n_tokens": int(corpus.n_tokens), "n_topics": n_topics,
@@ -139,10 +141,14 @@ def main(argv=None) -> int:
     ap.add_argument("--sweeps", type=int, default=300)
     ap.add_argument("--chains", type=int, default=8)
     ap.add_argument("--oracle-runs", type=int, default=8)
+    ap.add_argument("--datatype", choices=("flow", "dns", "proxy"),
+                    default="flow")
+    ap.add_argument("--seed", type=int, default=5)
     ap.add_argument("--out", default=None)
     args = ap.parse_args(argv)
     r = run_rehearsal(n_events=args.events, n_sweeps=args.sweeps,
                       n_chains=args.chains, n_oracle_runs=args.oracle_runs,
+                      datatype=args.datatype, seed=args.seed,
                       out_path=args.out)
     print(json.dumps(r, indent=2))
     return 0

@@ -72,3 +72,16 @@ peaked_p = rng.dirichlet(np.full(K, 0.05), size=N_VOCAB).astype(np.float32)
 a = run("uniform diffuse, default (subscan fused)", diffuse_t, diffuse_p)
 b = run("uniform diffuse, chunk=1<<22", diffuse_t, diffuse_p, chunk=1 << 22)
 c = run("peaked (fitted-like), default", peaked_t, peaked_p)
+
+# Round-3 levers (both EXACT unless noted; see scoring.py docstrings):
+# two-phase candidate-buffer merge, bf16 tables-at-rest, and the combo.
+d = run("uniform, merge_buffer=128", diffuse_t, diffuse_p,
+        merge_buffer=128)
+e = run("uniform, merge_buffer=128, chunk=1<<22", diffuse_t, diffuse_p,
+        merge_buffer=128, chunk=1 << 22)
+f = run("uniform, bf16 tables (APPROX at bf16 rounding)", diffuse_t,
+        diffuse_p, table_dtype="bfloat16")
+g = run("uniform, bf16 + merge_buffer=128", diffuse_t, diffuse_p,
+        table_dtype="bfloat16", merge_buffer=128)
+np.testing.assert_array_equal(a, d)   # exactness holds on-chip too
+np.testing.assert_array_equal(b, e)

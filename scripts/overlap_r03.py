@@ -1,0 +1,84 @@
+"""OVERLAP_r03: multi-seed, multi-datatype judged-overlap study.
+
+VERDICT r2 next #3/#4: round 2's artifact was one seed, one datatype,
++0.004 over the bar. This driver runs the full rehearsal pairing
+(onix/pipelines/rehearsal.py) for every (datatype, seed) cell and
+reports the MIN over seeds per datatype — the honest form of the
+judged fidelity metric (BASELINE.json: top-1k overlap vs oracle
+>= 0.95).
+
+    python scripts/overlap_r03.py --out docs/OVERLAP_r03.json
+"""
+import argparse
+import json
+import pathlib
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from onix.pipelines.rehearsal import JUDGED_BAR, run_rehearsal  # noqa: E402
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--events", type=int, default=100_000)
+    ap.add_argument("--sweeps", type=int, default=300)
+    ap.add_argument("--seeds", type=int, nargs="+", default=[5, 17, 41])
+    ap.add_argument("--datatypes", nargs="+",
+                    default=["flow", "dns", "proxy"])
+    ap.add_argument("--out", default="docs/OVERLAP_r03.json")
+    args = ap.parse_args()
+
+    cells = {}
+    t_all = time.monotonic()
+    for dt in args.datatypes:
+        for seed in args.seeds:
+            t = time.monotonic()
+            r = run_rehearsal(n_events=args.events, n_sweeps=args.sweeps,
+                              seed=seed, datatype=dt)
+            cells[f"{dt}/seed{seed}"] = r
+            print(f"[{dt} seed={seed}] jax_vs_oracle={r['jax_vs_oracle']} "
+                  f"ceiling={r['oracle_vs_oracle']} "
+                  f"({time.monotonic() - t:.0f}s)", flush=True)
+            # Checkpoint after every cell so a kill loses nothing.
+            _write(args.out, cells, args, t_all, partial=True)
+    _write(args.out, cells, args, t_all, partial=False)
+    return 0
+
+
+def _write(out, cells, args, t_all, partial):
+    per_dt = {}
+    for dt in args.datatypes:
+        vals = [c["jax_vs_oracle"] for k, c in cells.items()
+                if k.startswith(dt + "/")]
+        ceil = [c["oracle_vs_oracle"] for k, c in cells.items()
+                if k.startswith(dt + "/")]
+        if vals:
+            per_dt[dt] = {
+                "jax_vs_oracle_by_seed": vals,
+                "min_over_seeds": min(vals),
+                "oracle_ceiling_by_seed": ceil,
+                "passes_bar_min": min(vals) >= JUDGED_BAR,
+            }
+    doc = {
+        "metric": "top-1000 suspicious-connect overlap vs oracle, "
+                  "min over seeds",
+        "bar": JUDGED_BAR,
+        "partial": partial,
+        "per_datatype": per_dt,
+        "passes_bar_all": bool(per_dt) and all(
+            v["passes_bar_min"] for v in per_dt.values()) and not partial,
+        "seeds": args.seeds,
+        "n_events": args.events,
+        "n_sweeps": args.sweeps,
+        "wall_seconds_total": round(time.monotonic() - t_all, 1),
+        "cells": cells,
+    }
+    p = pathlib.Path(out)
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(json.dumps(doc, indent=2) + "\n")
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

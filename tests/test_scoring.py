@@ -293,3 +293,106 @@ def test_select_suspicious_events_non_pair_layout():
     tok = score_all(theta, phi, corpus.doc_ids, corpus.word_ids)
     ev = event_scores(bundle, np.asarray(tok), len(day))
     np.testing.assert_allclose(np.asarray(top.scores), ev[idx], rtol=2e-5)
+
+
+def test_merge_buffer_exact_vs_full():
+    """The two-phase candidate-buffer merge must be bit-identical to
+    the full merge — including the adversarial orderings: ascending
+    (every chunk improves), descending (chunk 0 decides everything),
+    heavy ties, and a candidate burst larger than the buffer."""
+    import jax.numpy as jnp
+
+    from onix.models import scoring
+
+    rng = np.random.default_rng(5)
+    n, k = 40_000, 700
+    cases = {
+        "uniform": rng.random(n, np.float32),
+        "ascending": np.sort(rng.random(n, np.float32)),
+        "descending": np.sort(rng.random(n, np.float32))[::-1].copy(),
+        "ties": (rng.integers(0, 40, n) / 40).astype(np.float32),
+        "burst": np.concatenate([np.full(3000, 0.5, np.float32),
+                                 np.full(n - 3000, 0.9, np.float32)
+                                 - rng.random(n - 3000).astype(np.float32)
+                                 * 0.1]),
+    }
+    for name, s in cases.items():
+        ref = scoring.bottom_k(jnp.asarray(s), tol=2.0, max_results=k,
+                               chunk=4096)
+        got = scoring.bottom_k(jnp.asarray(s), tol=2.0, max_results=k,
+                               chunk=4096, merge_buffer=64)
+        np.testing.assert_array_equal(np.asarray(ref.scores),
+                                      np.asarray(got.scores), err_msg=name)
+        # Same score multiset always; identical indices except inside
+        # exact-tie groups, where any member is a correct selection.
+        ref_i, got_i = np.asarray(ref.indices), np.asarray(got.indices)
+        diff = ref_i != got_i
+        if diff.any():
+            assert (np.asarray(ref.scores)[diff]
+                    == np.asarray(got.scores)[diff]).all(), name
+
+
+def test_merge_buffer_exact_on_top_suspicious_and_tables():
+    import jax.numpy as jnp
+
+    from onix.models import scoring
+
+    rng = np.random.default_rng(6)
+    d, v, k = 500, 300, 10
+    theta = rng.dirichlet(np.full(k, 0.5), d).astype(np.float32)
+    phi = rng.dirichlet(np.full(k, 0.5), v).astype(np.float32)
+    n = 30_000
+    di = jnp.asarray(rng.integers(0, d, n).astype(np.int32))
+    wi = jnp.asarray(rng.integers(0, v, n).astype(np.int32))
+    m = jnp.ones(n, jnp.float32)
+    ref = scoring.top_suspicious(theta, phi, di, wi, m, tol=1.0,
+                                 max_results=512, chunk=4096)
+    got = scoring.top_suspicious(theta, phi, di, wi, m, tol=1.0,
+                                 max_results=512, chunk=4096,
+                                 merge_buffer=32)
+    np.testing.assert_array_equal(np.asarray(ref.scores),
+                                  np.asarray(got.scores))
+
+    table = scoring.score_table(jnp.asarray(theta), jnp.asarray(phi)).ravel()
+    idx = di * v + wi
+    r2 = scoring.table_bottom_k(table, idx, tol=1.0, max_results=512,
+                                chunk=4096)
+    g2 = scoring.table_bottom_k(table, idx, tol=1.0, max_results=512,
+                                chunk=4096, merge_buffer=32)
+    np.testing.assert_array_equal(np.asarray(r2.scores),
+                                  np.asarray(g2.scores))
+    r3 = scoring.table_pair_bottom_k(table, idx[:n // 2], idx[n // 2:],
+                                     tol=1.0, max_results=512, chunk=4096)
+    g3 = scoring.table_pair_bottom_k(table, idx[:n // 2], idx[n // 2:],
+                                     tol=1.0, max_results=512, chunk=4096,
+                                     merge_buffer=32)
+    np.testing.assert_array_equal(np.asarray(r3.scores),
+                                  np.asarray(g3.scores))
+
+
+def test_bf16_tables_close_and_flagged():
+    """bf16 tables change scores only at bf16 rounding magnitude; the
+    selection stays a valid bottom-k of the rounded scores."""
+    import jax.numpy as jnp
+
+    from onix.models import scoring
+
+    rng = np.random.default_rng(7)
+    d, v, k = 400, 200, 20
+    theta = rng.dirichlet(np.full(k, 0.5), d).astype(np.float32)
+    phi = rng.dirichlet(np.full(k, 0.5), v).astype(np.float32)
+    n = 20_000
+    di = jnp.asarray(rng.integers(0, d, n).astype(np.int32))
+    wi = jnp.asarray(rng.integers(0, v, n).astype(np.int32))
+    m = jnp.ones(n, jnp.float32)
+    ref = scoring.top_suspicious(theta, phi, di, wi, m, tol=1.0,
+                                 max_results=256, chunk=4096)
+    got = scoring.top_suspicious(theta, phi, di, wi, m, tol=1.0,
+                                 max_results=256, chunk=4096,
+                                 table_dtype="bfloat16")
+    rs, gs = np.asarray(ref.scores), np.asarray(got.scores)
+    np.testing.assert_allclose(gs, rs, rtol=2e-2)
+    # Top sets mostly agree (rounding can swap near-ties at the edge).
+    overlap = len(set(np.asarray(ref.indices).tolist())
+                  & set(np.asarray(got.indices).tolist())) / 256
+    assert overlap > 0.9, overlap

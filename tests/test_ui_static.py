@@ -120,3 +120,41 @@ def test_storyboard_contract():
     assert set(engine._STORY_KEYS) == {"flow", "dns", "proxy"}
     for rel, html in DASHBOARDS.items():
         assert 'id="storyboard"' in html, f"{rel} missing storyboard"
+
+
+def test_event_timeline_contract():
+    """Round-3 per-event timeline: the panel exists on every dashboard,
+    the JS time-field map matches the columns each datatype renders,
+    and dots route clicks through the shared drill panel."""
+    for rel, html in DASHBOARDS.items():
+        assert 'id="event-timeline"' in html, rel
+    # TIME_KEYS fields must be real columns of their datatype's table.
+    tk = dict(re.findall(r'(flow|dns|proxy): "([^"]+)"', JS))
+    assert set(tk) == {"flow", "dns", "proxy"}
+    cols_block = JS[JS.index("const COLS"):JS.index("const REP_COLS")]
+    for t, field in tk.items():
+        row = re.search(rf"{t}: \[([^\]]+)\]", cols_block).group(1)
+        assert f'"{field}"' in row, (t, field)
+    # Dots drill through the one shared panel (no parallel UI path).
+    evt = JS[JS.index("function renderEventTimeline"):]
+    evt = evt[:evt.index("\nfunction ")]
+    assert "openDrill(" in evt
+
+
+def test_notebook_link_matches_generated_filenames():
+    """The in-dashboard notebook link must point at the exact filename
+    notebooks.py generates and setup installs under /data/notebooks/."""
+    from onix.oa import notebooks
+    import pathlib
+    import tempfile
+
+    for rel, html in DASHBOARDS.items():
+        assert 'id="notebook-link"' in html, rel
+    m = re.search(r"/data/notebooks/\$\{TYPE\}([^\s`\"]+)", JS)
+    assert m, "notebook link not built in onix.js"
+    suffix = m.group(1)
+    with tempfile.TemporaryDirectory() as d:
+        written = notebooks.write_notebooks(pathlib.Path(d))
+        names = {p.name for p in written}
+    for t in ("flow", "dns", "proxy"):
+        assert f"{t}{suffix}" in names, (t, suffix, names)
