@@ -15,6 +15,18 @@ import pathlib
 import sys
 import time
 
+import os
+
+import jax
+
+# Force CPU via BOTH the env (for any subprocess) and the live config:
+# the ambient sitecustomize imports jax (pinning the tunneled
+# accelerator platform) before this script runs, so the env var alone
+# is silently ignored and the study would hang on a down tunnel (same
+# trap as tests/conftest.py/bench.py).
+os.environ["JAX_PLATFORMS"] = "cpu"
+jax.config.update("jax_platforms", "cpu")
+
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
 from onix.pipelines.rehearsal import JUDGED_BAR, run_rehearsal  # noqa: E402
