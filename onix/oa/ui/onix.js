@@ -36,6 +36,8 @@ let allRows = [];           // current date's suspicious rows
 let currentDate = null;
 let graphMode = "chord";    // "chord" | "list"
 let lastGraph = null;
+let tableSort = null;       // {col, dir} | null (null = rank order)
+let tableFilter = "";       // substring filter over every rendered cell
 
 function hashDate() {
   const m = location.hash.match(/date=(\d{4}-\d{2}-\d{2})/);
@@ -357,12 +359,66 @@ function renderStoryboard(sb) {
   }));
 }
 
+function viewRows(rows) {
+  // Analyst table controls: substring filter over the rendered cells,
+  // then column sort (numeric when both sides parse). Applied to the
+  // MAIN table only — drill panels show their caller's exact rows.
+  let out = rows;
+  if (tableFilter) {
+    const q = tableFilter.toLowerCase();
+    const cols = COLS[TYPE];
+    out = out.filter(r => cols.some(
+      c => String(r[c] ?? "").toLowerCase().includes(q)));
+  }
+  if (tableSort) {
+    const { col, dir } = tableSort;
+    // ONE comparison mode for the whole column (numeric only when every
+    // non-empty cell parses — a per-pair mode switch is intransitive and
+    // makes Array.sort's result unspecified); empty cells always sort
+    // last regardless of direction.
+    const numeric = out.every(r => {
+      const v = r[col];
+      return v == null || v === "" || !Number.isNaN(Number(v));
+    });
+    out = [...out].sort((a, b) => {
+      const x = a[col], y = b[col];
+      const xm = x == null || x === "", ym = y == null || y === "";
+      if (xm || ym) return xm && ym ? a.rank - b.rank : (xm ? 1 : -1);
+      const cmp = numeric ? Number(x) - Number(y)
+                          : String(x).localeCompare(String(y));
+      return dir * cmp || a.rank - b.rank;
+    });
+  }
+  return out;
+}
+
+function renderMainTable() {
+  const shown = viewRows(allRows);
+  const counter = document.getElementById("row-count");
+  counter.textContent = shown.length === allRows.length
+    ? `${allRows.length} rows`
+    : `${shown.length} / ${allRows.length} rows`;
+  renderTable(shown, currentDate);
+}
+
 function renderTable(rows, date, table = null) {
+  const isMain = table === null;
   table = table || document.getElementById("sus-table");
   const cols = COLS[TYPE].filter(c => rows.length === 0 || c in rows[0]);
   const thead = el("thead");
   const hr = el("tr");
-  cols.forEach(c => hr.append(el("th", {}, c)));
+  cols.forEach(c => {
+    const mark = (isMain && tableSort && tableSort.col === c)
+      ? (tableSort.dir > 0 ? " ▲" : " ▼") : "";
+    const th = el("th", isMain ? { class: "sortable" } : {}, c + mark);
+    if (isMain) th.addEventListener("click", () => {
+      tableSort = (tableSort && tableSort.col === c && tableSort.dir > 0)
+        ? { col: c, dir: -1 }
+        : (tableSort && tableSort.col === c) ? null : { col: c, dir: 1 };
+      renderMainTable();
+    });
+    hr.append(th);
+  });
   hr.append(el("th", {}, "sev"));
   thead.append(hr);
   const tbody = el("tbody");
@@ -440,6 +496,11 @@ async function load() {
   allRows = rows;
   currentDate = date;
   labels.clear();
+  tableSort = null;
+  tableFilter = "";
+  const filt = document.getElementById("table-filter");
+  filt.value = "";
+  filt.oninput = () => { tableFilter = filt.value.trim(); renderMainTable(); };
   document.getElementById("save").disabled = true;
   document.getElementById("drill-panel").hidden = true;
   // In-dashboard notebook for the current datatype (the reference
@@ -464,7 +525,7 @@ async function load() {
   renderEventTimeline(rows);
   renderGraph(graph);
   renderStoryboard(story);
-  renderTable(rows, date);
+  renderMainTable();
 }
 
 window.addEventListener("hashchange", load);

@@ -138,11 +138,33 @@ def multihost_init(coordinator: str | None = None,
         jax.distributed.initialize()
     except (RuntimeError, ValueError) as e:
         msg = str(e).lower()
-        if ("detect" in msg or "coordinator_address" in msg
-                or "single-process" in msg):
+        # "must be called before any JAX calls": the backend is already
+        # up in this process. In a genuinely solo session (the sharded
+        # engine invoked mid-process, tests) that is a benign no-op —
+        # but if the environment says this process is one rank of a
+        # multi-process job, running solo would silently train on 1/N
+        # of the data (the round-2 bug), so it must still RAISE.
+        solo_shaped = ("detect" in msg or "coordinator_address" in msg
+                       or "single-process" in msg or "called before" in msg)
+        if solo_shaped and not _cluster_env_says_multiprocess():
             import sys
             print("multihost_init: no multi-host environment detected; "
                   f"running single-process ({e})", file=sys.stderr)
             return False
         raise
     return jax.process_count() > 1
+
+
+def _cluster_env_says_multiprocess() -> bool:
+    """True when launcher env vars claim >1 processes — the guard that
+    keeps auto-mode's solo fallback from swallowing a real pod/cluster
+    rank's init failure."""
+    import os
+    for var in ("JAX_NUM_PROCESSES", "SLURM_NTASKS",
+                "OMPI_COMM_WORLD_SIZE", "PMI_SIZE"):
+        try:
+            if int(os.environ.get(var, "1")) > 1:
+                return True
+        except ValueError:
+            pass
+    return False

@@ -54,12 +54,18 @@ def test_two_process_distributed_psum():
 
 
 def test_multihost_init_single_process_auto_is_noop():
-    """Auto mode on a single host: explicit False, nothing mutated."""
+    """Auto mode on a single host: explicit False, nothing mutated —
+    both before AND after the XLA backend is up (jax.distributed
+    refuses to initialize post-backend with a different error; auto
+    mode must treat that as solo too, since a pod launcher would have
+    initialized before first backend use)."""
     import jax
 
     from onix.parallel.mesh import multihost_init
 
     assert multihost_init() is False
+    jax.devices()                      # force backend init
+    assert multihost_init() is False   # post-backend: still a solo no-op
     assert jax.process_count() == 1
 
 

@@ -158,3 +158,20 @@ def test_notebook_link_matches_generated_filenames():
         names = {p.name for p in written}
     for t in ("flow", "dns", "proxy"):
         assert f"{t}{suffix}" in names, (t, suffix, names)
+
+
+def test_table_sort_filter_contract():
+    """Round-3 table controls: filter input + row counter exist on all
+    dashboards; sorting is main-table-only (drill panels keep caller
+    order) and filter/sort flow through ONE view function so the label
+    Save path still sees the same shared row objects."""
+    for rel, html in DASHBOARDS.items():
+        assert 'id="table-filter"' in html, rel
+        assert 'id="row-count"' in html, rel
+    assert "function viewRows" in JS
+    # Drill renders pass an explicit table and must never get headers
+    # that mutate the main table's sort state.
+    assert re.search(r"const isMain = table === null", JS)
+    # The filter re-render path goes through renderMainTable (which
+    # recomputes the counter), not a bare renderTable.
+    assert "renderMainTable();" in JS
