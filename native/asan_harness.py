@@ -140,6 +140,26 @@ def nfcapd_blob(compressed=False, bad_version=False, torn=False,
     return out[:len(out) - 9] if torn else out
 
 
+def pcapng_blob(truncate=0, bad_bom=False):
+    """Minimal pcapng: SHB + IDB(ethernet) + one EPB wrapping the same
+    DNS frame dns_pcap_blob emits."""
+    frame = dns_pcap_blob()[40:]      # strip pcap global+record headers
+
+    def block(btype, body):
+        pad = (-len(body)) % 4
+        total = 12 + len(body) + pad
+        return (struct.pack("<II", btype, total) + body + b"\0" * pad
+                + struct.pack("<I", total))
+
+    bom = 0xDEADBEEF if bad_bom else 0x1A2B3C4D
+    ts = 1467979200 * 1_000_000      # microsecond units (default resol)
+    out = block(0x0A0D0D0A, struct.pack("<IHHq", bom, 1, 0, -1))
+    out += block(1, struct.pack("<HHI", 1, 0, 0))
+    out += block(6, struct.pack("<IIIII", 0, ts >> 32, ts & 0xFFFFFFFF,
+                                len(frame), len(frame)) + frame)
+    return out[:len(out) - truncate] if truncate else out
+
+
 def dns_pcap_blob(truncate=0, ipv6=False, ext_headers=False):
     """One-response DNS pcap (Ethernet/IPv4 or /IPv6/UDP), optionally
     torn; ext_headers prepends a hop-by-hop extension header to the v6
@@ -191,6 +211,10 @@ def main() -> int:
         ("not a pcap", b"\x00" * 48, 1),
         ("header only", dns_pcap_blob()[:24], 0),   # empty capture is fine
         ("tiny file", b"\xa1", 1),
+        # pcapng container: happy, torn trailer, bad byte-order magic
+        ("pcapng one response", pcapng_blob(), 0),
+        ("pcapng torn block", pcapng_blob(truncate=5), 1),
+        ("pcapng bad byte-order magic", pcapng_blob(bad_bom=True), 1),
     ]:
         p = tmp / "cap.pcap"
         p.write_bytes(blob)

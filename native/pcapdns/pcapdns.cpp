@@ -13,7 +13,10 @@
 // (onix/ingest/pcap.py).
 //
 // Format coverage: classic pcap (magic a1b2c3d4 / d4c3b2a1, plus the
-// a1b23c4d nanosecond variant), Ethernet II with optional single
+// a1b23c4d nanosecond variant) AND pcapng (Wireshark's default save
+// format: SHB/IDB/EPB/SPB blocks, both byte orders, per-interface
+// linktype + if_tsresol, unknown blocks skipped whole),
+// Ethernet II with optional single
 // 802.1Q VLAN tag, IPv4 (any IHL, non-fragmented) and IPv6 (RFC 8200,
 // chainable extension headers walked, addresses printed in RFC 5952
 // canonical form), UDP src or dst port 53. Question-section names are
@@ -26,6 +29,7 @@
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
+#include <cmath>
 #include <string>
 #include <vector>
 
@@ -112,11 +116,89 @@ bool qname(const uint8_t* dns, size_t dns_len, size_t* off,
   return true;
 }
 
-}  // namespace
+// Process one Ethernet frame; emit a TSV row if it is a UDP DNS
+// response. Returns 1 when a row was written, 0 otherwise. Shared by
+// the classic-pcap and pcapng walkers.
+int process_frame(const uint8_t* pkt, size_t incl, uint32_t orig,
+                  double ts, FILE* out) {
+  // Ethernet II (+ optional one 802.1Q tag)
+  if (incl < 14) return 0;
+  size_t l2 = 12;
+  uint16_t etype = be16(pkt + l2);
+  l2 += 2;
+  if (etype == 0x8100) {
+    if (incl < l2 + 4) return 0;
+    etype = be16(pkt + l2 + 2);
+    l2 += 4;
+  }
+  const uint8_t* udp;
+  char a[46], b[46];
+  if (etype == 0x0800) {            // IPv4
+    if (incl < l2 + 20) return 0;
+    const uint8_t* ip = pkt + l2;
+    if ((ip[0] >> 4) != 4) return 0;
+    const size_t ihl = (size_t)(ip[0] & 0x0F) * 4;
+    if (ihl < 20 || incl < l2 + ihl + 8) return 0;
+    if (ip[9] != 17) return 0;      // UDP
+    const uint16_t frag = be16(ip + 6);
+    if (frag & 0x1FFF) return 0;    // non-first fragment
+    ip_str(((uint32_t)ip[12] << 24) | (ip[13] << 16) | (ip[14] << 8) |
+               ip[15], a);
+    ip_str(((uint32_t)ip[16] << 24) | (ip[17] << 16) | (ip[18] << 8) |
+               ip[19], b);
+    udp = ip + ihl;
+  } else if (etype == 0x86DD) {     // IPv6 (RFC 8200)
+    if (incl < l2 + 40) return 0;
+    const uint8_t* ip6 = pkt + l2;
+    if ((ip6[0] >> 4) != 6) return 0;
+    uint8_t nh = ip6[6];
+    size_t l3 = 40;
+    // Walk chainable extension headers (hop-by-hop 0, routing 43,
+    // destination options 60 — all share the (next, len8) shape);
+    // fragments and anything else end the walk.
+    for (int hops = 0;
+         hops < 4 && (nh == 0 || nh == 43 || nh == 60); ++hops) {
+      if (incl < l2 + l3 + 8) { nh = 0xFF; break; }
+      const uint8_t* eh = pkt + l2 + l3;
+      nh = eh[0];
+      l3 += ((size_t)eh[1] + 1) * 8;
+    }
+    if (nh != 17) return 0;         // UDP
+    if (incl < l2 + l3 + 8) return 0;
+    ip6_str(ip6 + 8, a);
+    ip6_str(ip6 + 24, b);
+    udp = ip6 + l3;
+  } else {
+    return 0;                       // other L3
+  }
+  const uint16_t sport = be16(udp);
+  const uint16_t dport = be16(udp + 2);
+  if (sport != 53 && dport != 53) return 0;
+  const size_t udp_len = be16(udp + 4);
+  if (udp_len < 8 || (size_t)(udp - pkt) + udp_len > incl) return 0;
 
-extern "C" int64_t pcapdns_extract(const uint8_t* buf, int64_t len,
-                                   FILE* out) {
-  if (len < 24) return -1;
+  const uint8_t* dns = udp + 8;
+  const size_t dns_len = udp_len - 8;
+  if (dns_len < 12) return 0;
+  const uint16_t flags = be16(dns + 2);
+  if (!(flags & 0x8000)) return 0;  // responses (QR=1) only
+  const uint16_t qdcount = be16(dns + 4);
+  if (qdcount < 1) return 0;
+  size_t qoff = 12;
+  std::string name;
+  if (!qname(dns, dns_len, &qoff, &name)) return 0;
+  if (qoff + 4 > dns_len) return 0;
+  const uint16_t qtype = be16(dns + qoff);
+  const uint16_t rcode = flags & 0x000F;
+
+  std::fprintf(out, "%.6f\t%u\t%s\t%s\t%s\t%u\t%u\n", ts, orig, a, b,
+               name.c_str(), qtype, rcode);
+  return 1;
+}
+
+// Classic pcap: fixed 24-byte global header + 16-byte per-record
+// headers.
+int64_t walk_pcap(const uint8_t* buf, int64_t len, FILE* out) {
   const uint32_t magic_raw = rd32(buf, false);
   bool swap, nanos;
   switch (magic_raw) {
@@ -137,86 +219,99 @@ extern "C" int64_t pcapdns_extract(const uint8_t* buf, int64_t len,
     const uint32_t orig = rd32(buf + off + 12, swap);
     off += 16;
     if (incl > 1 << 22 || off + incl > (size_t)len) return -1;  // torn file
-    const uint8_t* pkt = buf + off;
-    off += incl;
-
-    // Ethernet II (+ optional one 802.1Q tag)
-    if (incl < 14) continue;
-    size_t l2 = 12;
-    uint16_t etype = be16(pkt + l2);
-    l2 += 2;
-    if (etype == 0x8100) {
-      if (incl < l2 + 4) continue;
-      etype = be16(pkt + l2 + 2);
-      l2 += 4;
-    }
-    const uint8_t* udp;
-    char a[46], b[46];
-    if (etype == 0x0800) {            // IPv4
-      if (incl < l2 + 20) continue;
-      const uint8_t* ip = pkt + l2;
-      if ((ip[0] >> 4) != 4) continue;
-      const size_t ihl = (size_t)(ip[0] & 0x0F) * 4;
-      if (ihl < 20 || incl < l2 + ihl + 8) continue;
-      if (ip[9] != 17) continue;      // UDP
-      const uint16_t frag = be16(ip + 6);
-      if (frag & 0x1FFF) continue;    // non-first fragment
-      ip_str(((uint32_t)ip[12] << 24) | (ip[13] << 16) | (ip[14] << 8) |
-                 ip[15], a);
-      ip_str(((uint32_t)ip[16] << 24) | (ip[17] << 16) | (ip[18] << 8) |
-                 ip[19], b);
-      udp = ip + ihl;
-    } else if (etype == 0x86DD) {     // IPv6 (RFC 8200)
-      if (incl < l2 + 40) continue;
-      const uint8_t* ip6 = pkt + l2;
-      if ((ip6[0] >> 4) != 6) continue;
-      uint8_t nh = ip6[6];
-      size_t l3 = 40;
-      // Walk chainable extension headers (hop-by-hop 0, routing 43,
-      // destination options 60 — all share the (next, len8) shape);
-      // fragments and anything else end the walk.
-      for (int hops = 0;
-           hops < 4 && (nh == 0 || nh == 43 || nh == 60); ++hops) {
-        if (incl < l2 + l3 + 8) { nh = 0xFF; break; }
-        const uint8_t* eh = pkt + l2 + l3;
-        nh = eh[0];
-        l3 += ((size_t)eh[1] + 1) * 8;
-      }
-      if (nh != 17) continue;         // UDP
-      if (incl < l2 + l3 + 8) continue;
-      ip6_str(ip6 + 8, a);
-      ip6_str(ip6 + 24, b);
-      udp = ip6 + l3;
-    } else {
-      continue;                       // other L3
-    }
-    const uint16_t sport = be16(udp);
-    const uint16_t dport = be16(udp + 2);
-    if (sport != 53 && dport != 53) continue;
-    const size_t udp_len = be16(udp + 4);
-    if (udp_len < 8 || (size_t)(udp - pkt) + udp_len > incl) continue;
-
-    const uint8_t* dns = udp + 8;
-    const size_t dns_len = udp_len - 8;
-    if (dns_len < 12) continue;
-    const uint16_t flags = be16(dns + 2);
-    if (!(flags & 0x8000)) continue;  // responses (QR=1) only
-    const uint16_t qdcount = be16(dns + 4);
-    if (qdcount < 1) continue;
-    size_t qoff = 12;
-    std::string name;
-    if (!qname(dns, dns_len, &qoff, &name)) continue;
-    if (qoff + 4 > dns_len) continue;
-    const uint16_t qtype = be16(dns + qoff);
-    const uint16_t rcode = flags & 0x000F;
-
     const double ts = (double)ts_sec +
                       (double)ts_frac / (nanos ? 1e9 : 1e6);
-    std::fprintf(out, "%.6f\t%u\t%s\t%s\t%s\t%u\t%u\n", ts, orig, a, b,
-                 name.c_str(), qtype, rcode);
-    ++emitted;
+    emitted += process_frame(buf + off, incl, orig, ts, out);
+    off += incl;
   }
   return emitted;
+}
+
+// pcapng (the format Wireshark saves by default — without this, a
+// .pcapng capture on a tshark-less host had no ingest path): Section
+// Header Blocks set the byte order, Interface Description Blocks carry
+// per-interface linktype + timestamp resolution (option 9,
+// if_tsresol), Enhanced/Simple Packet Blocks carry the frames. Unknown
+// block types are skipped whole by their declared length.
+struct NgIface {
+  bool ethernet = false;
+  double ts_div = 1e6;      // timestamp units per second (default 1e-6 s)
+};
+
+int64_t walk_pcapng(const uint8_t* buf, int64_t len, FILE* out) {
+  int64_t emitted = 0;
+  size_t off = 0;
+  bool swap = false;
+  std::vector<NgIface> ifaces;
+  uint32_t snaplen_guard = 1 << 22;
+  while (off + 12 <= (size_t)len) {
+    const uint32_t btype = rd32(buf + off, swap);
+    uint32_t blen = rd32(buf + off + 4, swap);
+    if (btype == 0x0A0D0D0Au) {       // SHB: (re)establish byte order
+      const uint32_t bom = rd32(buf + off + 8, false);
+      if (bom == 0x1A2B3C4Du) swap = false;
+      else if (bom == 0x4D3C2B1Au) swap = true;
+      else return -1;
+      blen = rd32(buf + off + 4, swap);
+      ifaces.clear();                 // a new section, new interfaces
+    }
+    if (blen < 12 || (blen & 3) || off + blen > (size_t)len)
+      return -1;                      // torn/corrupt block framing
+    const uint8_t* body = buf + off + 8;
+    const size_t body_len = blen - 12;
+    if (btype == 0x00000001u) {       // IDB
+      if (body_len < 8) return -1;
+      NgIface nif;
+      nif.ethernet = rd16(body, swap) == 1;   // LINKTYPE_ETHERNET
+      // Walk options for if_tsresol (code 9, 1 byte payload).
+      size_t o = 8;
+      while (o + 4 <= body_len) {
+        const uint16_t code = rd16(body + o, swap);
+        const uint16_t olen = rd16(body + o + 2, swap);
+        if (code == 0) break;
+        if (o + 4 + olen > body_len) break;
+        if (code == 9 && olen >= 1) {
+          const uint8_t v = body[o + 4];
+          // Exponents >= 64 would be UB in the shift (and absurd
+          // resolutions anyway) — compute both forms in floating
+          // point, where any exponent is well-defined.
+          nif.ts_div = (v & 0x80) ? std::pow(2.0, (double)(v & 0x7F))
+                                  : std::pow(10.0, (double)v);
+        }
+        o += 4 + (((size_t)olen + 3) & ~(size_t)3);
+      }
+      ifaces.push_back(nif);
+    } else if (btype == 0x00000006u) {  // EPB
+      if (body_len < 20) return -1;
+      const uint32_t ifid = rd32(body, swap);
+      const uint64_t ts_units = ((uint64_t)rd32(body + 4, swap) << 32)
+                                | rd32(body + 8, swap);
+      const uint32_t capt = rd32(body + 12, swap);
+      const uint32_t orig = rd32(body + 16, swap);
+      if (capt > snaplen_guard || 20 + (size_t)capt > body_len) return -1;
+      if (ifid < ifaces.size() && ifaces[ifid].ethernet) {
+        const double ts = (double)ts_units / ifaces[ifid].ts_div;
+        emitted += process_frame(body + 20, capt, orig, ts, out);
+      }
+    } else if (btype == 0x00000003u) {  // SPB (no iface id: iface 0)
+      if (body_len < 4) return -1;
+      const uint32_t orig = rd32(body, swap);
+      const size_t capt = body_len - 4 < orig ? body_len - 4 : orig;
+      if (!ifaces.empty() && ifaces[0].ethernet)
+        emitted += process_frame(body + 4, capt, orig, 0.0, out);
+    }
+    off += blen;
+  }
+  return off == (size_t)len ? emitted : -1;
+}
+
+}  // namespace
+
+extern "C" int64_t pcapdns_extract(const uint8_t* buf, int64_t len,
+                                   FILE* out) {
+  if (len < 24) return -1;
+  if (rd32(buf, false) == 0x0A0D0D0Au) return walk_pcapng(buf, len, out);
+  return walk_pcap(buf, len, out);
 }
 
 #ifndef ONIX_PCAPDNS_NO_MAIN

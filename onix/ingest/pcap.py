@@ -178,3 +178,49 @@ def write_dns_pcap(table: pd.DataFrame, nanos: bool = False) -> bytes:
                            len(pkt), len(pkt))
         out += pkt
     return bytes(out)
+
+
+def write_dns_pcapng(table: pd.DataFrame, *, tsresol: int | None = None,
+                     extra_blocks: bool = True) -> bytes:
+    """Encode dns rows as a pcapng capture (SHB + IDB + one Enhanced
+    Packet Block per row) — Wireshark's default save format, which the
+    native extractor must ingest without tshark. `tsresol` sets the
+    IDB if_tsresol option (power-of-10 exponent; None = the 10^-6
+    default); `extra_blocks` interleaves an unknown block type and a
+    Name Resolution Block the reader must skip whole."""
+    # Reuse the classic writer for the per-row Ethernet frames.
+    pcap = write_dns_pcap(table)
+    frames = []
+    off = 24
+    data = memoryview(pcap)
+    while off + 16 <= len(pcap):
+        ts_sec, ts_usec, incl, orig = struct.unpack_from("<IIII", pcap, off)
+        off += 16
+        frames.append((ts_sec + ts_usec / 1e6, orig,
+                       bytes(data[off:off + incl])))
+        off += incl
+
+    def block(btype: int, body: bytes) -> bytes:
+        pad = (-len(body)) % 4
+        total = 12 + len(body) + pad
+        return (struct.pack("<II", btype, total) + body + b"\0" * pad
+                + struct.pack("<I", total))
+
+    shb = block(0x0A0D0D0A,
+                struct.pack("<IHHq", 0x1A2B3C4D, 1, 0, -1))
+    idb_body = struct.pack("<HHI", 1, 0, 0)          # ethernet, snaplen 0
+    if tsresol is not None:
+        idb_body += struct.pack("<HHB3x", 9, 1, tsresol)   # if_tsresol
+        idb_body += struct.pack("<HH", 0, 0)               # opt_endofopt
+    out = bytearray(shb + block(0x00000001, idb_body))
+    div = 10 ** (tsresol if tsresol is not None else 6)
+    if extra_blocks:
+        out += block(0x0BADBEEF, b"\x55" * 10)       # unknown: skip whole
+    for i, (ts, orig, frame) in enumerate(frames):
+        units = int(round(ts * div))
+        out += block(0x00000006, struct.pack(
+            "<IIIII", 0, units >> 32, units & 0xFFFFFFFF,
+            len(frame), orig) + frame)
+        if extra_blocks and i == 0:
+            out += block(0x00000004, b"\x00" * 8)    # NRB: skip whole
+    return bytes(out)

@@ -23,7 +23,7 @@ from onix.store import Store
 #: `nfcapd.YYYYMMDDhhmm` names but NOT the live in-progress
 #: `nfcapd.current*` file, whose truncated head must never be ingested.
 DEFAULT_PATTERNS = ("*.nf5", "*.tsv", "*.log", "*.csv", "*.pcap",
-                    "nfcapd.2*")
+                    "*.pcapng", "*.cap", "nfcapd.2*")
 
 
 def decode(datatype: str, path: str | pathlib.Path,

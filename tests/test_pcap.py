@@ -153,3 +153,52 @@ def test_pcap_dns_feeds_word_pipeline(tmp_path):
     bundle = build_corpus(dns_words(table))
     assert bundle.corpus.n_tokens == 40
     assert bundle.corpus.n_docs == 9         # distinct client IPs
+
+
+def _extract_rows(data: bytes, tmp_path, name):
+    p = tmp_path / name
+    p.write_bytes(data)
+    tsv = pcap.extract_dns_tsv(p)
+    return [ln.split("\t") for ln in tsv.strip().splitlines()]
+
+
+def test_pcapng_native_matches_pcap(tmp_path):
+    """A pcapng capture (Wireshark's default save format) decodes
+    natively to the SAME rows as the classic pcap of the same traffic —
+    at the default and a nanosecond if_tsresol, with unknown blocks
+    and an NRB interleaved (skipped whole)."""
+    table = _table(40)
+    ref = _extract_rows(pcap.write_dns_pcap(table), tmp_path, "a.pcap")
+    assert len(ref) == 40
+    for tsres in (None, 9):
+        got = _extract_rows(pcap.write_dns_pcapng(table, tsresol=tsres),
+                            tmp_path, f"a{tsres}.pcapng")
+        assert len(got) == 40, tsres
+        for r, g in zip(ref, got):
+            assert r[1:] == g[1:], (tsres, r, g)
+            assert abs(float(r[0]) - float(g[0])) < 1e-3
+
+
+def test_pcapng_torn_and_garbage_rejected(tmp_path):
+    table = _table(8)
+    data = pcap.write_dns_pcapng(table)
+    torn = tmp_path / "torn.pcapng"
+    torn.write_bytes(data[:len(data) - 6])
+    with pytest.raises(ValueError):
+        pcap.extract_dns_tsv(torn)
+    bad = tmp_path / "bad.pcapng"
+    bad.write_bytes(b"\x0a\x0d\x0d\x0a" + b"\xff" * 40)
+    with pytest.raises(ValueError):
+        pcap.extract_dns_tsv(bad)
+
+
+def test_pcapng_routes_through_dns_decode(tmp_path):
+    """decode('dns', x.pcapng) end-to-end into the dns table schema."""
+    from onix.ingest.run import decode
+
+    table = _table(12)
+    p = tmp_path / "day.pcapng"
+    p.write_bytes(pcap.write_dns_pcapng(table))
+    out = decode("dns", p)
+    assert len(out) == 12
+    assert out["dns_qry_name"].tolist() == table["dns_qry_name"].tolist()
