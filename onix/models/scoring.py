@@ -372,8 +372,12 @@ def score_all(theta, phi_wk, doc_ids, word_ids, chunk: int = 1 << 22,
                 jnp.asarray(word_ids[lo:hi]), n_vocab))
         return out
     if dedup and n:
+        from onix.utils.arrays import unique_inverse
         key = doc_ids.astype(np.int64) * n_vocab + word_ids
-        uniq, inv = np.unique(key, return_inverse=True)
+        # Chunked unique-merge + searchsorted inverse — same output as
+        # np.unique(return_inverse=True), ~4x faster at 10^8 keys
+        # (cache-sized sorts; the cardinality is tiny vs the array).
+        uniq, inv = unique_inverse(key)
         if uniq.shape[0] <= _DEDUP_THRESHOLD * n:
             pair_scores = score_all(
                 theta, phi_wk, (uniq // n_vocab).astype(doc_ids.dtype),

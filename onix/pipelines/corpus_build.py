@@ -18,27 +18,13 @@ import numpy as np
 import pandas as pd
 
 from onix.corpus import Corpus
+from onix.utils.arrays import unique_inverse
 from onix.pipelines.words import WordTable
 
 
-def _unique_inverse(arr: np.ndarray,
-                    chunk: int = 1 << 25) -> tuple[np.ndarray, np.ndarray]:
-    """np.unique(arr, return_inverse=True), restructured for the
-    10⁸-token path: the cardinality here is tiny (hundreds of words,
-    ~10⁵ docs) while the array is huge, so a full argsort + inverse
-    scatter — what np.unique does — is mostly wasted memory traffic.
-    Instead: per-chunk unique (cache-sized sorts), merge the small
-    uniques, then one binary-search pass for the inverse. Identical
-    output; ~4x faster at 2x10⁸ elements."""
-    n = arr.shape[0]
-    if n <= chunk:
-        return np.unique(arr, return_inverse=True)
-    u = np.unique(np.concatenate([
-        np.unique(arr[lo:lo + chunk]) for lo in range(0, n, chunk)]))
-    inv = np.empty(n, np.int64)
-    for lo in range(0, n, chunk):
-        inv[lo:lo + chunk] = np.searchsorted(u, arr[lo:lo + chunk])
-    return u, inv
+# Chunked unique-merge lives in onix.utils.arrays (shared with the
+# scoring dedup path); keep the historical private alias for callers.
+_unique_inverse = unique_inverse
 
 
 def _sorted_table_lookup(keys: np.ndarray, values: np.ndarray,

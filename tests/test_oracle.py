@@ -182,3 +182,23 @@ def test_cli_file_contract(tmp_path, corpus5):
     # beta rows are log-probs: logsumexp ≈ 0.
     lse = np.log(np.exp(beta - beta.max(1, keepdims=True)).sum(1)) + beta.max(1)
     np.testing.assert_allclose(lse, 0.0, atol=1e-5)
+
+
+def test_summarize_cells_min_over_seeds():
+    from onix.pipelines.rehearsal import JUDGED_BAR, summarize_cells
+
+    def cell(v, ceil, chains=8, runs=16):
+        return {"jax_vs_oracle": v, "oracle_vs_oracle": ceil,
+                "config": {"n_chains": chains, "n_oracle_runs": runs}}
+
+    cells = {
+        "flow/seed5": cell(0.96, 0.96),
+        "flow/seed17": cell(0.952, 0.97),
+        "dns/seed5": cell(0.94, 0.95, chains=16, runs=32),
+    }
+    out = summarize_cells(cells)
+    assert out["flow"]["min_over_seeds"] == 0.952
+    assert out["flow"]["passes_bar_min"] is (0.952 >= JUDGED_BAR)
+    assert out["dns"]["passes_bar_min"] is False
+    assert out["dns"]["n_chains"] == [16]
+    assert out["flow"]["n_oracle_runs"] == [16]
