@@ -76,7 +76,7 @@ def _dirichlet(rng, k, n):
     return rng.dirichlet(np.full(k, 0.5), size=n).astype(np.float32)
 
 
-def bench_scoring_uniform(jax, jnp, small=False):
+def bench_scoring_uniform(jax, jnp, small=False, checkpoint=None):
     """Headline: uniform-random events, fused scan+top-k, r01 shape.
 
     Measures BOTH selection forms — the plain per-chunk top_k merge and
@@ -135,6 +135,12 @@ def bench_scoring_uniform(jax, jnp, small=False):
         return reps * n_events / dt, dt, scores_h
 
     rate_a, dt_a, s_a = timed(make_bench())
+    if checkpoint is not None:
+        # A mid-run tunnel hang in a later variant must not lose this
+        # measurement — it is already a valid headline on its own.
+        checkpoint(rate_a, {"selection": "per_chunk_top_k",
+                            "rate_per_chunk_top_k": round(rate_a, 1),
+                            "partial": "variant B pending"})
     rate_b, dt_b, s_b = timed(make_bench(merge_buffer=128))
     # The two selection forms are algorithmically exact, but they are
     # two separately compiled XLA programs — fusion differences can
@@ -269,6 +275,67 @@ def _probe_backend(timeout_s: float = 240.0):
 
 
 def main() -> None:
+    """Watchdog parent: run the measurements in a CHILD process under a
+    hard deadline, checkpointing each component's result to a progress
+    file as it lands. The startup probe (below) covers a tunnel that is
+    down at launch; this covers the other observed failure mode — the
+    tunnel dropping MID-RUN, which leaves a device op blocked in
+    uninterruptible wait forever (round 3: bench hung 30+ min with ~0%
+    CPU; only SIGKILL recovers). Either way the judged line prints,
+    carrying every component that finished before the hang."""
+    if os.environ.get("_ONIX_BENCH_CHILD"):
+        return _measure()
+    import tempfile
+    deadline = float(os.environ.get("ONIX_BENCH_TIMEOUT_S", "2400"))
+    fd, progress = tempfile.mkstemp(prefix="onix-bench-", suffix=".json")
+    os.close(fd)
+    env = dict(os.environ, _ONIX_BENCH_CHILD="1",
+               _ONIX_BENCH_PROGRESS=progress)
+    try:
+        try:
+            r = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                               env=env, timeout=deadline,
+                               capture_output=True, text=True)
+        except subprocess.TimeoutExpired:
+            _emit_from_progress(progress,
+                                f"bench child exceeded {deadline:.0f}s "
+                                "deadline (device tunnel hang?) — "
+                                "reporting components completed before it")
+            return
+        for line in r.stdout.splitlines():
+            if line.startswith('{"metric"'):
+                print(line)
+                return
+        tail = (r.stderr or r.stdout).strip().splitlines()
+        _emit_from_progress(
+            progress, "bench child died without emitting the judged line "
+            f"(rc={r.returncode}): {tail[-1][:200] if tail else 'no output'}")
+    finally:
+        try:
+            os.unlink(progress)
+        except OSError:
+            pass
+
+
+def _emit_from_progress(progress: str, why: str) -> None:
+    detail, rate = {}, 0.0
+    try:
+        with open(progress) as f:
+            saved = json.load(f)
+        detail, rate = saved.get("detail", {}), saved.get("rate", 0.0)
+    except Exception:                               # noqa: BLE001
+        pass
+    detail["watchdog"] = why
+    print(json.dumps({
+        "metric": "netflow_events_scored_per_sec_per_chip",
+        "value": round(rate, 1),
+        "unit": "events/s/chip",
+        "vs_baseline": round(rate / BASELINE_EVENTS_PER_SEC_20NODE, 3),
+        "detail": detail,
+    }))
+
+
+def _measure() -> None:
     # The judged line must print no matter what the backend does: probe
     # first, fall back to CPU (smaller shapes) if the accelerator is
     # unreachable, and never let one component's failure eat the rest.
@@ -296,28 +363,52 @@ def main() -> None:
 
     rate = 0.0
     errors = {}
+    progress = os.environ.get("_ONIX_BENCH_PROGRESS")
 
-    def run(name, fn):
+    def save():
+        if progress:
+            tmp = progress + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump({"rate": rate, "detail": detail}, f)
+            os.replace(tmp, progress)
+
+    def run(name, fn, assign=None):
+        """Run one component; persist its result into the progress file
+        BEFORE returning (a later component hanging the process must not
+        lose a finished measurement — the watchdog's whole point)."""
         try:
-            return fn()
+            out = fn()
         except Exception as e:                  # noqa: BLE001
             errors[name] = repr(e)[:300]
+            save()
             return None
+        if assign is None:
+            detail[name] = out
+        else:
+            assign(out)
+        save()
+        return out
 
-    out = run("scoring_uniform",
-              lambda: bench_scoring_uniform(jax, jnp, small=fallback))
-    if out is not None:
+    def checkpoint_a(rate_a, partial):
+        nonlocal rate
+        rate, detail["scoring_uniform"] = rate_a, partial
+        save()
+
+    def assign_uniform(out):
+        nonlocal rate
         rate, detail["scoring_uniform"] = out
-    detail["gibbs_sweep"] = run(
-        "gibbs_sweep", lambda: bench_gibbs_sweep(jax, jnp, small=fallback))
+
+    run("scoring_uniform",
+        lambda: bench_scoring_uniform(jax, jnp, small=fallback,
+                                      checkpoint=checkpoint_a),
+        assign=assign_uniform)
+    run("gibbs_sweep", lambda: bench_gibbs_sweep(jax, jnp, small=fallback))
     # table strategy engages: D*V = 5.2e7 <= TABLE_MAX_ELEMS
-    detail["scoring_zipf_table"] = run(
-        "scoring_zipf_table",
+    run("scoring_zipf_table",
         lambda: bench_scoring_zipf(jax, jnp, 100_000, 512,
                                    "theta_phi_table", small=fallback))
     # dedup strategy engages: D*V = 2.1e9 too big for a table
-    detail["scoring_zipf_dedup"] = run(
-        "scoring_zipf_dedup",
+    run("scoring_zipf_dedup",
         lambda: bench_scoring_zipf(jax, jnp, 1_000_000, 2_048,
                                    "pair_dedup", small=fallback))
     if errors:

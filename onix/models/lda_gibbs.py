@@ -139,6 +139,9 @@ def make_block_step(*, alpha: float, eta: float, n_vocab: int,
     carry = (n_dk, n_wk, n_k, key); xs = (docs, words, mask, z_old).
     """
     v_eta = n_vocab * eta
+    # Sampler form is picked once at trace time; it is a platform
+    # property, not runtime state, so the traced program is static.
+    use_gumbel = jax.default_backend() not in ("cpu",)
 
     def block_step(carry, xs):
         n_dk, n_wk, n_k, key = carry
@@ -150,23 +153,33 @@ def make_block_step(*, alpha: float, eta: float, n_vocab: int,
         ndk = n_dk[d].astype(jnp.float32) - ohf
         nwk = n_wk[w].astype(jnp.float32) - ohf
         nk = n_k.astype(jnp.float32)[None, :] - ohf
-        # Categorical sampling via the exponential race: z = argmax
-        # p_k / e_k with e_k ~ Exp(1) — the Gumbel-argmax trick in
-        # LINEAR space (log(p/e) = log p + gumbel(u) for the same
-        # uniforms, so the argmax is identical up to float rounding)
-        # at one log per element instead of four. Per-element products
-        # keep full relative precision — no cumsum, so no rare-topic
-        # rounding (the reason an inverse-CDF formulation was
-        # rejected: a linear f32 cumsum makes transitions to topics
-        # below ~2^-24 of the total exactly impossible). Measured
-        # 1.75x faster on CPU (where the test suite and demo live);
-        # TPU re-measurement pending — believed scatter-bound there.
-        # Study + revert criterion: docs/PERF.md "exponential race".
-        p = ((ndk + alpha) * jnp.maximum(nwk + eta, 1e-10)
-             / (nk + v_eta))
-        u = jax.random.uniform(skey, p.shape, dtype=jnp.float32,
-                               minval=1e-38)
-        z_new = jnp.argmax(p / -jnp.log(u), axis=-1).astype(jnp.int32)
+        # Categorical sampling — two statistically identical forms,
+        # chosen per backend at trace time (docs/PERF.md "exponential
+        # race", measured both ways on both platforms):
+        #   * CPU: exponential race z = argmax p_k/e_k, e~Exp(1) — the
+        #     Gumbel-argmax trick in LINEAR space at one log per
+        #     element instead of four; measured 1.75x faster (the
+        #     transcendentals dominate on CPU). Per-element products
+        #     keep full relative precision — no cumsum, so no
+        #     rare-topic rounding (why inverse-CDF was rejected: a
+        #     linear f32 cumsum makes transitions to topics below
+        #     ~2^-24 of the total exactly impossible).
+        #   * TPU: classic log-space Gumbel-argmax — the sweep is
+        #     scatter-bound there so extra transcendentals are free,
+        #     and log space measured ~5% faster (37.5 vs 35.8 Mtok/s,
+        #     scripts/exp_gibbs_sweep.py on v5lite).
+        if use_gumbel:
+            logp = (jnp.log(ndk + alpha)
+                    + jnp.log(jnp.maximum(nwk + eta, 1e-10))
+                    - jnp.log(nk + v_eta))
+            g = jax.random.gumbel(skey, logp.shape, dtype=jnp.float32)
+            z_new = jnp.argmax(logp + g, axis=-1).astype(jnp.int32)
+        else:
+            p = ((ndk + alpha) * jnp.maximum(nwk + eta, 1e-10)
+                 / (nk + v_eta))
+            u = jax.random.uniform(skey, p.shape, dtype=jnp.float32,
+                                   minval=1e-38)
+            z_new = jnp.argmax(p / -jnp.log(u), axis=-1).astype(jnp.int32)
         z_new = jnp.where(m > 0, z_new, z_old)      # padding keeps sentinel
         # Dense one-hot delta rows, NOT per-element scalar scatters:
         # XLA's TPU scatter vectorizes the K lane dimension of row

@@ -421,6 +421,34 @@ _AGENTS = np.array([
     "Mozilla/5.0 (X11; Linux x86_64)"])
 
 
+_JUNK_ALPHA = np.array(list("abcdefghijklmnopqrstuvwxyz0123456789%2F"))
+
+
+def _proxy_campaigns(rng: np.random.Generator, n_anomalies: int):
+    """Shared anomaly-campaign recipe for BOTH proxy generators (row
+    and columnar): the campaign count scales with the anomaly count
+    (one per ~8 anomalies, min 5) and each campaign draws its own
+    URI-length range and hour window. A fixed handful of campaigns
+    collapses 10³ anomalies onto ~tens of word keys whose counts let
+    the sampler give the attack its own topic — the events then stop
+    being low-probability (measured at 10⁸ rows: 396/1000 recovered
+    with 5 fixed campaigns vs 840+/1000 heterogeneous)."""
+    n_camps = max(5, n_anomalies // 8)
+    camp = rng.integers(0, n_camps, n_anomalies)
+    camp_lo = rng.integers(25, 260, n_camps)
+    camp_hi = camp_lo + rng.integers(10, 140, n_camps)
+    camp_hour = rng.uniform(0, 22.4, n_camps).astype(np.float32)
+    return camp, camp_lo, camp_hi, camp_hour
+
+
+def _junk_uris(rng: np.random.Generator, camp: np.ndarray,
+               camp_lo: np.ndarray, camp_hi: np.ndarray) -> np.ndarray:
+    return np.array(
+        ["/" + "".join(rng.choice(_JUNK_ALPHA,
+                                  rng.integers(camp_lo[c], camp_hi[c])))
+         for c in camp], dtype=object)
+
+
 def synth_proxy_day(n_events: int = 20000, n_hosts: int = 120,
                     n_anomalies: int = 30, date: str = DEMO_DATE,
                     seed: int = 0) -> tuple[pd.DataFrame, np.ndarray]:
@@ -459,18 +487,15 @@ def synth_proxy_day(n_events: int = 20000, n_hosts: int = 120,
     # URI styles, hours) so they are heterogeneous in word space — a
     # single repeated signature would form its own topic and stop being
     # rare to the model (the same reason the reference needs DUPFACTOR
-    # to deliberately un-rare analyst-cleared patterns).
-    junk_alpha = list("abcdefghijklmnopqrstuvwxyz0123456789%2F")
-
-    def junk(lo, hi):
-        return "/" + "".join(rng.choice(junk_alpha, rng.integers(lo, hi)))
-
-    camp_len = [(30, 60), (60, 120), (120, 400), (25, 45), (200, 400)]
-    camp = rng.integers(0, len(camp_len), n_anomalies)
-    a_paths = np.array([junk(*camp_len[c]) for c in camp], dtype=object)
+    # to deliberately un-rare analyst-cleared patterns). ONE recipe
+    # shared with synth_proxy_day_arrays so the fidelity studies and
+    # the 10⁸-row scale runs plant the same anomaly distribution.
+    camp, camp_lo, camp_hi, camp_hour = _proxy_campaigns(rng, n_anomalies)
+    a_paths = _junk_uris(rng, camp, camp_lo, camp_hi)
     a_sites = np.array([f"198.51.{rng.integers(0, 100)}.{rng.integers(1, 255)}"
                         for _ in range(n_anomalies)], dtype=object)
-    a_hour = np.clip(camp * 1.7 + rng.uniform(0, 1.5, n_anomalies), 0, 23.99)
+    a_hour = np.clip(camp_hour[camp] + rng.uniform(0, 1.5, n_anomalies),
+                     0, 23.99)
     a_agents = np.array([f"tool{c}/{rng.integers(1, 9)}.{rng.integers(0, 9)}"
                          for c in camp], dtype=object)
     a_cs = np.exp(rng.normal(10, 1, n_anomalies)).astype(np.int64)
@@ -550,24 +575,10 @@ def synth_proxy_day_arrays(n_events: int, n_hosts: int = 100_000,
         out["hour"][lo:hi] = np.clip(rng.normal(peak_of[prof], 2.5), 0, 23.99)
 
     # Anomaly campaigns: beaconing to raw-IP hosts with junk URIs and
-    # rare per-campaign agents. The campaign COUNT scales with the
-    # anomaly count (one per ~8 anomalies) and each campaign draws its
-    # own URI-length range and hour window: 1000 anomalies spread over
-    # 5 fixed campaigns collapse onto ~tens of word keys whose counts
-    # let the sampler give the attack its own topic — the events then
-    # stop being low-probability (measured: 396/1000 recovered at 10⁸
-    # rows vs 840+/1000 for the heterogeneous generators; same
-    # rationale as the flow recipe's per-anomaly campaign comment).
-    junk_alpha = np.array(list("abcdefghijklmnopqrstuvwxyz0123456789%2F"))
-    n_camps = max(5, n_anomalies // 8)
-    camp = rng.integers(0, n_camps, n_anomalies)
-    camp_lo = rng.integers(25, 260, n_camps)
-    camp_hi = camp_lo + rng.integers(10, 140, n_camps)
-    camp_hour = rng.uniform(0, 22.4, n_camps).astype(np.float32)
-    a_uris = np.array(
-        ["/" + "".join(rng.choice(junk_alpha,
-                                  rng.integers(camp_lo[c], camp_hi[c])))
-         for c in camp], dtype=object)
+    # rare per-campaign agents — the _proxy_campaigns recipe shared
+    # with synth_proxy_day (heterogeneity rationale in its docstring).
+    camp, camp_lo, camp_hi, camp_hour = _proxy_campaigns(rng, n_anomalies)
+    a_uris = _junk_uris(rng, camp, camp_lo, camp_hi)
     a_hosts = np.array(
         [f"198.51.{rng.integers(0, 100)}.{rng.integers(1, 255)}"
          for _ in range(n_anomalies)], dtype=object)

@@ -1,0 +1,84 @@
+"""bf16-table fidelity check: does the 1.27x bench lever
+(`table_dtype="bfloat16"`, docs/PERF.md round-3 selection measurements)
+still clear the judged 0.95 overlap bar against the oracle?
+
+Runs the THINNEST-margin (datatype, seed) cell from OVERLAP_r03 per
+datatype — if bf16 holds the bar where the f32 margin is smallest, it
+holds everywhere in the study. Each cell reports, from the SAME fit and
+the SAME oracle ensemble: `jax_vs_oracle` (f32, matched-conditions
+control), `jax_bf16_vs_oracle` (the question), and `bf16_vs_f32`
+(pure rounding effect on the top-k set).
+
+    python scripts/overlap_bf16.py --out docs/OVERLAP_r03_bf16.json
+"""
+import argparse
+import json
+import pathlib
+import sys
+import time
+
+import os
+
+import jax
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from onix.pipelines.rehearsal import JUDGED_BAR, run_rehearsal  # noqa: E402
+
+# Thinnest f32 margin per datatype in docs/OVERLAP_r03.json, with the
+# chain/ensemble sizes that produced those numbers.
+CELLS = [
+    dict(datatype="flow", seed=5, n_chains=8, n_oracle_runs=16),
+    dict(datatype="dns", seed=17, n_chains=16, n_oracle_runs=32),
+    dict(datatype="proxy", seed=41, n_chains=16, n_oracle_runs=32),
+]
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--events", type=int, default=100_000)
+    ap.add_argument("--sweeps", type=int, default=400)
+    ap.add_argument("--out", default="docs/OVERLAP_r03_bf16.json")
+    args = ap.parse_args()
+
+    cells = {}
+    t_all = time.monotonic()
+    for cell in CELLS:
+        t = time.monotonic()
+        r = run_rehearsal(n_events=args.events, n_sweeps=args.sweeps,
+                          bf16_arm=True, **cell)
+        keep = {k: r[k] for k in (
+            "jax_vs_oracle", "jax_bf16_vs_oracle", "bf16_vs_f32",
+            "oracle_vs_oracle", "config")}
+        cells[f"{cell['datatype']}/seed{cell['seed']}"] = keep
+        print(f"[{cell['datatype']} seed={cell['seed']}] "
+              f"f32={r['jax_vs_oracle']} bf16={r['jax_bf16_vs_oracle']} "
+              f"bf16_vs_f32={r['bf16_vs_f32']} "
+              f"({time.monotonic() - t:.0f}s)", flush=True)
+        _write(args.out, cells, args, t_all)
+    return 0
+
+
+def _write(out, cells, args, t_all):
+    mn = min(c["jax_bf16_vs_oracle"] for c in cells.values())
+    doc = {
+        "metric": ("top-1000 overlap vs oracle with bf16 tables-at-rest, "
+                   "thinnest-margin cells"),
+        "bar": JUDGED_BAR,
+        "min_bf16_vs_oracle": mn,
+        "passes_bar_bf16": bool(mn >= JUDGED_BAR),
+        "complete": len(cells) == len(CELLS),
+        "cells": cells,
+        "n_events": args.events, "n_sweeps": args.sweeps,
+        "wall_seconds_total": round(time.monotonic() - t_all, 1),
+    }
+    p = pathlib.Path(out)
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(json.dumps(doc, indent=2) + "\n")
+
+
+if __name__ == "__main__":
+    sys.exit(main())
