@@ -63,7 +63,53 @@ class OAHandler(SimpleHTTPRequestHandler):
             return _safe_join(root, path[len("/data/"):])
         return _safe_join(UI_ROOT, path)
 
+    def _notebook_or_reject(self, datatype: str) -> pathlib.Path | None:
+        """Resolve a datatype to its installed template, sending the
+        HTTP error itself when it can't — the allowlist (never the
+        path) decides, and both the view and run endpoints share one
+        ladder so the guidance cannot drift."""
+        from onix.oa.notebooks import DATATYPES
+        if datatype not in DATATYPES:
+            self.send_error(404)
+            return None
+        nb = (pathlib.Path(self.cfg.oa.data_dir) / "notebooks"
+              / f"{datatype}_threat_investigation.ipynb")
+        if not nb.is_file():
+            self.send_error(404, "notebook templates not installed "
+                                 "(run `onix setup`)")
+            return None
+        return nb
+
+    def _send_html(self, html: str, status: int = 200) -> None:
+        data = html.encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "text/html; charset=utf-8")
+        self.send_header("Content-Length", str(len(data)))
+        self.send_header("Cache-Control", "no-store")
+        self.end_headers()
+        self.wfile.write(data)
+
     def do_GET(self):
+        path = self.path.split("?", 1)[0].split("#", 1)[0]
+        # Hosted notebook view: the installed template rendered
+        # server-side (no outputs; POST /notebooks/run executes it).
+        if path.startswith("/notebooks/") and path.endswith(".html"):
+            nb = self._notebook_or_reject(
+                path[len("/notebooks/"):-len(".html")])
+            if nb is None:
+                return
+            try:
+                from onix.oa.notebooks import render_html
+                html = render_html(nb)
+            except ImportError as e:
+                # nbformat/nbconvert are optional extras: a plain
+                # install must get guidance, not a dropped connection.
+                self.send_error(501, f"notebook rendering needs the "
+                                     f"jupyter stack ({e.name}): pip "
+                                     f"install nbconvert nbclient")
+                return
+            self._send_html(html)
+            return
         target = self._resolve()
         if target is None:
             self.send_error(403)
@@ -118,7 +164,10 @@ class OAHandler(SimpleHTTPRequestHandler):
         return False
 
     def do_POST(self):
-        if self.path.split("?", 1)[0] != "/feedback":
+        path = self.path.split("?", 1)[0]
+        if path == "/notebooks/run":
+            return self._run_notebook()
+        if path != "/feedback":
             self.send_error(404)
             return
         if self._reject_cross_site():
@@ -140,6 +189,54 @@ class OAHandler(SimpleHTTPRequestHandler):
         self.send_header("Content-Length", str(len(payload)))
         self.end_headers()
         self.wfile.write(payload)
+
+
+    def _run_notebook(self):
+        """Execute the datatype's investigation notebook against the
+        live OA data dir and return the rendered HTML — the hosted-
+        notebook path (reference README.md:55: notebooks live next to
+        the dashboards). Same cross-site guard as /feedback: execution
+        is code-running state, never reachable from another origin."""
+        if self._reject_cross_site():
+            return
+        try:
+            n = int(self.headers.get("Content-Length", 0))
+            body = json.loads(self.rfile.read(n))
+            datatype = str(body["datatype"])
+            date = str(body["date"])
+        except (KeyError, TypeError, ValueError, json.JSONDecodeError) as e:
+            self.send_error(400, f"bad request: {e}")
+            return
+        nb = self._notebook_or_reject(datatype)
+        if nb is None:
+            return
+        from onix.oa.notebooks import execute_to_html
+
+        # The kernel is a fresh interpreter: hand it the RESOLVED
+        # config (not a maybe-stale file path) so the notebook reads
+        # the exact data dir this server serves.
+        import tempfile
+        with tempfile.NamedTemporaryFile("w", suffix=".json",
+                                         delete=False) as f:
+            json.dump(self.cfg.to_dict(), f)
+            cfg_path = f.name
+        try:
+            html = execute_to_html(nb, date=date, config_path=cfg_path)
+        except ImportError as e:
+            # notebooks.py imports the jupyter stack lazily inside the
+            # call — a plain install gets guidance, not a dropped
+            # connection.
+            self.send_error(501, f"notebook execution needs the jupyter "
+                                 f"stack ({e.name}): pip install "
+                                 f"nbconvert nbclient")
+            return
+        except Exception as e:                  # noqa: BLE001 — kernel spawn
+            self.send_error(500, f"notebook execution failed: {e}")
+            return
+        finally:
+            import os
+            os.unlink(cfg_path)
+        self._send_html(html)
 
 
 def make_server(cfg: OnixConfig, port: int = DEFAULT_PORT,

@@ -503,12 +503,45 @@ async function load() {
   filt.oninput = () => { tableFilter = filt.value.trim(); renderMainTable(); };
   document.getElementById("save").disabled = true;
   document.getElementById("drill-panel").hidden = true;
-  // In-dashboard notebook for the current datatype (the reference
-  // hosts investigation notebooks next to the dashboards): installed
-  // by `onix setup` under the data dir, served at /data/notebooks/.
+  // Hosted notebooks for the current datatype (the reference hosts
+  // investigation notebooks next to the dashboards): "notebook" opens
+  // the server-rendered template, "run" executes it against this
+  // day's data (POST /notebooks/run) and shows the live outputs, the
+  // arrow downloads the .ipynb for a full Jupyter session.
   const nb = document.getElementById("notebook-link");
   nb.href = `/data/notebooks/${TYPE}_threat_investigation.ipynb`;
   nb.setAttribute("download", `${TYPE}_threat_investigation.ipynb`);
+  document.getElementById("notebook-view").href = `/notebooks/${TYPE}.html`;
+  const nbRun = document.getElementById("notebook-run");
+  let nbRunning = false;          // one kernel at a time per dashboard
+  nbRun.onclick = async (ev) => {
+    ev.preventDefault();
+    if (nbRunning) return;
+    nbRunning = true;
+    nbRun.textContent = "⏳ running";
+    // Open the tab NOW, inside the user activation — after a long
+    // await, popup blockers would return null and discard the result.
+    const w = window.open("", "_blank");
+    if (w) w.document.write("<title>onix notebook</title>running…");
+    try {
+      const resp = await fetch("/notebooks/run", {
+        method: "POST",
+        headers: {"Content-Type": "application/json"},
+        body: JSON.stringify({datatype: TYPE, date: currentDate}),
+      });
+      if (!resp.ok) throw new Error(`${resp.status} ${resp.statusText}`);
+      const html = await resp.text();
+      if (w) {
+        w.document.open(); w.document.write(html); w.document.close();
+      }
+    } catch (e) {
+      if (w) w.close();
+      alert(`notebook run failed: ${e.message}`);
+    } finally {
+      nbRunning = false;
+      nbRun.textContent = "▶ run";
+    }
+  };
   renderTiles(sum);
   renderBars("hist", sum.histogram.counts,
     (i, v) => `bin ${i}: ${v} events`);

@@ -41,12 +41,36 @@ def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--events", type=int, default=100_000)
     ap.add_argument("--sweeps", type=int, default=400)
+    ap.add_argument("--only", nargs="+", default=None,
+                    help="run only these datatypes; optionally override "
+                         "the cell seed as dt:seed (e.g. proxy:17)")
     ap.add_argument("--out", default="docs/OVERLAP_r03_bf16.json")
     args = ap.parse_args()
 
+    run_cells = list(CELLS)
+    overridden = []
+    if args.only:
+        picks = dict(
+            (s.split(":")[0], int(s.split(":")[1]) if ":" in s else None)
+            for s in args.only)
+        run_cells = [dict(c, seed=(picks[c["datatype"]]
+                                   if picks[c["datatype"]] is not None
+                                   else c["seed"]))
+                     for c in CELLS if c["datatype"] in picks]
+        overridden = [f"{c['datatype']}:seed{c['seed']}"
+                      for c in run_cells
+                      if picks[c["datatype"]] is not None]
+
     cells = {}
+    if pathlib.Path(args.out).exists():
+        # Merge-into semantics so a single-datatype re-run (e.g. proxy
+        # after a generator change) keeps the other datatypes' cells.
+        old = json.loads(pathlib.Path(args.out).read_text())
+        cells.update({k: v for k, v in old.get("cells", {}).items()
+                      if k.split("/")[0] not in
+                      {c["datatype"] for c in run_cells}})
     t_all = time.monotonic()
-    for cell in CELLS:
+    for cell in run_cells:
         t = time.monotonic()
         r = run_rehearsal(n_events=args.events, n_sweeps=args.sweeps,
                           bf16_arm=True, **cell)
@@ -58,15 +82,15 @@ def main() -> int:
               f"f32={r['jax_vs_oracle']} bf16={r['jax_bf16_vs_oracle']} "
               f"bf16_vs_f32={r['bf16_vs_f32']} "
               f"({time.monotonic() - t:.0f}s)", flush=True)
-        _write(args.out, cells, args, t_all)
+        _write(args.out, cells, args, t_all, overridden)
     return 0
 
 
-def _write(out, cells, args, t_all):
+def _write(out, cells, args, t_all, overridden):
     mn = min(c["jax_bf16_vs_oracle"] for c in cells.values())
     doc = {
         "metric": ("top-1000 overlap vs oracle with bf16 tables-at-rest, "
-                   "thinnest-margin cells"),
+                   "one cell per datatype (seeds in cell keys/configs)"),
         "bar": JUDGED_BAR,
         "min_bf16_vs_oracle": mn,
         "passes_bar_bf16": bool(mn >= JUDGED_BAR),
@@ -75,6 +99,10 @@ def _write(out, cells, args, t_all):
         "n_events": args.events, "n_sweeps": args.sweeps,
         "wall_seconds_total": round(time.monotonic() - t_all, 1),
     }
+    if overridden:
+        # A :seed override replaces a canonical cell — say so rather
+        # than let the doc claim the default study design ran.
+        doc["seed_overrides"] = overridden
     p = pathlib.Path(out)
     p.parent.mkdir(parents=True, exist_ok=True)
     p.write_text(json.dumps(doc, indent=2) + "\n")

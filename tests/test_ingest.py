@@ -691,6 +691,79 @@ def test_nfcapd_committed_fixture_decodes():
 
 
 @needs_decoder
+def test_nfcapd_hand_packed_layout_decodes():
+    """An nfcapd v1 file assembled FIELD BY FIELD from the documented
+    layout (nfdecode.cpp 'nfcapd v1' header comment) — independently of
+    `write_nfcapd` — must decode exactly. The committed-fixture test
+    guards against co-drift over time; this one guards against the
+    reader and writer sharing one WRONG layout assumption from day one
+    (VERDICT r2 missing #5: all other fixtures are self-generated).
+
+    Layout, little-endian throughout:
+      file header (140B): u16 magic 0xA50C, u16 version=1, u32 flags,
+        u32 n_blocks, 128B ident
+      stat record (136B)
+      per block: u32 NumRecords, u32 size, u16 id (2=data), u16 pad
+      common record (type 1): u16 type, u16 size, u16 flags
+        (bit0 v6 addrs, bit1 64-bit pkts, bit2 64-bit bytes),
+        u16 ext_map, u16 msec_first, u16 msec_last, u32 first,
+        u32 last, u8 fwd_status, u8 tcp_flags, u8 proto, u8 tos,
+        u16 sport, u16 dport, then addrs, pkts, bytes per flags.
+    """
+    import struct
+    import tempfile
+
+    def common_v4(first, msec, sport, dport, proto, sip, dip,
+                  pkts, byts, wide=False):
+        flags = (0x2 | 0x4) if wide else 0
+        body = struct.pack("<HHHHII", flags, 0, msec, msec, first,
+                           first + 1)
+        body += struct.pack("<BBBBHH", 0, 0x10, proto, 0, sport, dport)
+        body += struct.pack("<II", sip, dip)
+        body += struct.pack("<QQ" if wide else "<II", pkts, byts)
+        return struct.pack("<HH", 1, 4 + len(body)) + body
+
+    # v6 record (flags bit0): 2x16B addresses; reader must skip it
+    # consistently in count and decode.
+    v6_body = struct.pack("<HHHHII", 0x1, 0, 0, 0, 1467979200, 1467979201)
+    v6_body += struct.pack("<BBBBHH", 0, 0, 17, 0, 53, 53) + b"\x11" * 32
+    v6_body += struct.pack("<II", 7, 700)
+    v6_rec = struct.pack("<HH", 1, 4 + len(v6_body)) + v6_body
+    # exporter record (type 7): skipped whole by declared size
+    exp_rec = struct.pack("<HH", 7, 12) + b"\x00" * 8
+
+    recs = (
+        common_v4(1467979200, 250, 443, 52000, 6,
+                  0x0A000001, 0x0A000002, 12, 3456)          # 10.0.0.1/2
+        + exp_rec
+        + common_v4(1467979260, 0, 53, 4242, 17,
+                    0xC0A80101, 0x08080808,                  # 192.168.1.1
+                    5, 0x1_0000_0000, wide=True)             # saturates
+        + v6_rec
+    )
+    data_block = struct.pack("<IIHH", 4, len(recs), 2, 0) + recs
+    other = struct.pack("<IIHH", 0, 8, 1, 0) + b"\x00" * 8  # non-data blk
+    blob = (struct.pack("<HHII", 0xA50C, 1, 0, 2) + b"\x00" * 128
+            + b"\x00" * 136 + other + data_block)
+
+    with tempfile.NamedTemporaryFile(suffix=".nfcapd", delete=False) as f:
+        f.write(blob)
+        path = f.name
+    out = nfd.decode_file(path)
+    assert len(out) == 2
+    assert out["sip"].tolist() == ["10.0.0.1", "192.168.1.1"]
+    assert out["dip"].tolist() == ["10.0.0.2", "8.8.8.8"]
+    assert out["sport"].tolist() == [443, 53]
+    assert out["dport"].tolist() == [52000, 4242]
+    assert out["proto"].tolist() == ["TCP", "UDP"]
+    assert out["ipkt"].tolist() == [12, 5]
+    # 64-bit byte counter saturates at the uint32 ABI ceiling.
+    assert out["ibyt"].tolist() == [3456, 0xFFFFFFFF]
+    assert out["treceived"].tolist() == ["2016-07-08 12:00:00",
+                                         "2016-07-08 12:01:00"]
+
+
+@needs_decoder
 def test_nfcapd_compressed_falls_back_loudly():
     """A compressed-flagged nfcapd file routes to the nfdump
     passthrough; without the tool installed that is a DecoderUnavailable
