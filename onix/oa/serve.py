@@ -108,6 +108,11 @@ class OAHandler(SimpleHTTPRequestHandler):
                                      f"jupyter stack ({e.name}): pip "
                                      f"install nbconvert nbclient")
                 return
+            except Exception as e:              # noqa: BLE001 — e.g. a
+                # truncated template: an HTTP 500, never a dropped
+                # connection (same contract as /notebooks/run).
+                self.send_error(500, f"notebook render failed: {e}")
+                return
             self._send_html(html)
             return
         target = self._resolve()

@@ -53,6 +53,11 @@ def main() -> int:
         picks = dict(
             (s.split(":")[0], int(s.split(":")[1]) if ":" in s else None)
             for s in args.only)
+        known = {c["datatype"] for c in CELLS}
+        bogus = set(picks) - known
+        if bogus:
+            ap.error(f"unknown datatype(s) in --only: {sorted(bogus)} "
+                     f"(valid: {sorted(known)})")
         run_cells = [dict(c, seed=(picks[c["datatype"]]
                                    if picks[c["datatype"]] is not None
                                    else c["seed"]))

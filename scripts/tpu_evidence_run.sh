@@ -1,0 +1,64 @@
+#!/bin/bash
+# Round-3 TPU evidence sequence. Polls the tunneled device; when it
+# answers, runs the judged bench and the scale artifacts in order.
+# Each step gets a hard timeout (the tunnel has been observed to hang
+# device ops indefinitely mid-run) and its own log under /tmp.
+# Usage: nohup bash scripts/tpu_evidence_run.sh > /tmp/tpu_evidence.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.."
+
+probe() {
+  timeout 75 python -c "
+import jax, jax.numpy as jnp
+x = jnp.ones((256, 256)); float((x @ x).sum())
+assert jax.devices()[0].platform not in ('cpu',)
+print('TPU OK')" 2>/dev/null | grep -q "TPU OK"
+}
+
+echo "[$(date +%T)] waiting for the device tunnel..."
+until probe; do sleep 120; done
+echo "[$(date +%T)] tunnel up — starting evidence sequence"
+
+run_step() {  # name timeout_s command...
+  local name=$1 tmo=$2; shift 2
+  echo "[$(date +%T)] step $name (timeout ${tmo}s): $*"
+  timeout "$tmo" "$@" > "/tmp/step_$name.log" 2>&1
+  local rc=$?
+  echo "[$(date +%T)] step $name rc=$rc (log /tmp/step_$name.log)"
+  return $rc
+}
+
+# 1. Judged bench (watchdogged internally too). Only a line that
+#    parses as the judged JSON may land in the artifact — a killed or
+#    crashed step must never clobber a previously valid file.
+if run_step bench 3000 python bench.py; then
+  tail -1 /tmp/step_bench.log | python -c "
+import json, sys
+line = sys.stdin.readline()
+doc = json.loads(line)
+assert doc['metric'] and 'value' in doc
+print(line, end='')" > /tmp/bench_line.json \
+    && mv /tmp/bench_line.json docs/BENCH_r03_builder.json \
+    || echo "bench output failed JSON validation — artifact untouched"
+else
+  echo "bench step failed — artifact untouched"
+fi
+
+# 2. 1B-event flow day: fit on the first 1e8, stream-score all 1e9
+#    (VERDICT r2 next #2 — pipeline-only rate, generation separated).
+run_step scale1b 7200 python -m onix.pipelines.scale --events 1e9 \
+  --train-events 1e8 --out docs/SCALE_1B_r03.json
+
+# 3. DNS + proxy at 1e8 on the chip (VERDICT r2 next #3; the r03 DNS
+#    artifact so far is CPU-only).
+run_step scale_dns 5400 python -m onix.pipelines.scale --datatype dns \
+  --events 1e8 --out docs/SCALE_DNS_r03.json
+run_step scale_proxy 5400 python -m onix.pipelines.scale --datatype proxy \
+  --events 1e8 --out docs/SCALE_PROXY_r03.json
+
+# 4. Streaming configs[4] artifact on the chip (mid-stream campaign,
+#    zero-lag detection, bounded state).
+run_step stream 3600 python scripts/stream_scale.py \
+  --out docs/STREAM_r03.json
+
+echo "[$(date +%T)] evidence sequence complete"
