@@ -85,7 +85,7 @@ def bench_scoring_uniform(jax, jnp, small=False, checkpoint=None):
     headline: both are production configurations a user would pick
     between, and the selection-cost tradeoff is hardware-dependent
     (docs/PERF.md round-3 levers; CPU measures exact parity)."""
-    from onix.models.scoring import top_suspicious
+    from onix.models.scoring import top_suspicious, top_suspicious_screened
 
     n_docs, n_vocab, k = 100_000, 65_536, 20
     n_events = 1 << 22 if small else 1 << 24
@@ -101,48 +101,60 @@ def bench_scoring_uniform(jax, jnp, small=False, checkpoint=None):
     phi_d = jnp.asarray(phi_wk)
     m_d = jnp.ones(n_events, jnp.float32)
 
-    def make_bench(**kw):
+    def make_bench(screened=False, **kw):
+        # One body for every variant: the f32/bf16 forms thread a
+        # constant-True `sound` so the screened form (whose selector
+        # returns a real per-pass proof flag) is the same program shape.
         @jax.jit
         def bench(theta, phi, d, w, m):
             def one_pass(carry, i):
-                best_s, best_i = carry
+                best_s, best_i, all_sound = carry
                 # Loop-dependent index perturbation: every pass
                 # re-gathers fresh rows; without this XLA hoists the
                 # whole body.
                 di = jax.lax.rem(d + i, jnp.int32(n_docs))
                 wi = jax.lax.rem(w + i, jnp.int32(n_vocab))
-                out = top_suspicious(theta, phi, di, wi, m, tol=1.0,
-                                     max_results=max_results, **kw)
+                if screened:
+                    scr = top_suspicious_screened(
+                        theta, phi, di, wi, m, tol=1.0,
+                        max_results=max_results, **kw)
+                    out, sound = scr.result, scr.sound
+                else:
+                    out = top_suspicious(theta, phi, di, wi, m, tol=1.0,
+                                         max_results=max_results, **kw)
+                    sound = jnp.asarray(True)
                 cat_s = jnp.concatenate([best_s, out.scores])
                 cat_i = jnp.concatenate([best_i, out.indices])
                 neg, pos = jax.lax.top_k(-cat_s, max_results)
-                return (-neg, cat_i[pos]), None
+                return (-neg, cat_i[pos], all_sound & sound), None
 
             init = (jnp.full((max_results,), jnp.inf, jnp.float32),
-                    jnp.full((max_results,), -1, jnp.int32))
-            (scores, idx), _ = jax.lax.scan(
+                    jnp.full((max_results,), -1, jnp.int32),
+                    jnp.asarray(True))
+            (scores, idx, sound), _ = jax.lax.scan(
                 one_pass, init, jnp.arange(reps, dtype=jnp.int32))
-            return scores, idx
+            return scores, idx, sound
         return bench
 
     def timed(bench):
         np.asarray(bench(theta_d, phi_d, d_d, w_d, m_d)[0])   # compile
         t0 = time.perf_counter()
-        scores, idx = bench(theta_d, phi_d, d_d, w_d, m_d)
+        scores, idx, sound = bench(theta_d, phi_d, d_d, w_d, m_d)
         scores_h = np.asarray(scores)   # forces completion thru the tunnel
         idx_h = np.asarray(idx)
+        sound_h = bool(np.asarray(sound))
         dt = time.perf_counter() - t0
         assert np.isfinite(scores_h).all()
-        return reps * n_events / dt, dt, scores_h, idx_h
+        return reps * n_events / dt, dt, scores_h, idx_h, sound_h
 
-    rate_a, dt_a, s_a, i_a = timed(make_bench())
+    rate_a, dt_a, s_a, i_a, _ = timed(make_bench())
     if checkpoint is not None:
         # A mid-run tunnel hang in a later variant must not lose this
         # measurement — it is already a valid headline on its own.
         checkpoint(rate_a, {"selection": "per_chunk_top_k",
                             "rate_per_chunk_top_k": round(rate_a, 1),
                             "partial": "variants B/C pending"})
-    rate_b, dt_b, s_b, _ = timed(make_bench(merge_buffer=128))
+    rate_b, dt_b, s_b, _, _ = timed(make_bench(merge_buffer=128))
     # The two selection forms are algorithmically exact, but they are
     # two separately compiled XLA programs — fusion differences can
     # shift the gather-dot's accumulation order in the last bit. Record
@@ -163,15 +175,37 @@ def bench_scoring_uniform(jax, jnp, small=False, checkpoint=None):
     # bf16-vs-oracle == f32-vs-oracle >= the 0.95 bar), and (2) a
     # per-run check that THIS run's selected top-k set matches the
     # exact variant's. Headline takes bf16 only when (2) holds.
-    rate_c, dt_c, _s_c, i_c = timed(make_bench(merge_buffer=128,
-                                               table_dtype="bfloat16"))
+    rate_c, dt_c, _s_c, i_c, _ = timed(make_bench(merge_buffer=128,
+                                                  table_dtype="bfloat16"))
     bf16_set_ok = bool(np.array_equal(np.sort(i_a), np.sort(i_c)))
-    candidates = [(rate_a, dt_a, "per_chunk_top_k")]
-    if agree:
-        candidates.append((rate_b, dt_b, "two_phase_merge_buffer"))
-    if bf16_set_ok:
-        candidates.append((rate_c, dt_c, "bf16_tables_merge_buffer"))
-    rate, dt, sel = max(candidates)
+
+    def certified(with_screened: bool):
+        cand = [(rate_a, dt_a, "per_chunk_top_k")]
+        if agree:
+            cand.append((rate_b, dt_b, "two_phase_merge_buffer"))
+        if bf16_set_ok:
+            cand.append((rate_c, dt_c, "bf16_tables_merge_buffer"))
+        if with_screened and screened_ok:
+            cand.append((rate_e, dt_e, "bf16_screened_f32_rescore"))
+        return max(cand)
+
+    if checkpoint is not None:
+        r_cd, _, sel_cd = certified(with_screened=False)
+        checkpoint(r_cd, {"selection": sel_cd,
+                          "rate_per_chunk_top_k": round(rate_a, 1),
+                          "rate_merge_buffer_128": round(rate_b, 1),
+                          "rate_bf16_merge_buffer": round(rate_c, 1),
+                          "partial": "variant D (screened) pending"})
+    # Variant D: bf16-SCREENED exact selection (scoring.py ScreenedTopK)
+    # — bf16 gathers drive the scan, the f32 tables rescore only the
+    # candidate buffer, and a device-side rounding-bound check certifies
+    # the result. Quality gates: the proof flag from every pass AND
+    # (belt and braces) set-identity vs variant A.
+    rate_e, dt_e, _s_e, i_e, sound_e = timed(
+        make_bench(screened=True, merge_buffer=128))
+    screened_ok = sound_e and bool(np.array_equal(np.sort(i_a),
+                                                  np.sort(i_e)))
+    rate, dt, sel = certified(with_screened=True)
     live_proxy = 20.0 * _numpy_scoring_rate(theta, phi_wk)
     return rate, {
         "n_events_per_pass": n_events,
@@ -181,9 +215,11 @@ def bench_scoring_uniform(jax, jnp, small=False, checkpoint=None):
         "variants_bit_identical": agree,
         "bf16_topk_set_identical": bf16_set_ok,
         "bf16_fidelity_study": "docs/OVERLAP_r03_bf16.json",
+        "screened_sound_and_identical": screened_ok,
         "rate_per_chunk_top_k": round(rate_a, 1),
         "rate_merge_buffer_128": round(rate_b, 1),
         "rate_bf16_merge_buffer": round(rate_c, 1),
+        "rate_bf16_screened_rescore": round(rate_e, 1),
         "baseline_events_per_sec_20node_numpy_proxy":
             BASELINE_EVENTS_PER_SEC_20NODE,
         "live_numpy_proxy_this_run": round(live_proxy, 1),

@@ -328,6 +328,246 @@ def table_bottom_k(
                           merge_buffer=merge_buffer)
 
 
+# ---------------------------------------------------------------------------
+# bf16-screened exact selection
+#
+# bf16 tables-at-rest halve the gather traffic of the selection scan (the
+# measured-fastest form on chip), but raw bf16 scores round at 2^-8 and can
+# flip the top-k set near the boundary — the bench's per-run identity gate
+# then rejects the speed. The screened variants below keep the bf16 scan as
+# a SCREEN only: they retain an oversized candidate buffer by bf16 score,
+# rescore just those candidates with the f32 tables, and certify exactness
+# on device from the rounding bound.
+#
+# Soundness argument. Inputs are f32 probabilities in [0,1] rounded once to
+# bf16 (8 significand bits incl. the implicit one, unit roundoff u = 2^-8):
+# each factor carries relative error <= u/(1+u) < 2^-8, a product of two
+# <= (1+2^-8)^2 - 1 < 2^-6.99, and a nonnegative K-term sum accumulated in
+# f32 preserves the relative bound while adding < K*2^-23 of its own. So
+# for every event
+#   bf16_s in [f32_s/(1+REL), f32_s*(1+REL)]   with REL = 2^-6,
+# which leaves ~2x headroom over the 2^-6.99 product bound and absorbs the
+# f32-accumulation term for any plausible K (equality would need
+# K*2^-23 > 2^-6 - 2^-6.99, i.e. K > ~60k topics).
+# Let B_max be the WORST bf16 score retained in the candidate buffer and
+# s_k the k-th-best f32 score after rescoring. Any excluded event has
+# bf16_s >= B_max; if B_max > s_k*(1+REL) then its f32 score is
+#   f32_s >= bf16_s/(1+REL) >= B_max/(1+REL) > s_k
+# — strictly worse than the k-th result, so the exclusion was safe (and
+# strictness rules out boundary ties with excluded events). If the buffer
+# never filled, every event passing the inflated tol screen is IN it, which
+# covers every event with f32_s < tol outright. Either condition => the
+# returned top-k equals the full-f32 scan's, including its
+# lower-global-index tie rule (candidates are ordered by (score, index),
+# which is the rule _merge_bottom_k + _finalize_topk implement). When
+# neither holds the `sound` flag is False and the caller must fall back to
+# the f32 path — never silently accept the screened result.
+#
+# Identity strength differs by variant. The table_* screened variants
+# rescore by gathering the SAME f32 table the exact scan gathers — scores
+# are bit-identical by construction, so sound=True certifies a
+# bit-identical result. top_suspicious_screened's rescore recomputes the
+# gather-dot in a separately compiled XLA program, and separately compiled
+# programs can differ in the dot's last ulp (the same caveat bench.py
+# records for its variant pair); sound=True there certifies the result up
+# to last-ulp ties at the k-th boundary, and the bench additionally gates
+# on per-run set identity before headlining it.
+# ---------------------------------------------------------------------------
+
+_SCREEN_REL = 2.0 ** -6
+
+
+class ScreenedTopK(NamedTuple):
+    result: TopK
+    sound: jax.Array    # bool [] — True: provably identical to the f32 scan
+
+
+def _screened_scan(arrays: tuple, n: int, screen_chunk, rescore, *,
+                   tol: float, max_results: int, chunk: int,
+                   merge_buffer: int | None,
+                   buffer_mult: int) -> ScreenedTopK:
+    """Screen with bf16 chunk scores into a bottom-(k*buffer_mult) buffer,
+    rescore the buffer in f32, and prove exactness (see block comment).
+
+    `screen_chunk(*cols)` returns bf16-rounded scores with mask/tol-screen
+    rejects already at +inf (the screen tol must be tol*(1+2*REL) — the
+    inflation keeps every f32-qualifying event eligible); `rescore(gidx)`
+    returns f32 scores for global event indices, bit-matching the f32
+    path's scoring of the same events."""
+    if n == 0:
+        return ScreenedTopK(_empty_topk(max_results), jnp.asarray(True))
+    n_buffer = max_results * buffer_mult
+    screen = _scan_bottom_k(arrays, n, screen_chunk,
+                            max_results=n_buffer, chunk=chunk,
+                            merge_buffer=merge_buffer)
+    s32 = rescore(screen.indices)
+    s32 = jnp.where((screen.indices >= 0) & (s32 < tol), s32, jnp.inf)
+    # (score, global index) ascending == the f32 scan's deterministic
+    # order: merges keep the lower concat position at equal scores and
+    # the final stable argsort preserves it.
+    order = jnp.lexsort((screen.indices, s32))
+    s_fin = s32[order][:max_results]
+    i_fin = jnp.where(jnp.isfinite(s_fin), screen.indices[order][:max_results],
+                      -1)
+    buffer_full = jnp.isfinite(screen.scores[-1])
+    s_k = s_fin[-1]
+    margin_ok = jnp.isfinite(s_k) & (
+        screen.scores[-1] > s_k * (1.0 + _SCREEN_REL))
+    return ScreenedTopK(TopK(s_fin, i_fin), ~buffer_full | margin_ok)
+
+
+@functools.partial(jax.jit, static_argnames=("max_results", "chunk",
+                                             "merge_buffer", "buffer_mult"))
+def top_suspicious_screened(
+    theta: jax.Array,         # float32 [D,K] (single-estimate tables only)
+    phi_wk: jax.Array,        # float32 [V,K]
+    doc_ids: jax.Array,       # int32 [N]
+    word_ids: jax.Array,      # int32 [N]
+    mask: jax.Array,          # float32 [N] 0.0 for padding
+    *,
+    tol: float,
+    max_results: int,
+    chunk: int = 1 << 20,
+    merge_buffer: int | None = 128,
+    buffer_mult: int = 4,
+) -> ScreenedTopK:
+    """`top_suspicious` at bf16-scan speed with an f32-rescored result:
+    bf16 gathers drive the selection scan, the f32 tables rescore only
+    the ~max_results*buffer_mult survivors. `result` is valid only when
+    `sound` is True — otherwise rerun the f32 `top_suspicious` (the
+    screen cannot prove it kept every true bottom-k member). sound=True
+    certifies identity with the f32 scan up to last-ulp boundary ties
+    (the rescore is a separately compiled dot — module block comment);
+    the table_* variants below carry the strictly bit-identical claim."""
+    if theta.ndim != 2:
+        raise ValueError("screened selection covers single-estimate "
+                         "tables; combine chains upstream")
+    theta_b = theta.astype(jnp.bfloat16)
+    phi_b = phi_wk.astype(jnp.bfloat16)
+    tol_screen = tol * (1.0 + 2.0 * _SCREEN_REL)
+
+    def screen_chunk(dc, wc, mc):
+        s = _subscan_scores(theta_b, phi_b, dc, wc)
+        return jnp.where((mc > 0) & (s < tol_screen), s, jnp.inf)
+
+    def rescore(gidx):
+        safe = jnp.maximum(gidx, 0)
+        return score_events(theta, phi_wk, doc_ids[safe], word_ids[safe])
+
+    return _screened_scan((doc_ids, word_ids, mask), doc_ids.shape[0],
+                          screen_chunk, rescore, tol=tol,
+                          max_results=max_results, chunk=chunk,
+                          merge_buffer=merge_buffer, buffer_mult=buffer_mult)
+
+
+@functools.partial(jax.jit, static_argnames=("max_results", "chunk",
+                                             "merge_buffer", "buffer_mult"))
+def table_bottom_k_screened(
+    table_flat: jax.Array,   # float32 [D*V] from score_table().ravel()
+    idx: jax.Array,          # int32 [N] flat index d*V + w per event
+    table_bf16: jax.Array | None = None,   # optional precomputed bf16 copy
+    *,
+    tol: float,
+    max_results: int,
+    chunk: int = 1 << 21,
+    merge_buffer: int | None = 128,
+    buffer_mult: int = 4,
+) -> ScreenedTopK:
+    """`table_bottom_k` with a bf16 screen: the scan gathers a bf16 copy
+    of the score table (half the bytes of the bandwidth-bound gather),
+    f32 rescoring covers only the candidate buffer. Batch-loop callers
+    should build `table_bf16 = table_flat.astype(jnp.bfloat16)` ONCE and
+    pass it in — converting inside is a full extra pass over the table
+    per call."""
+    table_b = (table_flat.astype(jnp.bfloat16) if table_bf16 is None
+               else table_bf16)
+    tol_screen = tol * (1.0 + 2.0 * _SCREEN_REL)
+
+    def screen_chunk(ii):
+        s = table_b[ii].astype(jnp.float32)
+        return jnp.where(s < tol_screen, s, jnp.inf)
+
+    def rescore(gidx):
+        return table_flat[idx[jnp.maximum(gidx, 0)]]
+
+    return _screened_scan((idx,), idx.shape[0], screen_chunk, rescore,
+                          tol=tol, max_results=max_results, chunk=chunk,
+                          merge_buffer=merge_buffer, buffer_mult=buffer_mult)
+
+
+@functools.partial(jax.jit, static_argnames=("max_results", "chunk",
+                                             "merge_buffer", "buffer_mult"))
+def table_pair_bottom_k_screened(
+    table_flat: jax.Array,   # float32 [D*V] from score_table().ravel()
+    idx_src: jax.Array,      # int32 [N]
+    idx_dst: jax.Array,      # int32 [N]
+    table_bf16: jax.Array | None = None,   # optional precomputed bf16 copy
+    *,
+    tol: float,
+    max_results: int,
+    chunk: int = 1 << 21,
+    merge_buffer: int | None = 128,
+    buffer_mult: int = 4,
+) -> ScreenedTopK:
+    """`table_pair_bottom_k` with a bf16 screen. min() of two
+    once-rounded values stays within the same relative bound as a single
+    rounded value, so the shared REL covers the pair-min too. See
+    `table_bottom_k_screened` on precomputing `table_bf16`."""
+    table_b = (table_flat.astype(jnp.bfloat16) if table_bf16 is None
+               else table_bf16)
+    tol_screen = tol * (1.0 + 2.0 * _SCREEN_REL)
+
+    def screen_chunk(si, di):
+        s = jnp.minimum(table_b[si], table_b[di]).astype(jnp.float32)
+        return jnp.where(s < tol_screen, s, jnp.inf)
+
+    def rescore(gidx):
+        safe = jnp.maximum(gidx, 0)
+        return jnp.minimum(table_flat[idx_src[safe]],
+                           table_flat[idx_dst[safe]])
+
+    return _screened_scan((idx_src, idx_dst), idx_src.shape[0],
+                          screen_chunk, rescore, tol=tol,
+                          max_results=max_results, chunk=chunk,
+                          merge_buffer=merge_buffer, buffer_mult=buffer_mult)
+
+
+def _screened_enabled() -> bool:
+    # Opt-in until the screened scan has a TPU measurement behind it:
+    # the wrapper's fallback makes it exact either way, but the fast
+    # path should not become the pipeline default on CPU-only evidence.
+    import os
+    return os.environ.get("ONIX_SCREENED_SELECT", "0") == "1"
+
+
+def table_bottom_k_fast(table_flat, idx, table_bf16=None, *, tol: float,
+                        max_results: int) -> TopK:
+    """Drop-in `table_bottom_k`: bf16-screened scan when
+    ONIX_SCREENED_SELECT=1 (falling back to the f32 scan whenever the
+    device-side proof does not certify), plain f32 scan otherwise."""
+    if _screened_enabled():
+        scr = table_bottom_k_screened(table_flat, idx, table_bf16,
+                                      tol=tol, max_results=max_results)
+        if bool(scr.sound):
+            return scr.result
+    return table_bottom_k(table_flat, idx, tol=tol,
+                          max_results=max_results)
+
+
+def table_pair_bottom_k_fast(table_flat, idx_src, idx_dst, table_bf16=None,
+                             *, tol: float, max_results: int) -> TopK:
+    """Drop-in `table_pair_bottom_k` with the same screened/fallback
+    policy as `table_bottom_k_fast`."""
+    if _screened_enabled():
+        scr = table_pair_bottom_k_screened(table_flat, idx_src, idx_dst,
+                                           table_bf16, tol=tol,
+                                           max_results=max_results)
+        if bool(scr.sound):
+            return scr.result
+    return table_pair_bottom_k(table_flat, idx_src, idx_dst, tol=tol,
+                               max_results=max_results)
+
+
 # Dedup pays once the device scan shrinks enough to cover the host-side
 # np.unique sort; real telemetry is Zipf over (ip, word) pairs, so the
 # unique-pair count is typically a small fraction of the event count

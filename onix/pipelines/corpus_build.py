@@ -248,10 +248,10 @@ def select_suspicious_events(bundle: CorpusBundle, theta, phi_wk,
         w = corpus.word_ids[:n_real]
         idx = d.astype(np.int64) * n_vocab + w
         if single:
-            return scoring.table_bottom_k(
+            return scoring.table_bottom_k_fast(
                 table, jnp.asarray(idx.astype(np.int32)),
                 tol=tol, max_results=max_results)
-        return scoring.table_pair_bottom_k(
+        return scoring.table_pair_bottom_k_fast(
             table, jnp.asarray(idx[:n_events].astype(np.int32)),
             jnp.asarray(idx[n_events:].astype(np.int32)),
             tol=tol, max_results=max_results)

@@ -237,6 +237,10 @@ def _stream_score(bundle, fitted_edges, theta, phi_wk, *, n_events: int,
             f"budget; lower n_hosts or shard the table")
     table = scoring.score_table(jnp.asarray(theta_x),
                                 jnp.asarray(phi_x)).ravel()
+    # One bf16 copy for the whole stream — the screened scan would
+    # otherwise re-convert the (up to 512 MB) table every batch.
+    table_b = (table.astype(jnp.bfloat16)
+               if scoring._screened_enabled() else None)
 
     unseen_w = v_x - 1
     unseen_d = d_x - 1
@@ -295,12 +299,13 @@ def _stream_score(bundle, fitted_edges, theta, phi_wk, *, n_events: int,
 
         t = time.monotonic()
         if datatype == "flow":   # [src|dst] halves: fused pair-min path
-            top = scoring.table_pair_bottom_k(
-                table, jnp.asarray(idx[:m]), jnp.asarray(idx[m:]),
+            top = scoring.table_pair_bottom_k_fast(
+                table, jnp.asarray(idx[:m]), jnp.asarray(idx[m:]), table_b,
                 tol=1.0, max_results=max_results)
         else:                    # one client-IP token per event
-            top = scoring.table_bottom_k(
-                table, jnp.asarray(idx), tol=1.0, max_results=max_results)
+            top = scoring.table_bottom_k_fast(
+                table, jnp.asarray(idx), table_b,
+                tol=1.0, max_results=max_results)
         ti = np.asarray(top.indices)
         ts = np.asarray(top.scores)
         keep = ti >= 0

@@ -1,0 +1,180 @@
+"""bf16-screened exact selection (scoring.py ScreenedTopK family).
+
+The contract under test: whenever `sound` is True the screened result is
+IDENTICAL (scores and indices, including tie order) to the f32 scan's,
+and `sound` must go False — never silently wrong — when bf16 rounding
+genuinely cannot separate the top-k boundary.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from onix.models.scoring import (
+    ScreenedTopK,
+    table_bottom_k,
+    table_bottom_k_screened,
+    table_pair_bottom_k,
+    table_pair_bottom_k_screened,
+    top_suspicious,
+    top_suspicious_screened,
+)
+
+
+def _random_tables(rng, n_docs, n_vocab, k=8):
+    theta = rng.dirichlet(np.ones(k), size=n_docs).astype(np.float32)
+    phi = rng.dirichlet(np.ones(n_vocab), size=k).astype(np.float32).T
+    return jnp.asarray(theta), jnp.asarray(phi)
+
+
+def _assert_identical(screened: ScreenedTopK, exact):
+    assert bool(screened.sound)
+    np.testing.assert_array_equal(np.asarray(screened.result.indices),
+                                  np.asarray(exact.indices))
+    np.testing.assert_array_equal(np.asarray(screened.result.scores),
+                                  np.asarray(exact.scores))
+
+
+@pytest.mark.parametrize("n,chunk", [(5_000, 512), (777, 256), (64, 512)])
+def test_gather_dot_screened_matches_f32(n, chunk):
+    rng = np.random.default_rng(3)
+    theta, phi = _random_tables(rng, 50, 40)
+    d = jnp.asarray(rng.integers(0, 50, n).astype(np.int32))
+    w = jnp.asarray(rng.integers(0, 40, n).astype(np.int32))
+    m = jnp.asarray((rng.random(n) > 0.05).astype(np.float32))
+    kw = dict(tol=1.0, max_results=100, chunk=chunk)
+    exact = top_suspicious(theta, phi, d, w, m, **kw)
+    scr = top_suspicious_screened(theta, phi, d, w, m, **kw)
+    _assert_identical(scr, exact)
+
+
+def test_gather_dot_screened_tol_filter():
+    # A tol that lands mid-distribution: the f32 filter must win over the
+    # inflated screen tol (screen keeps a superset; rescore re-filters).
+    rng = np.random.default_rng(4)
+    theta, phi = _random_tables(rng, 30, 25)
+    n = 3_000
+    d = jnp.asarray(rng.integers(0, 30, n).astype(np.int32))
+    w = jnp.asarray(rng.integers(0, 25, n).astype(np.int32))
+    m = jnp.ones(n, jnp.float32)
+    kw = dict(tol=0.02, max_results=200, chunk=512)
+    exact = top_suspicious(theta, phi, d, w, m, **kw)
+    scr = top_suspicious_screened(theta, phi, d, w, m, **kw)
+    _assert_identical(scr, exact)
+    # Under-full result slots carry the -1/-inf sentinel contract.
+    s = np.asarray(scr.result.scores)
+    i = np.asarray(scr.result.indices)
+    assert (i[~np.isfinite(s)] == -1).all()
+
+
+def test_screened_empty_and_all_masked():
+    rng = np.random.default_rng(5)
+    theta, phi = _random_tables(rng, 10, 10)
+    empty = top_suspicious_screened(
+        theta, phi, jnp.zeros(0, jnp.int32), jnp.zeros(0, jnp.int32),
+        jnp.zeros(0, jnp.float32), tol=1.0, max_results=16)
+    assert bool(empty.sound)
+    assert (np.asarray(empty.result.indices) == -1).all()
+    n = 100
+    masked = top_suspicious_screened(
+        theta, phi, jnp.zeros(n, jnp.int32), jnp.zeros(n, jnp.int32),
+        jnp.zeros(n, jnp.float32), tol=1.0, max_results=16, chunk=64)
+    assert bool(masked.sound)
+    assert (np.asarray(masked.result.indices) == -1).all()
+
+
+def test_screened_unsound_on_bf16_degenerate_boundary():
+    # Scores engineered to differ only below bf16 resolution around the
+    # k-th position: the screen cannot certify the boundary, so `sound`
+    # must be False (silently returning a maybe-wrong set is the one
+    # forbidden outcome). Build via a [D*V] table directly — every event
+    # hits a distinct table cell whose f32 values are 0.5*(1+j*2^-20),
+    # collapsing to the same bf16 value.
+    n = 4_096
+    table = (0.5 * (1.0 + np.arange(n, dtype=np.float64) * 2.0 ** -20)
+             ).astype(np.float32)
+    idx = jnp.asarray(np.arange(n, dtype=np.int32))
+    scr = table_bottom_k_screened(jnp.asarray(table), idx, tol=1.0,
+                                  max_results=8, chunk=512, buffer_mult=4)
+    assert not bool(scr.sound)
+    # The documented fallback still yields the exact answer.
+    exact = table_bottom_k(jnp.asarray(table), idx, tol=1.0, max_results=8,
+                           chunk=512)
+    np.testing.assert_array_equal(np.asarray(exact.indices),
+                                  np.arange(8, dtype=np.int32))
+
+
+def test_screened_not_full_buffer_is_sound_without_margin():
+    # Fewer qualifying events than the candidate buffer: soundness must
+    # hold via the buffer-not-full arm even when scores are bf16-dense.
+    n = 40
+    table = (0.5 * (1.0 + np.arange(n, dtype=np.float64) * 2.0 ** -20)
+             ).astype(np.float32)
+    idx = jnp.asarray(np.arange(n, dtype=np.int32))
+    scr = table_bottom_k_screened(jnp.asarray(table), idx, tol=1.0,
+                                  max_results=8, chunk=512, buffer_mult=8)
+    exact = table_bottom_k(jnp.asarray(table), idx, tol=1.0, max_results=8,
+                           chunk=512)
+    _assert_identical(scr, exact)
+
+
+@pytest.mark.parametrize("n", [10_000, 513])
+def test_table_screened_matches_f32(n):
+    rng = np.random.default_rng(7)
+    d_n, v_n = 200, 64
+    table = jnp.asarray(rng.random(d_n * v_n).astype(np.float32))
+    idx = jnp.asarray(rng.integers(0, d_n * v_n, n).astype(np.int32))
+    kw = dict(tol=0.9, max_results=128, chunk=1024)
+    exact = table_bottom_k(table, idx, **kw)
+    scr = table_bottom_k_screened(table, idx, **kw)
+    _assert_identical(scr, exact)
+
+
+def test_table_pair_screened_matches_f32():
+    rng = np.random.default_rng(8)
+    d_n, v_n, n = 150, 48, 8_000
+    table = jnp.asarray(rng.random(d_n * v_n).astype(np.float32))
+    si = jnp.asarray(rng.integers(0, d_n * v_n, n).astype(np.int32))
+    di = jnp.asarray(rng.integers(0, d_n * v_n, n).astype(np.int32))
+    kw = dict(tol=0.8, max_results=100, chunk=1024)
+    exact = table_pair_bottom_k(table, si, di, **kw)
+    scr = table_pair_bottom_k_screened(table, si, di, **kw)
+    _assert_identical(scr, exact)
+
+
+def test_fast_wrappers_match_exact_both_gate_states(monkeypatch):
+    from onix.models.scoring import (table_bottom_k_fast,
+                                     table_pair_bottom_k_fast)
+    rng = np.random.default_rng(11)
+    d_n, v_n, n = 100, 32, 5_000
+    table = jnp.asarray(rng.random(d_n * v_n).astype(np.float32))
+    ii = jnp.asarray(rng.integers(0, d_n * v_n, n).astype(np.int32))
+    si = jnp.asarray(rng.integers(0, d_n * v_n, n).astype(np.int32))
+    di = jnp.asarray(rng.integers(0, d_n * v_n, n).astype(np.int32))
+    kw = dict(tol=0.9, max_results=64)
+    want_1 = table_bottom_k(table, ii, **kw)
+    want_2 = table_pair_bottom_k(table, si, di, **kw)
+    for gate in ("0", "1"):
+        monkeypatch.setenv("ONIX_SCREENED_SELECT", gate)
+        got_1 = table_bottom_k_fast(table, ii, **kw)
+        got_2 = table_pair_bottom_k_fast(table, si, di, **kw)
+        np.testing.assert_array_equal(np.asarray(got_1.indices),
+                                      np.asarray(want_1.indices))
+        np.testing.assert_array_equal(np.asarray(got_1.scores),
+                                      np.asarray(want_1.scores))
+        np.testing.assert_array_equal(np.asarray(got_2.indices),
+                                      np.asarray(want_2.indices))
+        np.testing.assert_array_equal(np.asarray(got_2.scores),
+                                      np.asarray(want_2.scores))
+
+
+def test_screened_rejects_chain_tables():
+    rng = np.random.default_rng(9)
+    theta = jnp.asarray(rng.dirichlet(np.ones(4), size=(2, 10))
+                        .astype(np.float32))
+    phi = jnp.asarray(np.moveaxis(
+        rng.dirichlet(np.ones(12), size=(2, 4)).astype(np.float32), 1, 2))
+    with pytest.raises(ValueError, match="single-estimate"):
+        top_suspicious_screened(
+            theta, phi, jnp.zeros(4, jnp.int32), jnp.zeros(4, jnp.int32),
+            jnp.ones(4, jnp.float32), tol=1.0, max_results=4)
