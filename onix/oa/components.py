@@ -77,6 +77,14 @@ _BUILTIN_RANGES = [
     ("127.0.0.0/8", "loopback", "loopback", 0.0, 0.0, "loopback"),
     ("169.254.0.0/16", "linklocal", "linklocal", 0.0, 0.0, "linklocal"),
     ("224.0.0.0/4", "multicast", "multicast", 0.0, 0.0, "multicast"),
+    # RFC 5737 documentation nets at fictional-but-plausible demo
+    # coordinates: synthetic telemetry (onix.pipelines.synth) draws its
+    # external anomaly peers here, so the demo dashboards' geo view is
+    # populated without a real GeoIP database. A user-supplied DB row
+    # for the same prefix overrides these (later-listed wins ties).
+    ("192.0.2.0/24", "demo-apac", "testnet-1", -33.87, 151.21, "demo"),
+    ("198.51.100.0/24", "demo-emea", "testnet-2", 48.86, 2.35, "demo"),
+    ("203.0.113.0/24", "demo-amer", "testnet-3", 37.77, -122.42, "demo"),
 ]
 
 

@@ -14,6 +14,8 @@ Output layout under `cfg.oa.data_dir`:
     <datatype>/<YYYYMMDD>/summary.json      stats/histogram/timeline
     <datatype>/<YYYYMMDD>/graph.json        network graph nodes+links
     <datatype>/<YYYYMMDD>/storyboard.json   per-actor threat cards
+    <datatype>/<YYYYMMDD>/geo.json          world-map points + country rollup
+    <datatype>/<YYYYMMDD>/ingest.json       store-volume view of the day
     <datatype>/dates.json                   date index for the picker
 """
 
@@ -194,6 +196,116 @@ def _storyboard(df: pd.DataFrame, datatype: str, top_n: int = 8) -> dict:
     return {"threats": threats}
 
 
+# Per-datatype (kind, geo column prefix, endpoint column) for the map
+# view. Flow plots both ends of each connect; dns/proxy geolocate the
+# client (the document/actor side — the only IP those rows carry).
+_GEO_KINDS = {
+    "flow": (("src", "src_geo_", "sip"), ("dst", "dst_geo_", "dip")),
+    "dns": (("client", "geo_", "ip_dst"),),
+    "proxy": (("client", "geo_", "clientip"),),
+}
+
+
+def _geo_points(df: pd.DataFrame, datatype: str,
+                max_points: int = 2000) -> dict:
+    """World-map data: one point per geolocatable endpoint of each
+    suspicious row, plus a per-country rollup — the reference OA's
+    globe/map view rebuilt on the enrichment columns
+    (reference README.md:45-48 "Visualization"). Rows are already
+    score-ascending, so capping at `max_points` keeps the most
+    suspicious."""
+    points: list[dict] = []
+    country_count: dict[str, int] = {}
+    country_min: dict[str, float] = {}
+    for kind, prefix, id_col in _GEO_KINDS[datatype]:
+        lat_c, lon_c, ctry_c = (f"{prefix}lat", f"{prefix}lon",
+                                f"{prefix}country")
+        if lat_c not in df.columns:
+            continue
+        lat = df[lat_c].to_numpy(float)
+        lon = df[lon_c].to_numpy(float)
+        ctry = df[ctry_c].astype(str).to_numpy()
+        score = df["score"].to_numpy(float)
+        rank = df["rank"].to_numpy()
+        ids = df[id_col].astype(str).to_numpy()
+        # (0,0)/unknown is the GeoIPDB miss value, not a real fix.
+        ok = ~((lat == 0.0) & (lon == 0.0)) & (ctry != "unknown")
+        for i in np.flatnonzero(ok):
+            points.append({
+                "lat": round(float(lat[i]), 3),
+                "lon": round(float(lon[i]), 3),
+                "rank": int(rank[i]), "score": float(score[i]),
+                "kind": kind, "id": ids[i], "country": ctry[i],
+            })
+            c = ctry[i]
+            country_count[c] = country_count.get(c, 0) + 1
+            country_min[c] = min(country_min.get(c, np.inf),
+                                 float(score[i]))
+    # Cap AFTER collecting every kind: rank order across src+dst points
+    # together, so at the cap the map keeps both endpoints of the most
+    # suspicious rows rather than one kind's points exhausting the
+    # budget.
+    points.sort(key=lambda p: (p["rank"], p["kind"]))
+    points = points[:max_points]
+    countries = sorted(
+        ({"country": c, "n": n, "min_score": country_min[c]}
+         for c, n in country_count.items()),
+        key=lambda r: -r["n"])
+    return {"points": points, "countries": countries[:20],
+            "n_located": int(sum(country_count.values()))}
+
+
+# Timestamp column per datatype in the raw store partitions (the same
+# columns _hours() bins for the suspicious rows).
+_TS_COLS = {"flow": "treceived", "dns": "frame_time", "proxy": "p_time"}
+
+# Above this many rows the per-hour histogram would mean scanning the
+# whole day's timestamp column; the volume view then reports totals from
+# parquet metadata only (row counts need no data pages).
+_INGEST_HOURLY_CAP = 5_000_000
+
+
+def _ingest_volumes(cfg: OnixConfig, datatype: str, date: str) -> dict:
+    """Store-volume summary for the day: how much telemetry the day's
+    partition actually holds, against which the suspicious count is
+    read. The reference OA suite ships an ingest-summary page fed by
+    the ingest pipeline's bookkeeping (SURVEY.md §2.1 #12); onix reads
+    the truth directly from the store partition — parquet footer
+    metadata for row counts, a timestamps-only column scan for the
+    hourly profile when the day is small enough."""
+    import pyarrow.parquet as pq
+
+    from onix.store import Store
+
+    pdir = Store(cfg.store.root).partition_dir(datatype, date)
+    parts = sorted(pdir.glob("part-*.parquet"))
+    if not parts:
+        return {"available": False, "rows_total": 0, "n_parts": 0,
+                "bytes_total": 0, "hourly": None, "hourly_skipped": None}
+    rows = 0
+    nbytes = 0
+    for p in parts:
+        rows += pq.ParquetFile(p).metadata.num_rows
+        nbytes += p.stat().st_size
+    hourly = None
+    hourly_skipped = None    # why hourly is null, for the dashboard
+    ts_col = _TS_COLS[datatype]
+    if rows > _INGEST_HOURLY_CAP:
+        hourly_skipped = "too_large"
+    else:
+        try:
+            ts = pd.concat([pd.read_parquet(p, columns=[ts_col])
+                            for p in parts], ignore_index=True)
+            hourly = np.bincount(_hours(ts, datatype),
+                                 minlength=24)[:24].tolist()
+        except (KeyError, ValueError):
+            # partition predates the column; totals still stand
+            hourly_skipped = "no_timestamps"
+    return {"available": True, "rows_total": int(rows),
+            "n_parts": len(parts), "bytes_total": int(nbytes),
+            "hourly": hourly, "hourly_skipped": hourly_skipped}
+
+
 def _summary(df: pd.DataFrame, datatype: str, date: str,
              manifest: dict | None) -> dict:
     scores = df["score"].to_numpy(np.float64)
@@ -280,6 +392,10 @@ def run_oa(cfg: OnixConfig, date: str, datatype: str) -> int:
     (out / "graph.json").write_text(json.dumps(_graph(enriched, datatype)))
     (out / "storyboard.json").write_text(
         json.dumps(_storyboard(enriched, datatype)))
+    (out / "geo.json").write_text(
+        json.dumps(_geo_points(enriched, datatype)))
+    (out / "ingest.json").write_text(
+        json.dumps(_ingest_volumes(cfg, datatype, date)))
     _update_dates_index(out.parent, date)
     print(f"onix oa: {len(enriched)} results -> {out}")
     return 0

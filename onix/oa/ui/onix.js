@@ -314,6 +314,121 @@ function renderEventTimeline(rows) {
   box.replaceChildren(svg);
 }
 
+function fmtBytes(n) {
+  for (const u of ["B", "KB", "MB", "GB", "TB"]) {
+    if (n < 1024 || u === "TB") return `${n.toFixed(n < 10 ? 1 : 0)} ${u}`;
+    n /= 1024;
+  }
+}
+
+function renderGeo(geo) {
+  // World-map view of the suspicious endpoints (the reference OA's
+  // globe/map visualization re-rendered dependency-free): equirect
+  // projection with a graticule, dot hotness = lowest-score decile,
+  // click → that row in the drill panel. Beside it, the per-country
+  // rollup as proportional bars.
+  const box = document.getElementById("geo-map");
+  const pts = (geo && geo.points) || [];
+  if (!pts.length) {
+    box.replaceChildren(el("div", { class: "empty" },
+                           "no geolocatable endpoints"));
+    document.getElementById("geo-countries").replaceChildren();
+    return;
+  }
+  const svgW = 460, svgH = 240, padL = 26, padT = 6, padB = 14;
+  const svg = svgEl("svg", { viewBox: `0 0 ${svgW} ${svgH}`, width: "100%" });
+  const xOf = lon => padL + (svgW - padL - 6) * (lon + 180) / 360;
+  const yOf = lat => padT + (svgH - padT - padB) * (90 - lat) / 180;
+  for (let lon = -180; lon <= 180; lon += 60) {
+    svg.append(svgEl("line", { class: "grid", x1: xOf(lon), x2: xOf(lon),
+                               y1: yOf(90), y2: yOf(-90) }));
+    const t = svgEl("text", { x: xOf(lon) - 10, y: svgH - 2 });
+    t.textContent = `${lon}°`;
+    svg.append(t);
+  }
+  for (let lat = -60; lat <= 60; lat += 30) {
+    svg.append(svgEl("line", {
+      class: "grid" + (lat === 0 ? " grid-eq" : ""),
+      x1: xOf(-180), x2: xOf(180), y1: yOf(lat), y2: yOf(lat) }));
+    const t = svgEl("text", { x: 1, y: yOf(lat) + 3 });
+    t.textContent = `${lat}°`;
+    svg.append(t);
+  }
+  const sorted = [...pts].sort((a, b) => a.score - b.score);
+  const hotCut = sorted[Math.max(0, Math.floor(sorted.length / 10) - 1)].score;
+  for (const p of pts) {
+    const c = svgEl("circle", {
+      class: "evt" + (p.score <= hotCut ? " hot" : ""),
+      cx: xOf(p.lon).toFixed(1), cy: yOf(p.lat).toFixed(1), r: 3,
+    });
+    const t = svgEl("title");
+    t.textContent = `${p.id} (${p.kind}) · ${p.country} · rank ${p.rank} · ` +
+      `score ${fmtScore(p.score)}`;
+    c.append(t);
+    c.addEventListener("click", () => openDrill(
+      `${p.id} (${p.country})`, allRows.filter(r => r.rank === p.rank)));
+    svg.append(c);
+  }
+  box.replaceChildren(svg);
+  const cbox = document.getElementById("geo-countries");
+  const rows = (geo.countries || []).slice(0, 8);
+  const maxN = Math.max(1, ...rows.map(r => r.n));
+  cbox.replaceChildren(...rows.map(r => {
+    const line = el("div", { class: "country-row" });
+    const bar = el("div", { class: "country-bar" });
+    bar.style.width = `${Math.max(2, 100 * r.n / maxN)}%`;
+    line.append(
+      el("span", { class: "country-name" }, r.country), bar,
+      el("span", { class: "country-n",
+                   title: `min score ${fmtScore(r.min_score)}` },
+         String(r.n)));
+    return line;
+  }));
+}
+
+function renderIngest(ing, sum) {
+  // Store-volume view of the day (the reference OA suite's
+  // ingest-summary page): what the pipeline actually ingested, against
+  // which the suspicious handful is read — README.md:42's "billion of
+  // events to a few thousands" as a visible ratio.
+  const tiles = document.getElementById("ingest-tiles");
+  const hbox = document.getElementById("ingest-hourly");
+  if (!ing || !ing.available) {
+    tiles.replaceChildren(el("div", { class: "empty" },
+                             "no store partition for this day"));
+    hbox.replaceChildren();
+    return;
+  }
+  const nSus = sum.n_results || 0;
+  const ratio = nSus ? Math.round(ing.rows_total / nSus) : null;
+  const cells = [
+    ["events in store", ing.rows_total.toLocaleString()],
+    ["part files", ing.n_parts],
+    ["on disk", fmtBytes(ing.bytes_total)],
+    ["filtered to", ratio ? `1 in ${ratio.toLocaleString()}` : "—"],
+  ];
+  tiles.replaceChildren(...cells.map(([l, v]) => {
+    const t = el("div", { class: "tile" });
+    t.append(el("div", { class: "v" }, String(v)),
+             el("div", { class: "l" }, l));
+    return t;
+  }));
+  if (ing.hourly && ing.hourly.some(v => v > 0)) {
+    renderBars("ingest-hourly", ing.hourly,
+      (i, v) => `${String(i).padStart(2, "0")}:00: ` +
+        `${v.toLocaleString()} ingested`);
+  } else {
+    // hourly_skipped says WHY the engine left hourly null — a small
+    // day without timestamps must not read as a volume problem.
+    const why = ing.hourly_skipped === "too_large"
+      ? "hourly profile skipped (day too large — totals from metadata)"
+      : ing.hourly_skipped === "no_timestamps"
+        ? "hourly profile unavailable (partition has no timestamp column)"
+        : "no hourly profile";
+    hbox.replaceChildren(el("div", { class: "empty" }, why));
+  }
+}
+
 function sparkline(values, w = 120, h = 26) {
   const svg = svgEl("svg", { viewBox: `0 0 ${w} ${h}`, class: "spark" });
   const max = Math.max(1, ...values);
@@ -489,10 +604,12 @@ async function load() {
   picker.value = date;
   picker.onchange = () => { location.hash = `date=${picker.value}`; };
   const dir = `/data/${TYPE}/${dayDir(date)}`;
-  const [rows, sum, graph, story] = await Promise.all([
+  const [rows, sum, graph, story, geo, ing] = await Promise.all([
     getJSON(`${dir}/suspicious.json`), getJSON(`${dir}/summary.json`),
     getJSON(`${dir}/graph.json`),
-    getJSON(`${dir}/storyboard.json`).catch(() => ({ threats: [] }))]);
+    getJSON(`${dir}/storyboard.json`).catch(() => ({ threats: [] })),
+    getJSON(`${dir}/geo.json`).catch(() => ({ points: [], countries: [] })),
+    getJSON(`${dir}/ingest.json`).catch(() => ({ available: false }))]);
   allRows = rows;
   currentDate = date;
   labels.clear();
@@ -558,6 +675,8 @@ async function load() {
   renderEventTimeline(rows);
   renderGraph(graph);
   renderStoryboard(story);
+  renderGeo(geo);
+  renderIngest(ing, sum);
   renderMainTable();
 }
 

@@ -108,8 +108,14 @@ def synth_flow_day(n_events: int = 20000, n_hosts: int = 120,
     # whose count reaches the vocabulary median and stops being rare —
     # word rarity IS the detection signal.)
     a_sip = hosts[rng.integers(0, n_hosts, n_anomalies)]
-    a_dip = np.array([f"203.0.{rng.integers(0, 16)}.{rng.integers(1, 255)}"
-                      for _ in range(n_anomalies)])
+    # External peers from the RFC 5737 documentation nets — proper
+    # address space for synthetic data, and the builtin GeoIPDB places
+    # them at demo coordinates so the dashboard's geo view lights up
+    # with exactly the suspicious endpoints.
+    a_net = rng.integers(0, 3, n_anomalies)
+    a_dip = np.array([f"{('192.0.2', '198.51.100', '203.0.113')[n]}"
+                      f".{rng.integers(1, 255)}"
+                      for n in a_net])
     a_dport = rng.integers(31337, 65535, n_anomalies)
     a_sport = rng.integers(1025, 65535, n_anomalies)
     a_proto = np.where(rng.random(n_anomalies) < 0.25,

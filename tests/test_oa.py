@@ -305,3 +305,117 @@ def test_storyboard_empty_results(tmp_path):
     sb = json.loads((oa_dir(cfg, "flow", "2016-07-08")
                      / "storyboard.json").read_text())
     assert sb == {"threats": []}
+
+
+# ---------------------------------------------------------------------------
+# geo + ingest-volume data files (round-3 UI depth)
+# ---------------------------------------------------------------------------
+
+
+def test_run_oa_emits_geo_and_ingest_stubs(tmp_path):
+    """Without a store partition or a public-IP geo DB, run_oa still
+    emits both files in their degraded-but-valid shapes (the UI's
+    .catch fallbacks only cover pre-round-3 data dirs)."""
+    cfg = load_config(None, [
+        f"store.root={tmp_path}/store",
+        f"store.results_dir={tmp_path}/results",
+        f"oa.data_dir={tmp_path}/oa",
+    ])
+    date = "2016-07-08"
+    res = results_path(cfg.store.results_dir, "dns", date)
+    res.parent.mkdir(parents=True, exist_ok=True)
+    _fake_results("dns").to_csv(res, index=False)
+    assert run_oa(cfg, date, "dns") == 0
+    out = oa_dir(cfg, "dns", date)
+    geo = json.loads((out / "geo.json").read_text())
+    # 10.0.0.x is the builtin DB's "internal" range at (0,0): filtered.
+    assert geo["points"] == [] and geo["n_located"] == 0
+    ing = json.loads((out / "ingest.json").read_text())
+    assert ing == {"available": False, "rows_total": 0, "n_parts": 0,
+                   "bytes_total": 0, "hourly": None,
+                   "hourly_skipped": None}
+
+
+def test_run_oa_geo_and_ingest_full(tmp_path):
+    """With a located geo DB and a real store partition: flow rows
+    produce src+dst map points, the country rollup aggregates, and the
+    ingest view reports store totals plus the hourly profile."""
+    from onix.store import Store
+
+    geo_csv = tmp_path / "geo.csv"
+    geo_csv.write_text(
+        "network,country,city,latitude,longitude,isp\n"
+        "203.0.113.0/24,XX,Testville,48.86,2.35,TestNet\n"
+        "10.0.0.0/8,YY,Intra,-33.87,151.21,Corp\n")
+    cfg = load_config(None, [
+        f"store.root={tmp_path}/store",
+        f"store.results_dir={tmp_path}/results",
+        f"oa.data_dir={tmp_path}/oa",
+        f"oa.geoip_db={geo_csv}",
+    ])
+    date = "2016-07-08"
+    n = 12
+    df = _fake_results("flow", n)
+    res = results_path(cfg.store.results_dir, "flow", date)
+    res.parent.mkdir(parents=True, exist_ok=True)
+    df.to_csv(res, index=False)
+    # Store partition: two parts, hours 3 and 7.
+    store = Store(cfg.store.root)
+    raw = pd.DataFrame({"treceived": ["2016-07-08 03:05:00"] * 30
+                        + ["2016-07-08 07:40:00"] * 10,
+                        "sip": ["10.0.0.1"] * 40})
+    store.append("flow", date, raw.iloc[:25])
+    store.append("flow", date, raw.iloc[25:])
+
+    assert run_oa(cfg, date, "flow") == 0
+    out = oa_dir(cfg, "flow", date)
+
+    geo = json.loads((out / "geo.json").read_text())
+    # every row geolocates at both ends -> 2n points, 2 countries
+    assert geo["n_located"] == 2 * n
+    assert len(geo["points"]) == 2 * n
+    kinds = {p["kind"] for p in geo["points"]}
+    assert kinds == {"src", "dst"}
+    by_country = {c["country"]: c["n"] for c in geo["countries"]}
+    assert by_country == {"XX": n, "YY": n}
+    pt = next(p for p in geo["points"] if p["kind"] == "dst")
+    assert pt["lat"] == 48.86 and pt["lon"] == 2.35
+    assert pt["rank"] >= 1 and pt["score"] > 0
+
+    ing = json.loads((out / "ingest.json").read_text())
+    assert ing["available"] and ing["rows_total"] == 40
+    assert ing["n_parts"] == 2 and ing["bytes_total"] > 0
+    hourly = ing["hourly"]
+    assert hourly[3] == 30 and hourly[7] == 10 and sum(hourly) == 40
+
+
+def test_geo_points_cap_keeps_most_suspicious_of_both_kinds():
+    """At the point cap, rank order across src+dst together wins — one
+    kind must not starve the other (review finding, round 3)."""
+    from onix.oa.engine import _geo_points
+    n = 10
+    df = pd.DataFrame({
+        "rank": np.arange(1, n + 1), "score": np.linspace(1e-6, 1e-3, n),
+        "sip": ["198.51.100.9"] * n, "dip": ["203.0.113.7"] * n,
+        "src_geo_lat": [48.86] * n, "src_geo_lon": [2.35] * n,
+        "src_geo_country": ["demo-emea"] * n,
+        "dst_geo_lat": [37.77] * n, "dst_geo_lon": [-122.42] * n,
+        "dst_geo_country": ["demo-amer"] * n,
+    })
+    geo = _geo_points(df, "flow", max_points=6)
+    assert len(geo["points"]) == 6
+    assert {p["kind"] for p in geo["points"]} == {"src", "dst"}
+    assert max(p["rank"] for p in geo["points"]) == 3
+    assert geo["n_located"] == 2 * n      # rollup counts everything
+
+
+def test_ingest_volumes_reports_skip_reason(tmp_path):
+    from onix.oa.engine import _ingest_volumes
+    from onix.store import Store
+    cfg = load_config(None, [f"store.root={tmp_path}/store"])
+    Store(cfg.store.root).append(
+        "flow", "2016-07-08", pd.DataFrame({"sip": ["10.0.0.1"] * 5}))
+    ing = _ingest_volumes(cfg, "flow", "2016-07-08")
+    assert ing["available"] and ing["rows_total"] == 5
+    assert ing["hourly"] is None
+    assert ing["hourly_skipped"] == "no_timestamps"

@@ -175,3 +175,36 @@ def test_table_sort_filter_contract():
     # The filter re-render path goes through renderMainTable (which
     # recomputes the counter), not a bare renderTable.
     assert "renderMainTable();" in JS
+
+
+def test_geo_view_contract():
+    """The geo panel reads geo.json's {points, countries} shape the OA
+    engine emits (_geo_points), projects equirect, and drills by rank."""
+    assert 'getJSON(`${dir}/geo.json`)' in JS
+    for field in ("p.lat", "p.lon", "p.rank", "p.kind", "r.min_score",
+                  "geo.countries"):
+        assert field in JS, field
+    # unavailable data must degrade, not crash the dashboard load
+    assert '.catch(() => ({ points: [], countries: [] }))' in JS
+    for rel, html in DASHBOARDS.items():
+        assert 'id="geo-map"' in html and 'id="geo-countries"' in html, rel
+
+
+def test_ingest_view_contract():
+    """The ingest-volume panel reads ingest.json (_ingest_volumes
+    fields) and renders the filtered-to ratio against summary.n_results
+    — README.md:42's contract as a visible number."""
+    assert 'getJSON(`${dir}/ingest.json`)' in JS
+    for field in ("ing.rows_total", "ing.n_parts", "ing.bytes_total",
+                  "ing.hourly", "ing.available", "sum.n_results"):
+        assert field in JS, field
+    assert '.catch(() => ({ available: false }))' in JS
+    for rel, html in DASHBOARDS.items():
+        assert 'id="ingest-tiles"' in html and 'id="ingest-hourly"' in html, rel
+
+
+def test_ingest_skip_reason_contract():
+    """hourly=null has two engine causes; the dashboard must not call a
+    timestamp-less small day 'too large' (review finding, round 3)."""
+    assert "ing.hourly_skipped" in JS
+    assert '"too_large"' in JS and '"no_timestamps"' in JS
