@@ -1,0 +1,201 @@
+"""On-device word creation + id mapping for the streaming flow path.
+
+The 1B-event artifact's dominant pipeline stage is host-side word
+creation + trained-id mapping (`stream_words_map`, 48% of the round-3
+pipeline wall) — and this host exposes ONE CPU core, so the numpy path
+cannot be parallelized sideways. The TPU-first answer is to move the
+transform onto the chip: raw numeric telemetry columns stream to the
+device (~25 B/event) and ONE fused program does binning → word packing
+→ vocab/doc lookup → θ·φᵀ gather → pair-min → running bottom-k, so only
+the winners ever come back. This renders SURVEY.md §2.1 #5's word
+creation (reference FlowWordCreation, a Spark executor map) as device
+compute on the VPU instead of a host preprocessing stage.
+
+Why a compact key: the host path packs words into 43-bit int64 keys
+(words.FLOW_SPEC). JAX runs x64-disabled, so the device path re-encodes
+the TRAINED vocabulary once on the host into an equivalent <=31-bit
+int32 key (pclass 17 | proto 3 | hbin 3 | bbin 3 | pbin 3) and the
+device packs events the same way — the event→vocab-id mapping is
+identical; only the key representation differs.
+
+Fidelity: binning compares f32 values against f32-cast edges while the
+host compares f64; a value within half an f32 ulp of a quantile edge
+can land one bin over (expected ~1e-7/event; tests assert agreement on
+synthetic days). The stream scorer's contract is the suspicious tail,
+not bit-stable word strings, and the planted-detection metric is
+unaffected.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from onix.models import scoring
+from onix.pipelines.words import (FLOW_SPEC, _PCLASS_HH, _PROTO_UNK,
+                                  N_BINS_DEFAULT)
+
+# Compact-key layout (int32), LSB-first: pbin | bbin | hbin | proto |
+# pclass. Shifts must match between build() (host) and _pack() (device).
+_BIN_BITS = 3
+_PROTO_BITS = 3
+_PROTO_SHIFT = 3 * _BIN_BITS
+_PCLASS_SHIFT = _PROTO_SHIFT + _PROTO_BITS
+_COMPACT_UNK = (1 << _PROTO_BITS) - 1     # _PROTO_UNK re-encoded
+
+
+class FlowDeviceTables(NamedTuple):
+    """Trained lookup state, re-encoded for on-device mapping.
+
+    A NamedTuple so the whole bundle is a pytree — it rides into the
+    jitted scan as one argument and stays device-resident across
+    chunks.
+    """
+
+    word_key_c: jax.Array     # int32 [V] compact keys, ascending
+    word_ids: jax.Array       # int32 [V] compact key -> trained vocab id
+    doc_u32: jax.Array        # uint32 [D] trained doc IPs, ascending
+    doc_ids: jax.Array        # int32 [D]
+    hour_edges: jax.Array     # f32 [n_bins-1]
+    byt_edges: jax.Array      # f32 [n_bins-1] (log1p space)
+    pkt_edges: jax.Array      # f32 [n_bins-1]
+    proto_remap: jax.Array    # int32 [n_proto_classes] caller id -> compact
+
+
+def build_flow_tables(bundle, edges: dict,
+                      proto_classes: list[str]) -> FlowDeviceTables:
+    """Re-encode the trained bundle once per run (host side, O(V+D)).
+
+    `edges` are the FITTED bin edges/proto table archived by the
+    training corpus build; `proto_classes` is the caller's proto id
+    order for the streamed columns (synth/ingest contract)."""
+    fields = FLOW_SPEC.unpack(np.asarray(bundle.word_key_sorted))
+    for name in ("pbin", "bbin", "hbin"):
+        if fields[name].max(initial=0) >= (1 << _BIN_BITS):
+            raise ValueError(
+                "n_bins too large for the compact key; raise _BIN_BITS")
+    table = np.asarray(edges["proto_classes"], dtype=object)
+    if len(table) >= _COMPACT_UNK:
+        raise ValueError("too many protocol classes for the compact key")
+    proto = np.where(fields["proto"] == _PROTO_UNK, _COMPACT_UNK,
+                     np.minimum(fields["proto"], _COMPACT_UNK))
+    key_c = (fields["pclass"] << _PCLASS_SHIFT
+             | proto << _PROTO_SHIFT
+             | fields["hbin"] << (2 * _BIN_BITS)
+             | fields["bbin"] << _BIN_BITS
+             | fields["pbin"]).astype(np.int64)
+    assert key_c.max(initial=0) < 2 ** 31, "compact key overflows int32"
+    order = np.argsort(key_c, kind="stable")
+    # Caller proto id -> compact code (same remap rule as
+    # flow_words_from_arrays: absent from the fitted table -> UNK).
+    names = np.asarray(proto_classes, dtype=object)
+    pos = np.searchsorted(table, names)
+    pos_c = np.clip(pos, 0, max(len(table) - 1, 0))
+    remap = np.where(len(table) and table[pos_c] == names,
+                     pos_c, _COMPACT_UNK).astype(np.int32)
+    nb = N_BINS_DEFAULT - 1
+    return FlowDeviceTables(
+        word_key_c=jnp.asarray(key_c[order].astype(np.int32)),
+        word_ids=jnp.asarray(
+            np.asarray(bundle.word_key_ids)[order].astype(np.int32)),
+        doc_u32=jnp.asarray(np.asarray(bundle.doc_u32_sorted)),
+        doc_ids=jnp.asarray(np.asarray(bundle.doc_u32_ids).astype(np.int32)),
+        hour_edges=jnp.asarray(
+            np.asarray(edges["hour"], np.float32).reshape(nb)),
+        byt_edges=jnp.asarray(
+            np.asarray(edges["log_ibyt"], np.float32).reshape(nb)),
+        pkt_edges=jnp.asarray(
+            np.asarray(edges["log_ipkt"], np.float32).reshape(nb)),
+        proto_remap=jnp.asarray(remap),
+    )
+
+
+def _lookup_sorted(table: jax.Array, ids: jax.Array, keys: jax.Array,
+                   fill: int) -> jax.Array:
+    """ids[searchsorted(table, keys)] where the hit is exact, else fill
+    — the device rendering of CorpusBundle's sorted-table lookups."""
+    pos = jnp.searchsorted(table, keys)
+    pos_c = jnp.clip(pos, 0, table.shape[0] - 1)
+    hit = table[pos_c] == keys
+    return jnp.where(hit, ids[pos_c], jnp.int32(fill))
+
+
+def _flow_flat_idx(t: FlowDeviceTables, v_x: int, unseen_w: int,
+                   unseen_d: int, sip, dip, sport, dport, proto, hour,
+                   byt, pkt):
+    """Per-chunk device transform: raw columns -> (idx_src, idx_dst)
+    flat score-table indices. Mirrors flow_words_from_arrays +
+    word_ids_packed/doc_ids_u32 field for field."""
+    sport = sport.astype(jnp.int32)
+    dport = dport.astype(jnp.int32)
+    s_low = sport <= 1024
+    d_low = dport <= 1024
+    pclass = jnp.where(
+        s_low & d_low, jnp.minimum(sport, dport),
+        jnp.where(s_low, sport,
+                  jnp.where(d_low, dport, jnp.int32(_PCLASS_HH))))
+    hbin = jnp.searchsorted(t.hour_edges, hour, side="right")
+    bbin = jnp.searchsorted(t.byt_edges, jnp.log1p(byt), side="right")
+    pbin = jnp.searchsorted(t.pkt_edges, jnp.log1p(pkt), side="right")
+    key = (pclass << _PCLASS_SHIFT
+           | t.proto_remap[proto.astype(jnp.int32)] << _PROTO_SHIFT
+           | hbin.astype(jnp.int32) << (2 * _BIN_BITS)
+           | bbin.astype(jnp.int32) << _BIN_BITS
+           | pbin.astype(jnp.int32))
+    wid = _lookup_sorted(t.word_key_c, t.word_ids, key, unseen_w)
+    did_s = _lookup_sorted(t.doc_u32, t.doc_ids, sip, unseen_d)
+    did_d = _lookup_sorted(t.doc_u32, t.doc_ids, dip, unseen_d)
+    return did_s * jnp.int32(v_x) + wid, did_d * jnp.int32(v_x) + wid
+
+
+@functools.partial(jax.jit, static_argnames=("v_x", "unseen_w", "unseen_d",
+                                             "tol", "max_results", "chunk"))
+def _flow_stream_scan(tables: FlowDeviceTables, table_flat: jax.Array,
+                      sip, dip, sport, dport, proto, hour, byt, pkt, *,
+                      v_x: int, unseen_w: int, unseen_d: int, tol: float,
+                      max_results: int, chunk: int) -> scoring.TopK:
+    def score_chunk(s_ip, d_ip, s_p, d_p, pr, hr, by, pk):
+        idx_s, idx_d = _flow_flat_idx(tables, v_x, unseen_w, unseen_d,
+                                      s_ip, d_ip, s_p, d_p, pr, hr, by, pk)
+        s = jnp.minimum(table_flat[idx_s], table_flat[idx_d])
+        return jnp.where(s < tol, s, jnp.inf)
+
+    return scoring._scan_bottom_k(
+        (sip, dip, sport, dport, proto, hour, byt, pkt), sip.shape[0],
+        score_chunk, max_results=max_results, chunk=chunk,
+        merge_buffer=128)
+
+
+def flow_stream_bottom_k(
+    tables: FlowDeviceTables,
+    table_flat: jax.Array,     # f32 [D_x * V_x] extended score table
+    cols: dict,                # numpy columns (synth/ingest schema)
+    *,
+    v_x: int,
+    unseen_w: int,
+    unseen_d: int,
+    tol: float,
+    max_results: int,
+    chunk: int = 1 << 21,
+) -> scoring.TopK:
+    """Fused words→map→score→select for one streamed flow chunk,
+    entirely on device: eight raw columns go up, `max_results` winners
+    come back. Selection runs through the shared exact scan
+    (scoring._scan_bottom_k), so tie rules, padding semantics, and the
+    two-phase merge match every other selection entry point."""
+    return _flow_stream_scan(
+        tables, table_flat,
+        jnp.asarray(cols["sip_u32"]),
+        jnp.asarray(cols["dip_u32"]),
+        jnp.asarray(np.asarray(cols["sport"], np.int32)),
+        jnp.asarray(np.asarray(cols["dport"], np.int32)),
+        jnp.asarray(np.asarray(cols["proto_id"], np.int32)),
+        jnp.asarray(np.asarray(cols["hour"], np.float32)),
+        jnp.asarray(np.asarray(cols["ibyt"], np.float32)),
+        jnp.asarray(np.asarray(cols["ipkt"], np.float32)),
+        v_x=v_x, unseen_w=unseen_w, unseen_d=unseen_d, tol=tol,
+        max_results=max_results, chunk=chunk)

@@ -129,10 +129,14 @@ def run_scale(n_events: int, n_hosts: int | None = None,
     walls["gibbs_fit"] = time.monotonic() - t
 
     planted = set(cols["anomaly_idx"].tolist())
+    stream_info: dict = {}
     t = time.monotonic()
     if train_events >= n_events:
         # Fused device path: score -> pair-min -> bottom-k in one
-        # compiled scan; only the winners cross the tunnel.
+        # compiled scan; only the winners cross the tunnel. Words were
+        # already built on host for training, so the manifest schema
+        # stays uniform with the streaming path's words_mode.
+        stream_info["words_mode"] = "host"
         top = select_suspicious_events(bundle, theta, phi_wk, n_events,
                                        tol=1.0, max_results=max_results)
         top_idx = np.asarray(top.indices)
@@ -144,7 +148,7 @@ def run_scale(n_events: int, n_hosts: int | None = None,
             bundle, wt.edges, theta, phi_wk, n_events=n_events,
             chunk_events=train_events, n_hosts=n_hosts, seed=seed,
             max_results=max_results, planted=planted, walls=walls,
-            datatype=datatype)
+            datatype=datatype, info=stream_info)
 
     walls["total"] = time.monotonic() - t_all
     # The judged rate excludes generating the benchmark's own input —
@@ -180,6 +184,7 @@ def run_scale(n_events: int, n_hosts: int | None = None,
                                  if len(finite) else None),
         "max_results": max_results,
         "seed": seed,
+        **stream_info,
     }
     if out_path is not None:
         out_path = pathlib.Path(out_path)
@@ -215,7 +220,7 @@ def extend_model_for_unseen(theta, phi_wk):
 def _stream_score(bundle, fitted_edges, theta, phi_wk, *, n_events: int,
                   chunk_events: int, n_hosts: int, seed: int,
                   max_results: int, planted: set, walls: dict,
-                  datatype: str = "flow"):
+                  datatype: str = "flow", info: dict | None = None):
     """Stream the FULL day through the fused device scorer in
     chunk_events-sized pieces against a model fitted on chunk 0.
 
@@ -229,6 +234,7 @@ def _stream_score(bundle, fitted_edges, theta, phi_wk, *, n_events: int,
 
     from onix.models import scoring
 
+    info = {} if info is None else info
     theta_x, phi_x = extend_model_for_unseen(theta, phi_wk)
     d_x, v_x = theta_x.shape[0], phi_x.shape[0]
     if d_x * v_x > scoring.TABLE_MAX_ELEMS:
@@ -244,6 +250,20 @@ def _stream_score(bundle, fitted_edges, theta, phi_wk, *, n_events: int,
 
     unseen_w = v_x - 1
     unseen_d = d_x - 1
+    # On-device word creation (flow only): the raw numeric columns ship
+    # to the chip and ONE fused program does binning→packing→trained-id
+    # lookup→score→bottom-k — stream_words_map collapses into
+    # stream_score. Opt-in (ONIX_DEVICE_WORDS=1) because the host is
+    # the reference implementation; device_words.py documents the f32
+    # bin-edge caveat.
+    device_words = (datatype == "flow"
+                    and os.environ.get("ONIX_DEVICE_WORDS", "0") == "1")
+    # Tables are built lazily from the FIRST streamed chunk, whose
+    # cols["proto_classes"] is the caller proto-id order the device
+    # remap must key on (the fitted table is sorted — a different
+    # beast; build_flow_tables' contract).
+    dev_tables = None
+    info["words_mode"] = "device" if device_words else "host"
     # Streamed chunks plant a day-proportional share of anomalies, not
     # a full day's worth per chunk: the streamed part of the run plants
     # ~one _default_anomalies(n_events) budget, so planted_in_bottom_k
@@ -283,36 +303,52 @@ def _stream_score(bundle, fitted_edges, theta, phi_wk, *, n_events: int,
             planted.update((cols["anomaly_idx"] + offset).tolist())
             walls["stream_synth"] += time.monotonic() - t
             t = time.monotonic()
-            wt = _words_from_cols(datatype, cols, edges=fitted_edges)
-            del cols
-            # Map packed keys / IPs into the TRAINED id spaces with one
-            # searchsorted per column against the bundle's tiny sorted
-            # tables; unknowns go to the UNSEEN rows. No per-chunk
-            # unique sort: at 2x10^8 tokens/chunk the old
-            # unique-then-map path spent most of the 1B run's wall in
-            # these sorts (docs/SCALE_1B_r02.json stream_synth_words).
-            wid = bundle.word_ids_packed(wt.word_key, fill=unseen_w)
-            did = bundle.doc_ids_u32(wt.ip_u32, fill=unseen_d)
-            idx = did * np.int32(v_x) + wid
-            del wt, wid, did
+            if device_words:
+                # Device words path: the raw columns ARE the input —
+                # words+map+score+select run as one program inside
+                # stream_score; stream_words_map holds only the
+                # once-per-run O(V+D) table re-encode.
+                from onix.pipelines import device_words as dw
+                if dev_tables is None:
+                    dev_tables = dw.build_flow_tables(
+                        bundle, fitted_edges,
+                        list(cols["proto_classes"]))
+            else:
+                wt = _words_from_cols(datatype, cols, edges=fitted_edges)
+                # Map packed keys / IPs into the TRAINED id spaces with
+                # one searchsorted per column against the bundle's tiny
+                # sorted tables; unknowns go to the UNSEEN rows. No
+                # per-chunk unique sort: at 2x10^8 tokens/chunk the old
+                # unique-then-map path spent most of the 1B run's wall
+                # in these sorts (docs/SCALE_1B_r02.json).
+                wid = bundle.word_ids_packed(wt.word_key, fill=unseen_w)
+                did = bundle.doc_ids_u32(wt.ip_u32, fill=unseen_d)
+                idx = did * np.int32(v_x) + wid
+                del wt, wid, did, cols
         walls["stream_words_map"] += time.monotonic() - t
 
         t = time.monotonic()
-        if datatype == "flow":   # [src|dst] halves: fused pair-min path
+        if c > 0 and device_words:
+            top = dw.flow_stream_bottom_k(
+                dev_tables, table, cols, v_x=v_x, unseen_w=unseen_w,
+                unseen_d=unseen_d, tol=1.0, max_results=max_results)
+            del cols
+        elif datatype == "flow":   # [src|dst] halves: fused pair-min path
             top = scoring.table_pair_bottom_k_fast(
                 table, jnp.asarray(idx[:m]), jnp.asarray(idx[m:]), table_b,
                 tol=1.0, max_results=max_results)
+            idx = None
         else:                    # one client-IP token per event
             top = scoring.table_bottom_k_fast(
                 table, jnp.asarray(idx), table_b,
                 tol=1.0, max_results=max_results)
+            idx = None
         ti = np.asarray(top.indices)
         ts = np.asarray(top.scores)
         keep = ti >= 0
         all_idx.append(ti[keep] + offset)
         all_scores.append(ts[keep])
         walls["stream_score"] += time.monotonic() - t
-        del idx
         offset += m
         c += 1
 

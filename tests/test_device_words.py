@@ -1,0 +1,142 @@
+"""On-device flow word creation (onix/pipelines/device_words.py).
+
+Contract: the device transform (compact-key packing + sorted-table
+lookups) maps every event to the SAME trained (doc, word) ids as the
+host path (flow_words_from_arrays + CorpusBundle lookups), including
+unseen words, unseen documents, and unknown protocols; and the fused
+stream selection returns the same winners as the host-mapped scan.
+"""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from onix.models import scoring
+from onix.pipelines import device_words as dw
+from onix.pipelines.corpus_build import build_corpus
+from onix.pipelines.scale import _words_from_cols
+from onix.pipelines.synth import SYNTH_ARRAYS
+
+
+def _trained(n=20_000, n_hosts=300, seed=3):
+    cols = SYNTH_ARRAYS["flow"](n, n_hosts=n_hosts, n_anomalies=40,
+                                seed=seed)
+    wt = _words_from_cols("flow", cols)
+    bundle = build_corpus(wt)
+    return cols, wt, bundle
+
+
+def _host_idx(bundle, wt_stream, v_x, unseen_w, unseen_d):
+    wid = bundle.word_ids_packed(wt_stream.word_key, fill=unseen_w)
+    did = bundle.doc_ids_u32(wt_stream.ip_u32, fill=unseen_d)
+    return did * np.int32(v_x) + wid
+
+
+def test_device_idx_matches_host_mapping():
+    cols, wt, bundle = _trained()
+    v = bundle.corpus.n_vocab
+    v_x, unseen_w, unseen_d = v + 1, v, bundle.corpus.n_docs
+    tables = dw.build_flow_tables(bundle, wt.edges,
+                                  list(cols["proto_classes"]))
+    # A FRESH chunk (different seed): mixes seen and unseen ips/words.
+    cols2 = SYNTH_ARRAYS["flow"](10_000, n_hosts=300, n_anomalies=25,
+                                 seed=77)
+    wt2 = _words_from_cols("flow", cols2, edges=dict(wt.edges))
+    want = _host_idx(bundle, wt2, v_x, unseen_w, unseen_d)
+    m = cols2["sip_u32"].shape[0]
+    got_s, got_d = dw._flow_flat_idx(
+        tables, v_x, unseen_w, unseen_d,
+        jnp.asarray(cols2["sip_u32"]), jnp.asarray(cols2["dip_u32"]),
+        jnp.asarray(cols2["sport"]), jnp.asarray(cols2["dport"]),
+        jnp.asarray(cols2["proto_id"].astype(np.int32)),
+        jnp.asarray(cols2["hour"]),
+        jnp.asarray(cols2["ibyt"].astype(np.float32)),
+        jnp.asarray(cols2["ipkt"].astype(np.float32)))
+    # WordTable layout is [src tokens | dst tokens] with the same word.
+    np.testing.assert_array_equal(np.asarray(got_s), want[:m])
+    np.testing.assert_array_equal(np.asarray(got_d), want[m:])
+
+
+def test_device_unseen_and_unknown_proto():
+    cols, wt, bundle = _trained(n=5_000, n_hosts=100)
+    v = bundle.corpus.n_vocab
+    v_x, unseen_w, unseen_d = v + 1, v, bundle.corpus.n_docs
+    # Declare one extra caller proto class absent from the fitted
+    # table: events carrying it must map to the UNSEEN word row.
+    classes = list(cols["proto_classes"]) + ["NEWPROTO"]
+    tables = dw.build_flow_tables(bundle, wt.edges, classes)
+    n = 64
+    sip = np.full(n, np.uint32(0xDEAD0001))      # never trained
+    dip = np.full(n, np.uint32(0xDEAD0002))
+    got_s, got_d = dw._flow_flat_idx(
+        tables, v_x, unseen_w, unseen_d,
+        jnp.asarray(sip), jnp.asarray(dip),
+        jnp.asarray(np.full(n, 40000, np.int32)),
+        jnp.asarray(np.full(n, 50000, np.int32)),
+        jnp.asarray(np.full(n, len(classes) - 1, np.int32)),
+        jnp.asarray(np.full(n, 12.5, np.float32)),
+        jnp.asarray(np.full(n, 1000.0, np.float32)),
+        jnp.asarray(np.full(n, 10.0, np.float32)))
+    np.testing.assert_array_equal(np.asarray(got_s),
+                                  np.full(n, unseen_d * v_x + unseen_w))
+    np.testing.assert_array_equal(np.asarray(got_d),
+                                  np.full(n, unseen_d * v_x + unseen_w))
+
+
+def test_fused_stream_selection_matches_host_path():
+    cols, wt, bundle = _trained()
+    rng = np.random.default_rng(9)
+    d = bundle.corpus.n_docs
+    v = bundle.corpus.n_vocab
+    v_x, unseen_w, unseen_d = v + 1, v, d
+    d_x = d + 1
+    table = jnp.asarray(rng.random(d_x * v_x).astype(np.float32))
+    tables = dw.build_flow_tables(bundle, wt.edges,
+                                  list(cols["proto_classes"]))
+    cols2 = SYNTH_ARRAYS["flow"](30_000, n_hosts=300, n_anomalies=30,
+                                 seed=101)
+    wt2 = _words_from_cols("flow", cols2, edges=dict(wt.edges))
+    idx = _host_idx(bundle, wt2, v_x, unseen_w, unseen_d)
+    m = cols2["sip_u32"].shape[0]
+    want = scoring.table_pair_bottom_k(
+        table, jnp.asarray(idx[:m]), jnp.asarray(idx[m:]),
+        tol=1.0, max_results=200)
+    got = dw.flow_stream_bottom_k(
+        tables, table, cols2, v_x=v_x, unseen_w=unseen_w,
+        unseen_d=unseen_d, tol=1.0, max_results=200)
+    np.testing.assert_array_equal(np.asarray(got.indices),
+                                  np.asarray(want.indices))
+    np.testing.assert_array_equal(np.asarray(got.scores),
+                                  np.asarray(want.scores))
+
+
+@pytest.mark.parametrize("gate", ["0", "1"])
+def test_scale_runner_device_words(tmp_path, gate, monkeypatch):
+    """The scale runner produces equivalent artifacts with words on
+    host or device (identical winners at this scale), and records the
+    mode."""
+    from onix.pipelines import scale
+
+    monkeypatch.setenv("ONIX_DEVICE_WORDS", gate)
+    out = tmp_path / f"scale_{gate}.json"
+    doc = scale.run_scale(30_000, train_events=15_000, n_sweeps=8,
+                          seed=5, out_path=out)
+    assert doc["words_mode"] == ("device" if gate == "1" else "host")
+    assert doc["planted_in_bottom_k"] > 0
+    if gate == "1":
+        assert doc["walls_seconds"].get("stream_words_map", 0.0) < 0.5
+
+
+def test_scale_runner_device_vs_host_same_winners(tmp_path, monkeypatch):
+    from onix.pipelines import scale
+
+    res = {}
+    for gate in ("0", "1"):
+        monkeypatch.setenv("ONIX_DEVICE_WORDS", gate)
+        res[gate] = scale.run_scale(30_000, train_events=15_000,
+                                    n_sweeps=8, seed=5)
+    assert (res["0"]["planted_in_bottom_k"]
+            == res["1"]["planted_in_bottom_k"])
+    assert res["0"]["selected_score_range"] == res["1"]["selected_score_range"]
