@@ -1,0 +1,107 @@
+"""Diagnose the gibbs_fit vs sweep-microbench gap (round 3).
+
+bench.py's sweep microbench posts ~35M tokens/s/chip (8.4M tokens,
+V=4096, 4 sweeps in one program), but the 1e8-token scale artifacts'
+gibbs_fit stage runs at ~7-11M tokens/s effective. Candidate causes,
+each isolated here on the real corpus shape:
+
+  A. per-sweep Python dispatch (fit calls _sweep once per sweep;
+     the microbench chains sweeps inside one program)
+  B. the sharded engine's shard_map/psum overhead at dp=1
+  C. the accumulate phase (posterior-mean running sums after burn-in)
+  D. the likelihood evals (every 10th sweep)
+  E. shape effects (1e8 tokens / V~500 vs the microbench's 8.4M/4096)
+
+Run on the TPU host:  python scripts/exp_fit_gap.py [n_tokens]
+Emits one JSON block; safe to rerun (compile cache persists).
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> int:
+    n_events = int(float(sys.argv[1])) if len(sys.argv) > 1 else 50_000_000
+
+    import jax
+
+    from onix.config import LDAConfig
+    from onix.models.lda_gibbs import GibbsLDA
+    from onix.parallel.mesh import make_mesh
+    from onix.parallel.sharded_gibbs import ShardedGibbsLDA
+    from onix.pipelines.corpus_build import build_corpus
+    from onix.pipelines.scale import _words_from_cols
+    from onix.pipelines.synth import SYNTH_ARRAYS
+    from onix.utils.obs import enable_compile_cache
+
+    enable_compile_cache("/tmp/onix-jax-cache")
+    dev = jax.devices()[0]
+    out = {"device": str(dev), "n_events": n_events}
+
+    cols = SYNTH_ARRAYS["dns"](n_events, n_hosts=200_000,
+                               n_anomalies=1000, seed=0)
+    bundle = build_corpus(_words_from_cols("dns", cols))
+    corpus = bundle.corpus
+    out["n_docs"] = int(corpus.n_docs)
+    out["n_vocab"] = int(corpus.n_vocab)
+    out["n_tokens"] = int(corpus.n_tokens)
+    del cols
+
+    cfg = LDAConfig(n_topics=20, n_sweeps=8, burn_in=4,
+                    block_size=1 << 17, seed=0)
+
+    def timed_fit(tag, model, **kw):
+        model.fit(corpus, n_sweeps=1, **kw)   # compile warm-up
+        t0 = time.monotonic()
+        model.fit(corpus, **kw)
+        dt = time.monotonic() - t0
+        # 8 sweeps; fit() also runs 2 ll evals and estimates.
+        rate = cfg.n_sweeps * corpus.n_tokens / dt / 1e6
+        out[tag] = {"wall_s": round(dt, 2),
+                    "mtok_per_s_effective": round(rate, 2)}
+        print(f"{tag}: {dt:.1f}s  {rate:.1f} Mtok/s", flush=True)
+
+    # B: sharded dp=1 vs plain single-device engine, identical corpus.
+    timed_fit("sharded_dp1", ShardedGibbsLDA(
+        cfg, corpus.n_vocab, mesh=make_mesh(dp=len(jax.devices()), mp=1)))
+    timed_fit("plain_single", GibbsLDA(cfg, corpus.n_docs, corpus.n_vocab))
+
+    # C: accumulate phase on for every sweep vs off for every sweep.
+    cfg_acc = LDAConfig(n_topics=20, n_sweeps=8, burn_in=0,
+                        block_size=1 << 17, seed=0)
+    cfg_noacc = LDAConfig(n_topics=20, n_sweeps=8, burn_in=8,
+                          block_size=1 << 17, seed=0)
+    timed_fit("all_accumulate", GibbsLDA(cfg_acc, corpus.n_docs,
+                                         corpus.n_vocab))
+    timed_fit("no_accumulate", GibbsLDA(cfg_noacc, corpus.n_docs,
+                                        corpus.n_vocab))
+
+    # A/D: raw chained sweeps, no fit() wrapper, no ll evals — the
+    # microbench form on the REAL corpus shape.
+    from onix.models.lda_gibbs import init_state
+
+    model = GibbsLDA(cfg, corpus.n_docs, corpus.n_vocab)
+    docs, words, mask = model.prepare(corpus)
+    state = init_state(docs, words, mask, corpus.n_docs, corpus.n_vocab,
+                       cfg.n_topics, cfg.seed)
+    state = model._sweep(state, docs, words, mask, accumulate=False)  # compile+warm
+    jax.block_until_ready(state.n_wk)
+    t0 = time.monotonic()
+    for _ in range(4):
+        state = model._sweep(state, docs, words, mask, accumulate=False)
+    jax.block_until_ready(state.n_wk)
+    dt = time.monotonic() - t0
+    out["raw_sweeps_no_fit"] = {
+        "wall_s": round(dt, 2),
+        "mtok_per_s": round(4 * corpus.n_tokens / dt / 1e6, 2)}
+    print("raw:", out["raw_sweeps_no_fit"], flush=True)
+
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

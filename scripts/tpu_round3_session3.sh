@@ -1,0 +1,53 @@
+#!/bin/bash
+# Round-3 session-3 TPU measurement queue. Runs AFTER the evidence
+# sequence (tpu_evidence_run.sh) finishes — probes until the device is
+# free, then measures this session's levers in value order:
+#   1. bench.py — the screened selection (variant D) measurement; a
+#      certified win updates the builder bench artifact.
+#   2. exp_fit_gap.py — gibbs_fit vs sweep-microbench gap diagnosis.
+#   3. flow 1e8 with ONIX_DEVICE_WORDS=1 — device-words timing vs the
+#      host-words artifact shape.
+# Usage: nohup bash scripts/tpu_round3_session3.sh > /tmp/tpu_s3.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.."
+
+probe() {
+  timeout 75 python -c "
+import jax, jax.numpy as jnp
+x = jnp.ones((256, 256)); float((x @ x).sum())
+assert jax.devices()[0].platform not in ('cpu',)
+print('TPU OK')" 2>/dev/null | grep -q "TPU OK"
+}
+
+# Wait for the evidence sequence to release the device (its last step
+# writes docs/STREAM_r03.json or times out).
+while pgrep -f tpu_evidence_run.sh > /dev/null; do sleep 60; done
+echo "[$(date +%T)] evidence sequence done — waiting for a live tunnel"
+until probe; do sleep 120; done
+echo "[$(date +%T)] tunnel up"
+
+run_step() {  # name timeout_s command...
+  local name=$1 tmo=$2; shift 2
+  echo "[$(date +%T)] step $name (timeout ${tmo}s): $*"
+  timeout "$tmo" "$@" > "/tmp/step_$name.log" 2>&1
+  echo "[$(date +%T)] step $name rc=$? (log /tmp/step_$name.log)"
+}
+
+if run_step bench_s3 3000 python bench.py; then
+  tail -1 /tmp/step_bench_s3.log | python -c "
+import json, sys
+line = sys.stdin.readline()
+doc = json.loads(line)
+assert doc['metric'] and 'value' in doc
+print(line, end='')" > /tmp/bench_line.json \
+    && mv /tmp/bench_line.json docs/BENCH_r03_builder.json \
+    || echo "bench output failed validation — artifact untouched"
+fi
+
+run_step fit_gap 3600 python scripts/exp_fit_gap.py 5e7
+
+run_step flow1e8_dev 3600 env ONIX_DEVICE_WORDS=1 \
+  python -m onix.pipelines.scale --events 1e8 --train-events 2e7 \
+  --out docs/SCALE_FLOW_DEVWORDS_r03.json
+
+echo "[$(date +%T)] session-3 measurement queue complete"
