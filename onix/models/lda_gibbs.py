@@ -130,18 +130,50 @@ def init_chains(
                                    n_docs, n_vocab, n_topics))(keys)
 
 
+# Table width up to which the n_wk delta goes through an MXU one-hot
+# matmul instead of a scatter-add on TPU. Rationale: the sweep is
+# scatter-bound (docs/PERF.md), and with product vocabularies (V in the
+# hundreds) the n_wk scatter is COLLISION-dense — a 2^17-token block
+# lands ~B/V ~ 250 colliding row-updates per word. The matmul form
+# computes the same [V, K] delta as onehot(w)^T @ delta on the MXU:
+# B*V*K MACs (~1.4e9 at the cap — microseconds) plus one [B, V] bf16
+# one-hot materialization, with NO serialized collisions. Exact by
+# construction: operands are {-1, 0, 1} (exact in bf16), accumulation
+# is f32, and each output magnitude is <= B = 2^17 << 2^24. The n_dk
+# scatter keeps its scatter form — documents are nearly collision-free
+# within a block and D is far too large to one-hot.
+_NWK_MATMUL_MAX_V = 4096
+# Auto-enable also bounds the [B, V] one-hot temporary (bf16 elements):
+# 2^27 = 256 MB. A block_size 2^17 sweep at V=4096 would otherwise grow
+# a 1 GiB temporary (x n_chains under the vmap engine) that the scatter
+# form never allocated — an OOM regression, not a speedup. Forcing
+# nwk_matmul=True bypasses the bound for experiments.
+_NWK_MATMUL_MAX_ELEMS = 1 << 27
+
+
 def make_block_step(*, alpha: float, eta: float, n_vocab: int,
-                    k_topics: int):
+                    k_topics: int, nwk_matmul: bool | None = None):
     """The collapsed-Gibbs block sampler shared by the single-device and
     sharded engines — one definition so the documented dp=1 equivalence
     can never silently diverge.
 
     carry = (n_dk, n_wk, n_k, key); xs = (docs, words, mask, z_old).
+
+    `nwk_matmul`: force the n_wk-delta form (True = one-hot matmul,
+    False = scatter-add); None picks at trace time — matmul on
+    accelerator backends when the n_wk table width is at most
+    _NWK_MATMUL_MAX_V (ONIX_NWK_MATMUL=0/1 overrides for experiments).
+    Both forms produce bit-identical int32 counts.
     """
     v_eta = n_vocab * eta
     # Sampler form is picked once at trace time; it is a platform
     # property, not runtime state, so the traced program is static.
     use_gumbel = jax.default_backend() not in ("cpu",)
+    if nwk_matmul is None:
+        import os
+        env = os.environ.get("ONIX_NWK_MATMUL")
+        if env in ("0", "1"):
+            nwk_matmul = env == "1"
 
     def block_step(carry, xs):
         n_dk, n_wk, n_k, key = carry
@@ -188,7 +220,22 @@ def make_block_step(*, alpha: float, eta: float, n_vocab: int,
         # 35M vs 18M tokens/s at K=20).
         delta = _one_hot(z_new, k_topics) - oh_old  # int32-exact update
         n_dk = n_dk.at[d].add(delta)
-        n_wk = n_wk.at[w].add(delta)
+        # n_wk shape is static under trace, so the delta form resolves
+        # to ONE compiled path (module comment at _NWK_MATMUL_MAX_V).
+        use_matmul = (nwk_matmul if nwk_matmul is not None
+                      else (use_gumbel
+                            and n_wk.shape[0] <= _NWK_MATMUL_MAX_V
+                            and w.shape[0] * n_wk.shape[0]
+                            <= _NWK_MATMUL_MAX_ELEMS))
+        if use_matmul:
+            oh_w = jax.nn.one_hot(w, n_wk.shape[0], dtype=jnp.bfloat16)
+            d_wk = jax.lax.dot_general(
+                oh_w, delta.astype(jnp.bfloat16),
+                (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            n_wk = n_wk + d_wk.astype(jnp.int32)
+        else:
+            n_wk = n_wk.at[w].add(delta)
         n_k = n_k + delta.sum(axis=0, dtype=jnp.int32)
         return (n_dk, n_wk, n_k, key), z_new
 

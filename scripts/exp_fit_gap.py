@@ -54,7 +54,9 @@ def main() -> int:
                     block_size=1 << 17, seed=0)
 
     def timed_fit(tag, model, **kw):
-        model.fit(corpus, n_sweeps=1, **kw)   # compile warm-up
+        # Warm-up compiles BOTH sweep specializations (accumulate is a
+        # static argname: burn_in+1 sweeps touches False and True).
+        model.fit(corpus, n_sweeps=model.config.burn_in + 1, **kw)
         t0 = time.monotonic()
         model.fit(corpus, **kw)
         dt = time.monotonic() - t0
@@ -64,9 +66,11 @@ def main() -> int:
                     "mtok_per_s_effective": round(rate, 2)}
         print(f"{tag}: {dt:.1f}s  {rate:.1f} Mtok/s", flush=True)
 
-    # B: sharded dp=1 vs plain single-device engine, identical corpus.
+    # B: sharded at dp=1 vs plain single-device engine, identical
+    # corpus — dp is PINNED to 1 so this isolates shard_map/psum
+    # overhead, not data parallelism.
     timed_fit("sharded_dp1", ShardedGibbsLDA(
-        cfg, corpus.n_vocab, mesh=make_mesh(dp=len(jax.devices()), mp=1)))
+        cfg, corpus.n_vocab, mesh=make_mesh(dp=1, mp=1)))
     timed_fit("plain_single", GibbsLDA(cfg, corpus.n_docs, corpus.n_vocab))
 
     # C: accumulate phase on for every sweep vs off for every sweep.
@@ -98,6 +102,43 @@ def main() -> int:
         "wall_s": round(dt, 2),
         "mtok_per_s": round(4 * corpus.n_tokens / dt / 1e6, 2)}
     print("raw:", out["raw_sweeps_no_fit"], flush=True)
+
+    # n_wk delta form: MXU one-hot matmul vs scatter-add, raw sweeps.
+    # Product vocabularies are collision-dense for the n_wk scatter
+    # (B/V ~ hundreds of colliding updates per block); the matmul form
+    # is bit-identical (test_gibbs) — this measures whether it breaks
+    # the scatter bound on the real shape.
+    import jax.numpy as jnp
+
+    from onix.models.lda_gibbs import make_block_step
+
+    for form, tag in ((False, "raw_nwk_scatter"), (True, "raw_nwk_matmul")):
+        step = make_block_step(alpha=cfg.alpha, eta=cfg.eta,
+                               n_vocab=corpus.n_vocab,
+                               k_topics=cfg.n_topics, nwk_matmul=form)
+
+        @jax.jit
+        def sweeps4(carry, z):
+            def one(c_z, _):
+                c, z = c_z
+                c, z = jax.lax.scan(step, c, (docs, words, mask, z))
+                return (c, z), None
+            (carry, z), _ = jax.lax.scan(one, (carry, z),
+                                         jnp.arange(4))
+            return carry, z
+
+        st = init_state(docs, words, mask, corpus.n_docs, corpus.n_vocab,
+                        cfg.n_topics, cfg.seed)
+        carry = (st.n_dk, st.n_wk, st.n_k, st.key)
+        carry, z = sweeps4(carry, st.z)          # compile + warm
+        jax.block_until_ready(carry[1])
+        t0 = time.monotonic()
+        carry, z = sweeps4(carry, z)
+        jax.block_until_ready(carry[1])
+        dt = time.monotonic() - t0
+        out[tag] = {"wall_s": round(dt, 2),
+                    "mtok_per_s": round(4 * corpus.n_tokens / dt / 1e6, 2)}
+        print(tag, out[tag], flush=True)
 
     print(json.dumps(out))
     return 0

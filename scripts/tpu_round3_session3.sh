@@ -30,7 +30,9 @@ run_step() {  # name timeout_s command...
   local name=$1 tmo=$2; shift 2
   echo "[$(date +%T)] step $name (timeout ${tmo}s): $*"
   timeout "$tmo" "$@" > "/tmp/step_$name.log" 2>&1
-  echo "[$(date +%T)] step $name rc=$? (log /tmp/step_$name.log)"
+  local rc=$?
+  echo "[$(date +%T)] step $name rc=$rc (log /tmp/step_$name.log)"
+  return $rc
 }
 
 if run_step bench_s3 3000 python bench.py; then

@@ -118,3 +118,38 @@ def test_multi_chain_deterministic():
     r1 = GibbsLDA(cfg, corpus.n_docs, corpus.n_vocab).fit(corpus)
     r2 = GibbsLDA(cfg, corpus.n_docs, corpus.n_vocab).fit(corpus)
     np.testing.assert_allclose(r1["phi_wk"], r2["phi_wk"], rtol=1e-6)
+
+
+def test_nwk_matmul_form_bit_identical():
+    """The MXU one-hot-matmul n_wk delta must equal the scatter form
+    bit for bit over full sweeps (it is exact integer math in f32 —
+    lda_gibbs module comment at _NWK_MATMUL_MAX_V)."""
+    import jax
+    import jax.numpy as jnp
+
+    from onix.models.lda_gibbs import init_state, make_block_step
+
+    corpus, _, _ = synthetic_lda_corpus(n_docs=60, n_vocab=40, n_topics=4,
+                                        mean_doc_len=30, seed=2)
+    cfg = LDAConfig(n_topics=4, n_sweeps=3, block_size=128, seed=1)
+    model = GibbsLDA(cfg, corpus.n_docs, corpus.n_vocab)
+    docs, words, mask = model.prepare(corpus)
+    states = {}
+    for form in (False, True):
+        step = make_block_step(alpha=cfg.alpha, eta=cfg.eta,
+                               n_vocab=corpus.n_vocab,
+                               k_topics=cfg.n_topics, nwk_matmul=form)
+        st = init_state(docs, words, mask, corpus.n_docs, corpus.n_vocab,
+                        cfg.n_topics, cfg.seed)
+        carry = (st.n_dk, st.n_wk, st.n_k, st.key)
+        z = st.z
+        for _ in range(cfg.n_sweeps):
+            carry, z = jax.lax.scan(step, carry, (docs, words, mask, z))
+        states[form] = (np.asarray(carry[0]), np.asarray(carry[1]),
+                        np.asarray(carry[2]), np.asarray(z))
+    for a, b in zip(states[False], states[True]):
+        np.testing.assert_array_equal(a, b)
+    # Count-table invariants hold for the matmul form.
+    n_dk, n_wk, n_k, _ = states[True]
+    assert n_wk.sum() == int(np.asarray(mask).sum())
+    np.testing.assert_array_equal(n_wk.sum(axis=0), n_k)
