@@ -533,18 +533,28 @@ def table_pair_bottom_k_screened(
 
 
 def _screened_enabled() -> bool:
-    # Opt-in until the screened scan has a TPU measurement behind it:
-    # the wrapper's fallback makes it exact either way, but the fast
-    # path should not become the pipeline default on CPU-only evidence.
+    # Platform default, env-overridable. On TPU the screened scan is
+    # the measured-fastest certified form
+    # (docs/BENCH_r03_builder_screened.json: 132.2M ev/s vs 118.6M
+    # exact on the same run, sound + set-identical); everywhere else —
+    # CPU (no gather-bandwidth win) and unmeasured accelerators (an
+    # uncertifiable screen would pay BOTH scans via the fallback) — the
+    # f32 scan stays the default. Any env value other than "1"
+    # disables, so legacy spellings like "0"/"false"/"off" all mean
+    # off; unset means the platform default.
     import os
-    return os.environ.get("ONIX_SCREENED_SELECT", "0") == "1"
+    env = os.environ.get("ONIX_SCREENED_SELECT")
+    if env is not None:
+        return env == "1"
+    return jax.default_backend() == "tpu"
 
 
 def table_bottom_k_fast(table_flat, idx, table_bf16=None, *, tol: float,
                         max_results: int) -> TopK:
-    """Drop-in `table_bottom_k`: bf16-screened scan when
-    ONIX_SCREENED_SELECT=1 (falling back to the f32 scan whenever the
-    device-side proof does not certify), plain f32 scan otherwise."""
+    """Drop-in `table_bottom_k`: the bf16-screened scan when enabled
+    (_screened_enabled: default on TPU, ONIX_SCREENED_SELECT
+    overrides), falling back to the f32 scan whenever the device-side
+    proof does not certify; plain f32 scan otherwise."""
     if _screened_enabled():
         scr = table_bottom_k_screened(table_flat, idx, table_bf16,
                                       tol=tol, max_results=max_results)
@@ -557,7 +567,7 @@ def table_bottom_k_fast(table_flat, idx, table_bf16=None, *, tol: float,
 def table_pair_bottom_k_fast(table_flat, idx_src, idx_dst, table_bf16=None,
                              *, tol: float, max_results: int) -> TopK:
     """Drop-in `table_pair_bottom_k` with the same screened/fallback
-    policy as `table_bottom_k_fast`."""
+    policy (and platform default) as `table_bottom_k_fast`."""
     if _screened_enabled():
         scr = table_pair_bottom_k_screened(table_flat, idx_src, idx_dst,
                                            table_bf16, tol=tol,
