@@ -170,6 +170,271 @@ def _flow_stream_scan(tables: FlowDeviceTables, table_flat: jax.Array,
         merge_buffer=128)
 
 
+# ---------------------------------------------------------------------------
+# DNS / proxy device paths.
+#
+# Same design as flow with one extra split: the string-derived features
+# (subdomain entropy, URI length, user-agent class, ...) are computed
+# per UNIQUE value on the host — thousands of strings, microseconds —
+# and packed into per-unique PARTIAL compact keys; the device gathers
+# the partials through the dictionary codes and packs in the per-event
+# numeric fields. Compact layouts (LSB-first):
+#   dns:   flbin 3 | hbin 3 | ebin 3 | slbin 3 | nlabels 3 | qtype 8 |
+#          rcode 4 | tld 1                                   (28 bits)
+#   proxy: cclass 3 | hbin 3 | uebin 3 | ulbin 3 | hostip 1 | ua 7
+#                                                            (20 bits)
+# build_*_tables validates that the TRAINED vocab fits these ranges
+# (qtype < 256, rcode < 16, <126 common user agents, ...) and raises
+# otherwise — the caller then stays on the host path. Streamed events
+# outside the ranges get key -1 (matches no table entry), landing on
+# the UNSEEN word row exactly as the host lookup would.
+# ---------------------------------------------------------------------------
+
+_DNS_HBIN_SHIFT = 3
+_DNS_EBIN_SHIFT = 6
+_DNS_SLBIN_SHIFT = 9
+_DNS_NLABELS_SHIFT = 12
+_DNS_QTYPE_SHIFT = 15
+_DNS_RCODE_SHIFT = 23
+_DNS_TLD_SHIFT = 27
+_PROXY_HBIN_SHIFT = 3
+_PROXY_UEBIN_SHIFT = 6
+_PROXY_ULBIN_SHIFT = 9
+_PROXY_HOSTIP_SHIFT = 12
+_PROXY_UA_SHIFT = 13
+_PROXY_UA_RARE_C = 126     # words._UA_RARE (1023) re-encoded to 7 bits
+
+
+class DnsDeviceTables(NamedTuple):
+    word_key_c: jax.Array     # int32 [V] compact keys, ascending
+    word_ids: jax.Array       # int32 [V]
+    doc_u32: jax.Array        # uint32 [D] trained client IPs, ascending
+    doc_ids: jax.Array        # int32 [D]
+    hour_edges: jax.Array     # f32 [n_bins-1]
+    flen_edges: jax.Array     # f32 [n_bins-1]
+
+
+def build_dns_tables(bundle, edges: dict) -> DnsDeviceTables:
+    from onix.pipelines.words import DNS_SPEC
+
+    fields = DNS_SPEC.unpack(np.asarray(bundle.word_key_sorted))
+    if fields["qtype"].max(initial=0) >= 256:
+        raise ValueError("trained qtype exceeds the compact key range")
+    if fields["rcode"].max(initial=0) >= 16:
+        raise ValueError("trained rcode exceeds the compact key range")
+    for name in ("flbin", "hbin", "ebin", "slbin", "nlabels"):
+        if fields[name].max(initial=0) >= 8:
+            raise ValueError(f"trained {name} exceeds the compact key range")
+    key_c = (fields["flbin"]
+             | fields["hbin"] << _DNS_HBIN_SHIFT
+             | fields["ebin"] << _DNS_EBIN_SHIFT
+             | fields["slbin"] << _DNS_SLBIN_SHIFT
+             | fields["nlabels"] << _DNS_NLABELS_SHIFT
+             | fields["qtype"] << _DNS_QTYPE_SHIFT
+             | fields["rcode"] << _DNS_RCODE_SHIFT
+             | fields["tld"] << _DNS_TLD_SHIFT).astype(np.int64)
+    order = np.argsort(key_c, kind="stable")
+    nb = N_BINS_DEFAULT - 1
+    return DnsDeviceTables(
+        word_key_c=jnp.asarray(key_c[order].astype(np.int32)),
+        word_ids=jnp.asarray(
+            np.asarray(bundle.word_key_ids)[order].astype(np.int32)),
+        doc_u32=jnp.asarray(np.asarray(bundle.doc_u32_sorted)),
+        doc_ids=jnp.asarray(np.asarray(bundle.doc_u32_ids).astype(np.int32)),
+        hour_edges=jnp.asarray(
+            np.asarray(edges["hour"], np.float32).reshape(nb)),
+        flen_edges=jnp.asarray(
+            np.asarray(edges["frame_len"], np.float32).reshape(nb)),
+    )
+
+
+def _pad_pow2(a: np.ndarray) -> np.ndarray:
+    """Pad a per-unique table to the next power of two so the jitted
+    per-chunk scan sees a handful of distinct shapes, not one per
+    chunk's unique count (each distinct shape is a recompile)."""
+    n = max(1, int(a.shape[0]))
+    size = 1 << (n - 1).bit_length()
+    return np.pad(a, (0, size - a.shape[0]))
+
+
+def dns_partial_keys(qnames: np.ndarray, edges: dict) -> np.ndarray:
+    """Per-UNIQUE compact partials (ebin|slbin|nlabels|tld at their
+    shifts) from the fitted edges — host side, O(uniques)."""
+    from onix.utils.features import digitize, qname_features
+
+    qf = qname_features(qnames)
+    slbin = digitize(qf["sub_len"], edges["sub_len"]).astype(np.int64)
+    ebin = digitize(qf["sub_entropy"].astype(np.float64),
+                    edges["sub_entropy"]).astype(np.int64)
+    return (ebin << _DNS_EBIN_SHIFT
+            | slbin << _DNS_SLBIN_SHIFT
+            | qf["n_labels"] << _DNS_NLABELS_SHIFT
+            | qf["tld_ok"] << _DNS_TLD_SHIFT).astype(np.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("v_x", "unseen_w", "unseen_d",
+                                             "tol", "max_results", "chunk"))
+def _dns_stream_scan(tables: DnsDeviceTables, table_flat: jax.Array,
+                     partial_u: jax.Array, client, codes, qtype, rcode,
+                     flen, hour, *, v_x: int, unseen_w: int, unseen_d: int,
+                     tol: float, max_results: int,
+                     chunk: int) -> scoring.TopK:
+    def score_chunk(cl, co, qt, rc, fl, hr):
+        flbin = jnp.searchsorted(tables.flen_edges, fl, side="right")
+        hbin = jnp.searchsorted(tables.hour_edges, hr, side="right")
+        key = (partial_u[co]
+               | flbin.astype(jnp.int32)
+               | hbin.astype(jnp.int32) << _DNS_HBIN_SHIFT
+               | qt << _DNS_QTYPE_SHIFT
+               | rc << _DNS_RCODE_SHIFT)
+        valid = ((qt >= 0) & (qt < 256) & (rc >= 0) & (rc < 16))
+        key = jnp.where(valid, key, jnp.int32(-1))
+        wid = _lookup_sorted(tables.word_key_c, tables.word_ids, key,
+                             unseen_w)
+        did = _lookup_sorted(tables.doc_u32, tables.doc_ids, cl, unseen_d)
+        s = table_flat[did * jnp.int32(v_x) + wid]
+        return jnp.where(s < tol, s, jnp.inf)
+
+    return scoring._scan_bottom_k(
+        (client, codes, qtype, rcode, flen, hour), client.shape[0],
+        score_chunk, max_results=max_results, chunk=chunk,
+        merge_buffer=128)
+
+
+def dns_stream_bottom_k(tables: DnsDeviceTables, table_flat: jax.Array,
+                        cols: dict, edges: dict, *, v_x: int, unseen_w: int,
+                        unseen_d: int, tol: float, max_results: int,
+                        chunk: int = 1 << 21) -> scoring.TopK:
+    """Fused words→map→score→select for one streamed DNS chunk: string
+    features run per unique name on the host, everything per-event on
+    the device."""
+    partial_u = jnp.asarray(_pad_pow2(dns_partial_keys(cols["qnames"], edges)))
+    return _dns_stream_scan(
+        tables, table_flat, partial_u,
+        jnp.asarray(cols["client_u32"]),
+        jnp.asarray(np.asarray(cols["qname_codes"], np.int32)),
+        jnp.asarray(np.asarray(cols["qtype"], np.int32)),
+        jnp.asarray(np.asarray(cols["rcode"], np.int32)),
+        jnp.asarray(np.asarray(cols["frame_len"], np.float32)),
+        jnp.asarray(np.asarray(cols["hour"], np.float32)),
+        v_x=v_x, unseen_w=unseen_w, unseen_d=unseen_d, tol=tol,
+        max_results=max_results, chunk=chunk)
+
+
+class ProxyDeviceTables(NamedTuple):
+    word_key_c: jax.Array     # int32 [V] compact keys, ascending
+    word_ids: jax.Array       # int32 [V]
+    doc_u32: jax.Array        # uint32 [D]
+    doc_ids: jax.Array        # int32 [D]
+    hour_edges: jax.Array     # f32 [n_bins-1]
+
+
+def build_proxy_tables(bundle, edges: dict) -> ProxyDeviceTables:
+    from onix.pipelines.words import _UA_RARE, PROXY_SPEC
+
+    fields = PROXY_SPEC.unpack(np.asarray(bundle.word_key_sorted))
+    if len(edges.get("ua_common", ())) >= _PROXY_UA_RARE_C:
+        raise ValueError("too many common user agents for the compact key")
+    ua = fields["ua"]
+    bad_ua = (ua >= len(edges.get("ua_common", ()))) & (ua != _UA_RARE)
+    if bad_ua.any():
+        raise ValueError("trained ua code outside the fitted common table")
+    ua_c = np.where(ua == _UA_RARE, _PROXY_UA_RARE_C, ua)
+    if fields["cclass"].max(initial=0) >= 8:
+        raise ValueError("trained cclass exceeds the compact key range")
+    for name in ("hbin", "uebin", "ulbin"):
+        if fields[name].max(initial=0) >= 8:
+            raise ValueError(f"trained {name} exceeds the compact key range")
+    key_c = (fields["cclass"]
+             | fields["hbin"] << _PROXY_HBIN_SHIFT
+             | fields["uebin"] << _PROXY_UEBIN_SHIFT
+             | fields["ulbin"] << _PROXY_ULBIN_SHIFT
+             | fields["hostip"] << _PROXY_HOSTIP_SHIFT
+             | ua_c << _PROXY_UA_SHIFT).astype(np.int64)
+    order = np.argsort(key_c, kind="stable")
+    nb = N_BINS_DEFAULT - 1
+    return ProxyDeviceTables(
+        word_key_c=jnp.asarray(key_c[order].astype(np.int32)),
+        word_ids=jnp.asarray(
+            np.asarray(bundle.word_key_ids)[order].astype(np.int32)),
+        doc_u32=jnp.asarray(np.asarray(bundle.doc_u32_sorted)),
+        doc_ids=jnp.asarray(np.asarray(bundle.doc_u32_ids).astype(np.int32)),
+        hour_edges=jnp.asarray(
+            np.asarray(edges["hour"], np.float32).reshape(nb)),
+    )
+
+
+def proxy_partial_keys(uris: np.ndarray, hosts: np.ndarray,
+                       agents: np.ndarray, edges: dict) -> tuple:
+    """Per-UNIQUE compact partials for the three dictionary columns —
+    host side, O(uniques). Returns (uri_p, host_p, ua_p) int32."""
+    from onix.pipelines.words import _IP_RE, _UA_RARE, _categorical
+    from onix.utils.features import digitize, entropy_array
+
+    uri_len = np.fromiter((len(str(u)) for u in uris), np.float64,
+                          len(uris))
+    ulbin = digitize(uri_len, edges["uri_len"]).astype(np.int64)
+    uebin = digitize(entropy_array(uris).astype(np.float64),
+                     edges["uri_entropy"]).astype(np.int64)
+    uri_p = (uebin << _PROXY_UEBIN_SHIFT
+             | ulbin << _PROXY_ULBIN_SHIFT).astype(np.int32)
+    host_p = (np.fromiter((int(bool(_IP_RE.match(str(h)))) for h in hosts),
+                          np.int64, len(hosts))
+              << _PROXY_HOSTIP_SHIFT).astype(np.int32)
+    ua = _categorical(np.asarray(agents, dtype=object), "ua_common", edges,
+                      _UA_RARE)
+    ua_c = np.where(ua == _UA_RARE, _PROXY_UA_RARE_C, ua)
+    return uri_p, host_p, (ua_c << _PROXY_UA_SHIFT).astype(np.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("v_x", "unseen_w", "unseen_d",
+                                             "tol", "max_results", "chunk"))
+def _proxy_stream_scan(tables: ProxyDeviceTables, table_flat: jax.Array,
+                       uri_p: jax.Array, host_p: jax.Array, ua_p: jax.Array,
+                       client, uri_c, host_c, ua_c, respcode, hour, *,
+                       v_x: int, unseen_w: int, unseen_d: int, tol: float,
+                       max_results: int, chunk: int) -> scoring.TopK:
+    def score_chunk(cl, uc, hc, ac, rc, hr):
+        hbin = jnp.searchsorted(tables.hour_edges, hr, side="right")
+        cclass = rc // 100
+        key = (uri_p[uc] | host_p[hc] | ua_p[ac]
+               | cclass
+               | hbin.astype(jnp.int32) << _PROXY_HBIN_SHIFT)
+        valid = (rc >= 0) & (cclass < 8)
+        key = jnp.where(valid, key, jnp.int32(-1))
+        wid = _lookup_sorted(tables.word_key_c, tables.word_ids, key,
+                             unseen_w)
+        did = _lookup_sorted(tables.doc_u32, tables.doc_ids, cl, unseen_d)
+        s = table_flat[did * jnp.int32(v_x) + wid]
+        return jnp.where(s < tol, s, jnp.inf)
+
+    return scoring._scan_bottom_k(
+        (client, uri_c, host_c, ua_c, respcode, hour), client.shape[0],
+        score_chunk, max_results=max_results, chunk=chunk,
+        merge_buffer=128)
+
+
+def proxy_stream_bottom_k(tables: ProxyDeviceTables, table_flat: jax.Array,
+                          cols: dict, edges: dict, *, v_x: int,
+                          unseen_w: int, unseen_d: int, tol: float,
+                          max_results: int,
+                          chunk: int = 1 << 21) -> scoring.TopK:
+    """Fused words→map→score→select for one streamed proxy chunk."""
+    uri_p, host_p, ua_p = proxy_partial_keys(
+        cols["uris"], cols["hosts"], cols["agents"], edges)
+    return _proxy_stream_scan(
+        tables, table_flat, jnp.asarray(_pad_pow2(uri_p)),
+        jnp.asarray(_pad_pow2(host_p)), jnp.asarray(_pad_pow2(ua_p)),
+        jnp.asarray(cols["client_u32"]),
+        jnp.asarray(np.asarray(cols["uri_codes"], np.int32)),
+        jnp.asarray(np.asarray(cols["host_codes"], np.int32)),
+        jnp.asarray(np.asarray(cols["ua_codes"], np.int32)),
+        jnp.asarray(np.asarray(cols["respcode"], np.int32)),
+        jnp.asarray(np.asarray(cols["hour"], np.float32)),
+        v_x=v_x, unseen_w=unseen_w, unseen_d=unseen_d, tol=tol,
+        max_results=max_results, chunk=chunk)
+
+
 def flow_stream_bottom_k(
     tables: FlowDeviceTables,
     table_flat: jax.Array,     # f32 [D_x * V_x] extended score table

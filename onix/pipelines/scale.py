@@ -250,19 +250,35 @@ def _stream_score(bundle, fitted_edges, theta, phi_wk, *, n_events: int,
 
     unseen_w = v_x - 1
     unseen_d = d_x - 1
-    # On-device word creation (flow only): the raw numeric columns ship
+    # On-device word creation: the raw numeric/dictionary columns ship
     # to the chip and ONE fused program does binning→packing→trained-id
     # lookup→score→bottom-k — stream_words_map collapses into
-    # stream_score. Opt-in (ONIX_DEVICE_WORDS=1) because the host is
-    # the reference implementation; device_words.py documents the f32
-    # bin-edge caveat.
-    device_words = (datatype == "flow"
-                    and os.environ.get("ONIX_DEVICE_WORDS", "0") == "1")
-    # Tables are built lazily from the FIRST streamed chunk, whose
+    # stream_score (string features stay host-side per UNIQUE value for
+    # dns/proxy). Opt-in (ONIX_DEVICE_WORDS=1) because the host is the
+    # reference implementation; device_words.py documents the f32
+    # bin-edge caveat and the compact-key range gates (a trained vocab
+    # outside the ranges raises at table build → host path).
+    device_words = os.environ.get("ONIX_DEVICE_WORDS", "0") == "1"
+    # Flow tables are built lazily from the FIRST streamed chunk, whose
     # cols["proto_classes"] is the caller proto-id order the device
     # remap must key on (the fitted table is sorted — a different
     # beast; build_flow_tables' contract).
     dev_tables = None
+    walls.setdefault("stream_words_map", 0.0)
+    if device_words and datatype != "flow":
+        from onix.pipelines import device_words as dw
+        # Timed into stream_words_map like the flow build: the O(V+D)
+        # re-encode is pipeline work, identical accounting across
+        # datatypes.
+        t_build = time.monotonic()
+        try:
+            dev_tables = (dw.build_dns_tables(bundle, fitted_edges)
+                          if datatype == "dns"
+                          else dw.build_proxy_tables(bundle, fitted_edges))
+        except ValueError as e:
+            print(f"device words unavailable ({e}); using the host path")
+            device_words = False
+        walls["stream_words_map"] += time.monotonic() - t_build
     info["words_mode"] = "device" if device_words else "host"
     # Streamed chunks plant a day-proportional share of anomalies, not
     # a full day's worth per chunk: the streamed part of the run plants
@@ -279,7 +295,9 @@ def _stream_score(bundle, fitted_edges, theta, phi_wk, *, n_events: int,
     # stream_words_map is the real pipeline work (word creation +
     # trained-id mapping) and joins the pipeline-only rate.
     walls["stream_synth"] = 0.0
-    walls["stream_words_map"] = 0.0
+    # setdefault: the dns/proxy device-table build above already
+    # accumulated its re-encode time here.
+    walls.setdefault("stream_words_map", 0.0)
     walls["stream_score"] = 0.0
     offset = 0
     c = 0
@@ -329,9 +347,20 @@ def _stream_score(bundle, fitted_edges, theta, phi_wk, *, n_events: int,
 
         t = time.monotonic()
         if c > 0 and device_words:
-            top = dw.flow_stream_bottom_k(
-                dev_tables, table, cols, v_x=v_x, unseen_w=unseen_w,
-                unseen_d=unseen_d, tol=1.0, max_results=max_results)
+            if datatype == "flow":
+                top = dw.flow_stream_bottom_k(
+                    dev_tables, table, cols, v_x=v_x, unseen_w=unseen_w,
+                    unseen_d=unseen_d, tol=1.0, max_results=max_results)
+            elif datatype == "dns":
+                top = dw.dns_stream_bottom_k(
+                    dev_tables, table, cols, fitted_edges, v_x=v_x,
+                    unseen_w=unseen_w, unseen_d=unseen_d, tol=1.0,
+                    max_results=max_results)
+            else:
+                top = dw.proxy_stream_bottom_k(
+                    dev_tables, table, cols, fitted_edges, v_x=v_x,
+                    unseen_w=unseen_w, unseen_d=unseen_d, tol=1.0,
+                    max_results=max_results)
             del cols
         elif datatype == "flow":   # [src|dst] halves: fused pair-min path
             top = scoring.table_pair_bottom_k_fast(
