@@ -226,12 +226,20 @@ def bench_scoring_uniform(jax, jnp, small=False, checkpoint=None):
     }
 
 
-def bench_gibbs_sweep(jax, jnp, small=False):
+def bench_gibbs_sweep(jax, jnp, small=False, n_vocab=4_096):
     """Hot loop #2: tokens sampled per second per chip, full sweeps
-    chained inside one program (state evolves — nothing to hoist)."""
+    chained inside one program (state evolves — nothing to hoist).
+
+    Default V=4096 keeps round-over-round comparability with r1 — at
+    this benchmark's block size (2^16) it stays on the scatter path
+    because 2^16*4096 exceeds lda_gibbs._NWK_MATMUL_MAX_ELEMS (the
+    one-hot temporary bound; MAX_V alone would admit it). main() also
+    measures V=512 — the PRODUCT vocabulary shape the judged pipelines
+    actually run, where the n_wk scatter is collision-dense and the MXU
+    one-hot-matmul update auto-engages on TPU."""
     from onix.models import lda_gibbs
 
-    n_docs, n_vocab, k = 200_000, 4_096, 20
+    n_docs, k = 200_000, 20
     n_tokens = 1 << 21 if small else 1 << 23   # 8.4M ~ a large day/chip
     block = 1 << 16
     reps = 2 if small else 4
@@ -463,6 +471,8 @@ def _measure() -> None:
                                       checkpoint=checkpoint_a),
         assign=assign_uniform)
     run("gibbs_sweep", lambda: bench_gibbs_sweep(jax, jnp, small=fallback))
+    run("gibbs_sweep_product_vocab",
+        lambda: bench_gibbs_sweep(jax, jnp, small=fallback, n_vocab=512))
     # table strategy engages: D*V = 5.2e7 <= TABLE_MAX_ELEMS
     run("scoring_zipf_table",
         lambda: bench_scoring_zipf(jax, jnp, 100_000, 512,
