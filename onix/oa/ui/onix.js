@@ -233,13 +233,16 @@ function renderGraph(graph) {
   };
 }
 
-function openDrill(title, rows) {
+function openDrill(title, rows, { progression = false } = {}) {
   // Rows → label without touching the main table's ordering: render the
   // filtered rows in the drill panel with the same label controls
-  // (shared `labels` map, same Save button).
+  // (shared `labels` map, same Save button). `progression: true` adds
+  // the actor's incident-progression lanes (storyboard drills).
   document.getElementById("drill-title").textContent =
     `${title} — ${rows.length} suspicious row${rows.length === 1 ? "" : "s"}`;
   renderTable(rows, currentDate, document.getElementById("drill-table"));
+  document.getElementById("drill-progression").replaceChildren();
+  if (progression) renderProgression(rows);
   const panel = document.getElementById("drill-panel");
   panel.hidden = false;
   panel.scrollIntoView({ behavior: "smooth", block: "nearest" });
@@ -294,10 +297,7 @@ function renderEventTimeline(rows) {
     t.textContent = fmtScore(s);
     svg.append(t);
   });
-  // Hot = the lowest-score decile — the same "most suspicious first"
-  // emphasis as the graph's hot edges.
-  const sorted = [...pts].sort((a, b) => a.s - b.s);
-  const hotCut = sorted[Math.max(0, Math.floor(sorted.length / 10) - 1)].s;
+  const hotCut = hotCutOf(pts);
   for (const p of pts) {
     const c = svgEl("circle", {
       class: "evt" + (p.s <= hotCut ? " hot" : ""),
@@ -443,6 +443,77 @@ function sparkline(values, w = 120, h = 26) {
   return svg;
 }
 
+function hourGrid(svg, xOf, yTop, yBot, svgH) {
+  // Shared 6-hour grid + HH:00 labels (event timeline + progression).
+  for (let hh = 0; hh <= 24; hh += 6) {
+    svg.append(svgEl("line", { class: "grid", x1: xOf(hh), x2: xOf(hh),
+                               y1: yTop, y2: yBot }));
+    const t = svgEl("text", { x: xOf(hh) - 8, y: svgH - 2 });
+    t.textContent = `${String(hh).padStart(2, "0")}:00`;
+    svg.append(t);
+  }
+}
+
+function hotCutOf(pts) {
+  // Lowest-score decile — the shared "most suspicious" emphasis.
+  const sorted = [...pts].sort((a, b) => a.s - b.s);
+  return sorted[Math.max(0, Math.floor(sorted.length / 10) - 1)].s;
+}
+
+function renderProgression(rows) {
+  // Incident progression for one actor (the reference threat
+  // investigation's progression tree, README.md:45-48): the actor's
+  // suspicious events as time-ordered dots on one lane per peer,
+  // most-suspicious peer first — beacon trains and lateral spread read
+  // directly off the lanes. Rendered inside the drill panel when a
+  // storyboard card opens.
+  const box = document.getElementById("drill-progression");
+  const [, kt] = EDGE_KEYS[TYPE];
+  const pts = rows.map(r => ({ r, h: hourFracOf(r), peer: String(r[kt]),
+                               s: Number(r.score) }))
+    .filter(p => p.h !== null);
+  if (pts.length < 2) { box.replaceChildren(); return; }
+  const byPeer = new Map();
+  for (const p of pts) {
+    if (!byPeer.has(p.peer)) byPeer.set(p.peer, []);
+    byPeer.get(p.peer).push(p);
+  }
+  const lanes = [...byPeer.entries()]
+    .sort((a, b) => Math.min(...a[1].map(p => p.s))
+                  - Math.min(...b[1].map(p => p.s)))
+    .slice(0, 12);
+  const rowH = 16, padL = 130, svgW = 460, padB = 14;
+  const svgH = lanes.length * rowH + padB + 6;
+  const svg = svgEl("svg", { viewBox: `0 0 ${svgW} ${svgH}`,
+                             width: "100%", class: "progression" });
+  const xOf = h => padL + (svgW - padL - 6) * h / 24;
+  hourGrid(svg, xOf, 2, svgH - padB, svgH);
+  const hotCut = hotCutOf(pts);
+  lanes.forEach(([peer, ps], i) => {
+    const y = 10 + i * rowH;
+    const label = svgEl("text", { class: "node", x: padL - 6, y: y + 3,
+                                  "text-anchor": "end" });
+    label.textContent = peer;
+    svg.append(label);
+    const hs = ps.map(p => p.h);
+    svg.append(svgEl("line", { class: "lane", y1: y, y2: y,
+                               x1: xOf(Math.min(...hs)),
+                               x2: xOf(Math.max(...hs)) }));
+    for (const p of ps) {
+      const c = svgEl("circle", {
+        class: "evt" + (p.s <= hotCut ? " hot" : ""),
+        cx: xOf(p.h).toFixed(1), cy: y, r: 3,
+      });
+      const t = svgEl("title");
+      t.textContent = `${peer} · rank ${p.r.rank} · ` +
+        `score ${fmtScore(p.s)} · ${p.r[TIME_KEYS[TYPE]]}`;
+      c.append(t);
+      svg.append(c);
+    }
+  });
+  box.replaceChildren(svg);
+}
+
 function renderStoryboard(sb) {
   // The reference's threat storyboard (README.md:45-48) as cards: each
   // actor's narrative, activity sparkline, top peers; click → that
@@ -468,7 +539,8 @@ function renderStoryboard(sb) {
     card.addEventListener("click", () => {
       const set = new Set(t.ranks || []);
       openDrill(`threat ${t.entity}`,
-                allRows.filter(r => set.has(r.rank)));
+                allRows.filter(r => set.has(r.rank)),
+                { progression: true });
     });
     return card;
   }));

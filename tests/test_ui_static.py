@@ -208,3 +208,20 @@ def test_ingest_skip_reason_contract():
     timestamp-less small day 'too large' (review finding, round 3)."""
     assert "ing.hourly_skipped" in JS
     assert '"too_large"' in JS and '"no_timestamps"' in JS
+
+
+def test_incident_progression_contract():
+    """Storyboard drills render the actor's incident progression (peer
+    lanes over time) and every other drill clears it."""
+    assert "renderProgression" in JS
+    assert 'getElementById("drill-progression")' in JS
+    # The clear and the conditional render live INSIDE openDrill, so no
+    # call-ordering convention exists to regress; the storyboard drill
+    # opts in via the option.
+    body = JS[JS.index("function openDrill"):]
+    body = body[:body.index("\n}")]
+    assert ".replaceChildren()" in body
+    assert "if (progression) renderProgression(rows)" in body
+    assert "{ progression: true }" in JS     # storyboard card opts in
+    for rel, html in DASHBOARDS.items():
+        assert 'id="drill-progression"' in html, rel
