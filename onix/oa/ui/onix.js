@@ -285,13 +285,7 @@ function renderEventTimeline(rows) {
   const yOf = s => padT + (svgH - padT - padB)
     * (1 - (Math.log(s) - ll) / (lh - ll || 1));
   const xOf = h => padL + (svgW - padL - 6) * h / 24;
-  for (let hh = 0; hh <= 24; hh += 6) {
-    svg.append(svgEl("line", { class: "grid", x1: xOf(hh), x2: xOf(hh),
-                               y1: padT, y2: svgH - padB }));
-    const t = svgEl("text", { x: xOf(hh) - 8, y: svgH - 3 });
-    t.textContent = `${String(hh).padStart(2, "0")}:00`;
-    svg.append(t);
-  }
+  hourGrid(svg, xOf, padT, svgH - padB, svgH);
   [lo, hi].forEach(s => {
     const t = svgEl("text", { x: 1, y: yOf(s) + 3 });
     t.textContent = fmtScore(s);
