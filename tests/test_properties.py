@@ -112,3 +112,125 @@ def test_corpus_build_is_deterministic_partition(pairs):
     got_words = b1.vocab.words[b1.corpus.word_ids]
     np.testing.assert_array_equal(got_ips, ips)
     np.testing.assert_array_equal(got_words, words)
+
+
+# ---------------------------------------------------------------------------
+# Device-path compact-key re-encodings (onix/pipelines/device_words.py):
+# the int32 keys must be injective over every in-range field combination
+# — a collision would silently merge two trained words and corrupt
+# scores only in device mode.
+# ---------------------------------------------------------------------------
+
+
+_flow_fields = st.tuples(
+    st.integers(0, 65536),      # pclass (service port or the HH marker)
+    st.integers(0, 6),          # proto compact code (<_COMPACT_UNK=7)
+    st.integers(0, 7),          # hbin
+    st.integers(0, 7),          # bbin
+    st.integers(0, 7),          # pbin
+)
+
+
+@given(st.lists(_flow_fields, min_size=2, max_size=50, unique=True))
+def test_flow_compact_key_injective(combos):
+    from onix.pipelines.device_words import (_BIN_BITS, _PCLASS_SHIFT,
+                                             _PROTO_SHIFT)
+    keys = set()
+    for pclass, proto, hbin, bbin, pbin in combos:
+        k = (pclass << _PCLASS_SHIFT | proto << _PROTO_SHIFT
+             | hbin << (2 * _BIN_BITS) | bbin << _BIN_BITS | pbin)
+        assert 0 <= k < 2 ** 31
+        keys.add(k)
+    assert len(keys) == len(combos)
+
+
+_dns_fields = st.tuples(
+    st.integers(0, 7),          # flbin
+    st.integers(0, 7),          # hbin
+    st.integers(0, 7),          # ebin
+    st.integers(0, 7),          # slbin
+    st.integers(0, 6),          # nlabels (subdomain_split caps at 6)
+    st.integers(0, 255),        # qtype
+    st.integers(0, 15),         # rcode
+    st.integers(0, 1),          # tld
+)
+
+
+@given(st.lists(_dns_fields, min_size=2, max_size=50, unique=True))
+def test_dns_compact_key_injective(combos):
+    from onix.pipelines.device_words import (_DNS_EBIN_SHIFT,
+                                             _DNS_HBIN_SHIFT,
+                                             _DNS_NLABELS_SHIFT,
+                                             _DNS_QTYPE_SHIFT,
+                                             _DNS_RCODE_SHIFT,
+                                             _DNS_SLBIN_SHIFT,
+                                             _DNS_TLD_SHIFT)
+    keys = set()
+    for flb, hb, eb, slb, nl, qt, rc, tld in combos:
+        k = (flb | hb << _DNS_HBIN_SHIFT | eb << _DNS_EBIN_SHIFT
+             | slb << _DNS_SLBIN_SHIFT | nl << _DNS_NLABELS_SHIFT
+             | qt << _DNS_QTYPE_SHIFT | rc << _DNS_RCODE_SHIFT
+             | tld << _DNS_TLD_SHIFT)
+        assert 0 <= k < 2 ** 31
+        keys.add(k)
+    assert len(keys) == len(combos)
+
+
+_proxy_fields = st.tuples(
+    st.integers(0, 7),          # cclass
+    st.integers(0, 7),          # hbin
+    st.integers(0, 7),          # uebin
+    st.integers(0, 7),          # ulbin
+    st.integers(0, 1),          # hostip
+    st.integers(0, 126),        # ua compact (common ids + RARE=126)
+)
+
+
+@given(st.lists(_proxy_fields, min_size=2, max_size=50, unique=True))
+def test_proxy_compact_key_injective(combos):
+    from onix.pipelines.device_words import (_PROXY_HBIN_SHIFT,
+                                             _PROXY_HOSTIP_SHIFT,
+                                             _PROXY_UA_SHIFT,
+                                             _PROXY_UEBIN_SHIFT,
+                                             _PROXY_ULBIN_SHIFT)
+    keys = set()
+    for cc, hb, ueb, ulb, hip, ua in combos:
+        k = (cc | hb << _PROXY_HBIN_SHIFT | ueb << _PROXY_UEBIN_SHIFT
+             | ulb << _PROXY_ULBIN_SHIFT | hip << _PROXY_HOSTIP_SHIFT
+             | ua << _PROXY_UA_SHIFT)
+        assert 0 <= k < 2 ** 31
+        keys.add(k)
+    assert len(keys) == len(combos)
+
+
+@given(st.integers(0, 65536), st.integers(0, 2), st.integers(0, 7),
+       st.integers(0, 7), st.integers(0, 7))
+@settings(max_examples=30)
+def test_flow_build_tables_reencodes_spec_key(pclass, proto, hbin, bbin,
+                                              pbin):
+    """build_flow_tables' ACTUAL re-encode of a trained FLOW_SPEC key
+    must place every field at the documented compact shifts — a
+    one-word bundle through the real builder, not a formula replay."""
+    from types import SimpleNamespace
+
+    from onix.pipelines.device_words import (_BIN_BITS, _PCLASS_SHIFT,
+                                             _PROTO_SHIFT,
+                                             build_flow_tables)
+    from onix.pipelines.words import FLOW_SPEC
+    key64 = FLOW_SPEC.pack({
+        "proto": np.array([proto]), "pclass": np.array([pclass]),
+        "hbin": np.array([hbin]), "bbin": np.array([bbin]),
+        "pbin": np.array([pbin])})
+    classes = ["ICMP", "TCP", "UDP"]
+    bundle = SimpleNamespace(
+        word_key_sorted=key64, word_key_ids=np.array([7], np.int32),
+        doc_u32_sorted=np.array([1], np.uint32),
+        doc_u32_ids=np.array([0], np.int32))
+    edges = {"proto_classes": classes,
+             "hour": np.zeros(4), "log_ibyt": np.zeros(4),
+             "log_ipkt": np.zeros(4)}
+    tabs = build_flow_tables(bundle, edges, classes)
+    want = (pclass << _PCLASS_SHIFT | proto << _PROTO_SHIFT
+            | hbin << (2 * _BIN_BITS) | bbin << _BIN_BITS | pbin)
+    assert int(np.asarray(tabs.word_key_c)[0]) == want
+    assert int(np.asarray(tabs.word_ids)[0]) == 7
