@@ -124,12 +124,19 @@ class PipelineConfig:
     dupfactor: int = 1000       # analyst-labeled rows duplicated x this in corpus
     stream_max_docs: int = 0    # streaming doc-state bound (0 = unbounded):
     #                             LRU-evict idle IPs past this population
+    columnar: str = "auto"      # day-read mode for `onix score`: "on" always
+    #                             reads the store part-by-part into numeric
+    #                             columns (the 10^8+-row path), "off" keeps
+    #                             the pandas/string reference path, "auto"
+    #                             switches on COLUMNAR_AUTO_MIN_ROWS
 
     def validate(self) -> None:
         if self.datatype not in DATATYPES:
             raise ValueError(f"datatype must be one of {DATATYPES}")
         if self.max_results < 1:
             raise ValueError("max_results must be >=1")
+        if self.columnar not in ("auto", "on", "off"):
+            raise ValueError("pipeline.columnar must be auto|on|off")
         if self.dupfactor < 1:
             raise ValueError("dupfactor must be >=1")
         if self.stream_max_docs < 0:

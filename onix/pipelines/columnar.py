@@ -1,0 +1,218 @@
+"""Columnar day reading for the scoring CLI — the 10⁸⁺-row path.
+
+`run_scoring` historically read a stored day as ONE pandas frame and
+built words through the per-row string functions: correct, but a
+billion-row day neither fits in memory as objects nor survives per-row
+Python (reference contract README.md:42 "filter billion of events to a
+few thousands"). This module reads the day's parquet parts one at a
+time, converts each to the numeric/dictionary-encoded columns the
+`*_words_from_arrays` fast paths consume (words.py — bit-exact vs the
+string paths), and merges the per-part dictionaries, so `onix score`
+rides the same zero-per-row machinery the scale artifacts prove.
+
+Per-part memory is one part's frame; the merged output holds only
+numeric arrays (~tens of bytes/event) plus the tiny unique-string
+tables.
+"""
+
+from __future__ import annotations
+
+import re
+
+import numpy as np
+import pandas as pd
+
+from onix.pipelines.words import _factorize
+from onix.store import Store, hour_of
+
+_IPV4_RE = re.compile(r"^\d{1,3}\.\d{1,3}\.\d{1,3}\.\d{1,3}$")
+
+
+def _ips_u32(values: pd.Series, col: str) -> np.ndarray:
+    """IP column -> uint32, via the unique table (rows >> uniques, so
+    the per-string work is O(distinct IPs)). The u32 mapping must be
+    INJECTIVE on the day's strings for doc-identity parity with the
+    string path, so only canonical dotted-quad IPv4 is accepted — an
+    IPv6 or non-canonical string raises with guidance instead of
+    silently merging documents."""
+    from onix.ingest.nfdecode import str_to_ip
+
+    codes, uniq = _factorize(values.astype(str).to_numpy())
+    bad = [s for s in uniq if not _IPV4_RE.match(s)]
+    if not bad:
+        u32 = str_to_ip(uniq)
+        canon = [f"{v >> 24}.{(v >> 16) & 255}.{(v >> 8) & 255}.{v & 255}"
+                 for v in u32.tolist()]
+        bad = [s for s, c in zip(uniq, canon) if s != c]
+    if bad:
+        raise ValueError(
+            f"column {col!r} holds non-IPv4/non-canonical addresses "
+            f"(e.g. {bad[0]!r}); the columnar day reader needs a "
+            "canonical uint32 IP mapping — run with "
+            "pipeline.columnar=off for this day")
+    return u32[codes]
+
+
+def flow_frame_cols(df: pd.DataFrame) -> dict:
+    """One part's frame -> flow_words_from_arrays kwargs (same recipe
+    the words equivalence tests pin against the string path)."""
+    proto_codes, protos = _factorize(
+        df["proto"].astype(str).str.upper().to_numpy())
+    return {
+        "sip_u32": _ips_u32(df["sip"], "sip"),
+        "dip_u32": _ips_u32(df["dip"], "dip"),
+        "sport": df["sport"].to_numpy(np.int32),
+        "dport": df["dport"].to_numpy(np.int32),
+        "proto_id": proto_codes,
+        "hour": hour_of(df["treceived"]),
+        "ibyt": df["ibyt"].to_numpy(np.int64),
+        "ipkt": df["ipkt"].to_numpy(np.int64),
+        "proto_classes": protos,
+    }
+
+
+def dns_frame_cols(df: pd.DataFrame) -> dict:
+    codes, uniq = _factorize(df["dns_qry_name"].astype(str).to_numpy())
+    return {
+        "client_u32": _ips_u32(df["ip_dst"], "ip_dst"),
+        "qname_codes": codes,
+        "qnames": uniq,
+        "qtype": df["dns_qry_type"].to_numpy(np.int64),
+        "rcode": df["dns_qry_rcode"].to_numpy(np.int64),
+        "frame_len": df["frame_len"].to_numpy(np.float64),
+        "hour": hour_of(df["frame_time"]),
+    }
+
+
+def proxy_frame_cols(df: pd.DataFrame) -> dict:
+    uri_codes, uris = _factorize(df["uripath"].astype(str).to_numpy())
+    host_codes, hosts = _factorize(df["host"].astype(str).to_numpy())
+    ua_codes, agents = _factorize(df["useragent"].astype(str).to_numpy())
+    return {
+        "client_u32": _ips_u32(df["clientip"], "clientip"),
+        "uri_codes": uri_codes, "uris": uris,
+        "host_codes": host_codes, "hosts": hosts,
+        "ua_codes": ua_codes, "agents": agents,
+        "respcode": df["respcode"].to_numpy(np.int64),
+        "hour": hour_of(df["p_date"].astype(str) + " "
+                        + df["p_time"].astype(str)),
+    }
+
+
+FRAME_COLS = {"flow": flow_frame_cols, "dns": dns_frame_cols,
+              "proxy": proxy_frame_cols}
+
+# (dictionary-code column, unique-table column) pairs per datatype —
+# what merge_cols must re-key across parts.
+_DICT_PAIRS = {
+    "flow": (("proto_id", "proto_classes"),),
+    "dns": (("qname_codes", "qnames"),),
+    "proxy": (("uri_codes", "uris"), ("host_codes", "hosts"),
+              ("ua_codes", "agents")),
+}
+
+
+def merge_cols(datatype: str, parts: list[dict]) -> dict:
+    """Concatenate per-part column dicts; dictionary codes are re-keyed
+    into one merged unique table per string column (sorted-unique merge
+    + searchsorted remap — O(total uniques log uniques), tiny)."""
+    if len(parts) == 1:
+        return parts[0]
+    dict_pairs = _DICT_PAIRS[datatype]
+    uniq_cols = {u for _, u in dict_pairs}
+    out: dict = {}
+    for code_col, uniq_col in dict_pairs:
+        merged = np.unique(np.concatenate([p[uniq_col] for p in parts]))
+        remapped = []
+        for p in parts:
+            remap = np.searchsorted(merged, p[uniq_col])
+            remapped.append(remap[p[code_col]])
+        out[code_col] = np.concatenate(remapped)
+        out[uniq_col] = merged
+    for key in parts[0]:
+        if key in out or key in uniq_cols:
+            continue
+        out[key] = np.concatenate([p[key] for p in parts])
+    return out
+
+
+def read_day_cols(store: Store, datatype: str, date: str) -> dict:
+    """Read a stored day part by part into merged columnar form."""
+    pdir = store.partition_dir(datatype, date)
+    part_files = sorted(pdir.glob("part-*.parquet"))
+    if not part_files:
+        raise FileNotFoundError(
+            f"no data for {datatype} {date} under {pdir}")
+    to_cols = FRAME_COLS[datatype]
+    parts = [to_cols(pd.read_parquet(p)) for p in part_files]
+    return merge_cols(datatype, parts)
+
+
+def words_from_cols(datatype: str, cols: dict, edges: dict | None = None):
+    """Dispatch merged columns into the *_words_from_arrays fast path."""
+    from onix.pipelines.words import (dns_words_from_arrays,
+                                      flow_words_from_arrays,
+                                      proxy_words_from_arrays)
+
+    c = {k: v for k, v in cols.items() if k != "proto_classes"}
+    if datatype == "flow":
+        return flow_words_from_arrays(
+            **c, proto_classes=list(cols["proto_classes"]), edges=edges)
+    if datatype == "dns":
+        return dns_words_from_arrays(**c, edges=edges)
+    if datatype == "proxy":
+        return proxy_words_from_arrays(**c, edges=edges)
+    raise ValueError(f"unknown datatype {datatype!r}")
+
+
+# Frames below this many rows stay on the pandas/string path ("auto"):
+# the columnar win is memory/scan-speed at scale, and the string path
+# is the reference implementation the bit-exactness tests pin.
+COLUMNAR_AUTO_MIN_ROWS = 2_000_000
+
+
+def rows_at(store: Store, datatype: str, date: str,
+            indices: np.ndarray) -> pd.DataFrame:
+    """The selected raw rows by global day index, caller order
+    preserved — re-read part by part so only the few-thousand winners
+    ever materialize as pandas objects (the columnar path never holds
+    the day as a frame)."""
+    import pyarrow.parquet as pq
+
+    idx = np.asarray(indices, np.int64)
+    order = np.argsort(idx, kind="stable")
+    wanted = idx[order]
+    pdir = store.partition_dir(datatype, date)
+    chunks = []
+    offset = 0
+    for p in sorted(pdir.glob("part-*.parquet")):
+        n = pq.ParquetFile(p).metadata.num_rows
+        lo = np.searchsorted(wanted, offset)
+        hi = np.searchsorted(wanted, offset + n)
+        if hi > lo:
+            df = pd.read_parquet(p)
+            chunks.append(df.iloc[wanted[lo:hi] - offset])
+        offset += n
+    if wanted.size and wanted[-1] >= offset:
+        raise IndexError(f"row index {wanted[-1]} beyond day size {offset}")
+    if not chunks:
+        # Zero winners: an EMPTY frame with the day's full raw-column
+        # schema (parquet metadata only), matching table.iloc[[]].
+        import pyarrow.parquet as pq
+
+        first = sorted(pdir.glob("part-*.parquet"))[0]
+        return (pq.ParquetFile(first).schema_arrow.empty_table()
+                .to_pandas())
+    allf = pd.concat(chunks)
+    inv = np.empty(len(idx), np.int64)
+    inv[order] = np.arange(len(idx))
+    return allf.iloc[inv].reset_index(drop=True)
+
+
+def day_row_count(store: Store, datatype: str, date: str) -> int:
+    """Row count from parquet footers only — no data pages read."""
+    import pyarrow.parquet as pq
+
+    pdir = store.partition_dir(datatype, date)
+    return sum(pq.ParquetFile(p).metadata.num_rows
+               for p in sorted(pdir.glob("part-*.parquet")))

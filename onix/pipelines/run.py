@@ -164,12 +164,41 @@ def run_scoring(cfg: OnixConfig, engine: str = "gibbs",
              config_hash=cfg.config_hash)
 
     with log.stage("read"):
+        cols = None
         if table is None:
-            table = store.read(datatype, date)
-        n_events = len(table)
+            # Columnar day read (the 10^8+-row path, columnar.py): the
+            # day never materializes as one pandas frame — numeric
+            # columns + tiny unique-string tables per part, merged.
+            from onix.pipelines import columnar
+            mode = cfg.pipeline.columnar
+            if mode == "on" or (mode == "auto"
+                                and columnar.day_row_count(
+                                    store, datatype, date)
+                                >= columnar.COLUMNAR_AUTO_MIN_ROWS):
+                try:
+                    cols = columnar.read_day_cols(store, datatype, date)
+                    n_events = len(cols["hour"])
+                except ValueError as e:
+                    # e.g. non-IPv4 addresses: the u32 doc mapping
+                    # cannot hold. auto falls back to the reference
+                    # path (and says so); an explicit "on" propagates.
+                    if mode == "on":
+                        raise
+                    log.emit("columnar_fallback", reason=str(e)[:200])
+            if cols is None and table is None:
+                table = store.read(datatype, date)
+        if table is not None:
+            n_events = len(table)
+        log.emit("read_mode", columnar=cols is not None)
 
     with log.stage("word_creation", n_events=n_events):
-        words = WORD_FNS[datatype](table)
+        # Same words either way: the *_from_arrays paths are bit-exact
+        # vs the string paths (tests/test_words.py equivalence suite).
+        if cols is not None:
+            from onix.pipelines.columnar import words_from_cols
+            words = words_from_cols(datatype, cols)
+        else:
+            words = WORD_FNS[datatype](table)
     with log.stage("corpus_build"):
         feedback = load_feedback(cfg, datatype, date)
         bundle = build_corpus(words, feedback, cfg.pipeline.dupfactor)
@@ -204,7 +233,13 @@ def run_scoring(cfg: OnixConfig, engine: str = "gibbs",
     scoring_seconds = meter.seconds
     events_per_sec = meter.items / scoring_seconds if scoring_seconds else 0.0
 
-    results = table.iloc[top].copy()
+    if table is not None:
+        results = table.iloc[top].copy().reset_index(drop=True)
+    else:
+        # Columnar read: fetch just the winners' raw rows from the
+        # store parts (caller order = `top` order).
+        from onix.pipelines.columnar import rows_at
+        results = rows_at(store, datatype, date, top)
     results.insert(0, "score", ev_scores[top])
     results.insert(1, "event_idx", top)
     # Word/doc provenance: attribute each selected event to the token that

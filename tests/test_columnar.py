@@ -1,0 +1,144 @@
+"""Columnar day reading for the scoring CLI (onix/pipelines/columnar.py).
+
+Contract: `onix score` with pipeline.columnar=on produces byte-identical
+results to the pandas/string reference path on the same stored day —
+including multi-part days (dictionary merge + winners re-read) — and
+the auto mode switches on the row-count threshold.
+"""
+
+import json
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from onix.config import load_config
+from onix.pipelines import columnar
+from onix.pipelines.run import run_scoring
+from onix.pipelines.synth import DEMO_DATE, SYNTH
+from onix.store import Store, results_path
+
+DATE = DEMO_DATE
+
+
+def _cfg(tmp_path, datatype, extra=()):
+    return load_config(None, [
+        f"store.root={tmp_path}/store",
+        f"store.results_dir={tmp_path}/results-{extra[0].split('=')[-1]}"
+        if extra else f"store.results_dir={tmp_path}/results",
+        f"pipeline.datatype={datatype}",
+        f"pipeline.date={DATE}",
+        "lda.n_sweeps=12",
+        "lda.n_topics=8",
+        *extra,
+    ])
+
+
+def _store_two_parts(tmp_path, datatype, n=4000):
+    table, _ = SYNTH[datatype](n_events=n, n_anomalies=20, seed=3)
+    store = Store(f"{tmp_path}/store")
+    half = n // 2
+    store.append(datatype, DATE, table.iloc[:half])
+    store.append(datatype, DATE, table.iloc[half:])
+    return table
+
+
+@pytest.mark.parametrize("datatype", ["flow", "dns", "proxy"])
+def test_columnar_scoring_matches_reference_path(tmp_path, datatype):
+    _store_two_parts(tmp_path, datatype)
+    outs = {}
+    for mode in ("off", "on"):
+        cfg = _cfg(tmp_path, datatype,
+                   extra=(f"pipeline.columnar={mode}",))
+        assert run_scoring(cfg) == 0
+        res = results_path(cfg.store.results_dir, datatype, DATE)
+        outs[mode] = (pd.read_csv(res),
+                      json.loads(res.with_suffix(".manifest.json")
+                                 .read_text()))
+    df_off, man_off = outs["off"]
+    df_on, man_on = outs["on"]
+    pd.testing.assert_frame_equal(df_off, df_on)
+    for k in ("n_events", "n_docs", "n_vocab", "n_tokens", "n_results"):
+        assert man_off[k] == man_on[k], k
+
+
+def test_merge_cols_rekeys_dictionaries():
+    a = {"qname_codes": np.array([0, 1, 0]),
+         "qnames": np.asarray(["b.com", "a.com"], dtype=object),
+         "client_u32": np.array([1, 2, 3], np.uint32)}
+    b = {"qname_codes": np.array([0, 1]),
+         "qnames": np.asarray(["c.com", "a.com"], dtype=object),
+         "client_u32": np.array([4, 5], np.uint32)}
+    got = columnar.merge_cols("dns", [a, b])
+    uniq = got["qnames"]
+    names = uniq[got["qname_codes"]]
+    np.testing.assert_array_equal(
+        names, ["b.com", "a.com", "b.com", "c.com", "a.com"])
+    np.testing.assert_array_equal(got["client_u32"], [1, 2, 3, 4, 5])
+    assert sorted(uniq.tolist()) == uniq.tolist()   # merged table sorted
+
+
+def test_rows_at_spans_parts_and_preserves_order(tmp_path):
+    table = _store_two_parts(tmp_path, "flow", n=100)
+    store = Store(f"{tmp_path}/store")
+    idx = np.array([99, 0, 50, 49, 1])      # both parts, shuffled order
+    got = columnar.rows_at(store, "flow", DATE, idx)
+    want = table.iloc[idx].reset_index(drop=True)
+    pd.testing.assert_frame_equal(got, want)
+    with pytest.raises(IndexError):
+        columnar.rows_at(store, "flow", DATE, np.array([100]))
+
+
+def test_auto_mode_row_threshold(tmp_path, monkeypatch):
+    _store_two_parts(tmp_path, "flow", n=300)
+    store = Store(f"{tmp_path}/store")
+    assert columnar.day_row_count(store, "flow", DATE) == 300
+    # Below the threshold auto stays on pandas; shrink the threshold
+    # and the columnar reader engages (observed via the runlog event).
+    for floor, want in ((10 ** 9, False), (100, True)):
+        monkeypatch.setattr(columnar, "COLUMNAR_AUTO_MIN_ROWS", floor)
+        cfg = _cfg(tmp_path, "flow",
+                   extra=(f"store.results_dir={tmp_path}/r-{floor}",))
+        assert run_scoring(cfg) == 0
+        runlog = (results_path(f"{tmp_path}/r-{floor}", "flow", DATE)
+                  .with_suffix(".runlog.jsonl").read_text())
+        modes = [json.loads(l) for l in runlog.splitlines()
+                 if '"read_mode"' in l]
+        assert modes and modes[-1]["columnar"] is want
+
+
+def test_non_ipv4_day_rejected_on_and_falls_back_auto(tmp_path,
+                                                      monkeypatch):
+    table, _ = SYNTH["dns"](n_events=200, n_anomalies=5, seed=3)
+    table = table.copy()
+    table.loc[table.index[3], "ip_dst"] = "2001:db8::1"
+    Store(f"{tmp_path}/store").append("dns", DATE, table)
+    # Explicit on: loud rejection with guidance.
+    cfg = _cfg(tmp_path, "dns", extra=("pipeline.columnar=on",))
+    with pytest.raises(ValueError, match="columnar=off"):
+        run_scoring(cfg)
+    # auto: falls back to the reference path and completes.
+    monkeypatch.setattr(columnar, "COLUMNAR_AUTO_MIN_ROWS", 10)
+    cfg = _cfg(tmp_path, "dns",
+               extra=(f"store.results_dir={tmp_path}/r-fb",))
+    assert run_scoring(cfg) == 0
+    runlog = (results_path(f"{tmp_path}/r-fb", "dns", DATE)
+              .with_suffix(".runlog.jsonl").read_text())
+    assert "columnar_fallback" in runlog
+
+
+def test_empty_results_schema_matches_reference(tmp_path):
+    """tol below every score: zero winners must still write the full
+    raw-column schema on the columnar path (review finding)."""
+    _store_two_parts(tmp_path, "flow", n=400)
+    cols_csv = {}
+    for mode in ("off", "on"):
+        cfg = _cfg(tmp_path, "flow", extra=(
+            f"store.results_dir={tmp_path}/r0-{mode}",
+            f"pipeline.columnar={mode}", "pipeline.tol=1e-30"))
+        assert run_scoring(cfg) == 0
+        df = pd.read_csv(results_path(f"{tmp_path}/r0-{mode}", "flow",
+                                      DATE))
+        assert len(df) == 0
+        cols_csv[mode] = df.columns.tolist()
+    assert cols_csv["on"] == cols_csv["off"]
