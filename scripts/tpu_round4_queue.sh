@@ -1,0 +1,83 @@
+#!/bin/bash
+# Round-4 TPU measurement queue: polls the tunnel and fires the judged
+# measurements in value order the moment the device answers. Each step
+# has a hard timeout; artifacts are only written by completed runs
+# (scale.py writes its manifest at the end; the bench line is
+# JSON-validated before replacing the canonical builder artifact, and a
+# watchdog-cut partial line can never clobber a complete one).
+# Usage: nohup bash scripts/tpu_round4_queue.sh > /tmp/tpu_r04.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.."
+
+probe() {
+  timeout 75 python -c "
+import jax, jax.numpy as jnp
+x = jnp.ones((256, 256)); float((x @ x).sum())
+assert jax.devices()[0].platform not in ('cpu',)
+print('TPU OK')" 2>/dev/null | grep -q "TPU OK"
+}
+
+echo "[$(date +%T)] waiting for a live tunnel..."
+until probe; do sleep 90; done
+echo "[$(date +%T)] tunnel up — round-4 sequence"
+
+run_step() {  # name timeout_s command...
+  local name=$1 tmo=$2; shift 2
+  echo "[$(date +%T)] step $name (timeout ${tmo}s): $*"
+  timeout "$tmo" "$@" > "/tmp/step_$name.log" 2>&1
+  local rc=$?
+  echo "[$(date +%T)] step $name rc=$rc (log /tmp/step_$name.log)"
+  return $rc
+}
+
+# 1. Judged bench (screened + product-vocab gibbs arms). Complete runs
+#    go to the canonical builder artifact; watchdog-cut partials go to
+#    the sidecar so a hang can't clobber full evidence.
+if run_step bench_r04 3000 python bench.py; then
+  tail -1 /tmp/step_bench_r04.log | python -c "
+import json, sys
+line = sys.stdin.readline()
+doc = json.loads(line)
+assert doc['metric'] and 'value' in doc
+dst = ('docs/BENCH_r04_builder.json'
+       if 'watchdog' not in doc['detail'] else
+       'docs/BENCH_r04_builder_partial.json')
+open(dst, 'w').write(line)
+print('bench ->', dst, doc['value'])" \
+    || echo "bench line failed validation — artifacts untouched"
+fi
+
+# 2. Fit-gap diagnosis (matmul n_wk verdict at the real corpus shape) —
+#    cheap, and its verdict decides whether the scale reruns below get
+#    the fast fit. Runs before the big scale jobs for that reason.
+run_step fit_gap 3600 python scripts/exp_fit_gap.py 5e7
+
+# 3. Device-words at 1e8 flow (validates the words-on-chip lever).
+run_step flow1e8_dev 3600 env ONIX_DEVICE_WORDS=1 \
+  python -m onix.pipelines.scale --events 1e8 --train-events 2e7 \
+  --out docs/SCALE_FLOW_DEVWORDS_r04.json
+
+# 4. The 1B day with device words (candidate headline config).
+run_step scale1b_dev 7200 env ONIX_DEVICE_WORDS=1 \
+  python -m onix.pipelines.scale --events 1e9 --train-events 1e8 \
+  --out docs/SCALE_1B_DEVWORDS_r04.json
+
+# 5. DNS/proxy 1e8 reruns — gibbs_fit dominated both walls; the
+#    auto-engaged matmul update is the candidate win.
+run_step scale_dns 5400 python -m onix.pipelines.scale --datatype dns \
+  --events 1e8 --out docs/SCALE_DNS_r04.json
+run_step scale_proxy 5400 python -m onix.pipelines.scale --datatype proxy \
+  --events 1e8 --out docs/SCALE_PROXY_r04.json
+
+# 6. Streaming rerun (configs[4]) with whatever host-path speedups the
+#    round has landed by the time the tunnel answers.
+run_step stream 3600 python scripts/stream_scale.py \
+  --out docs/STREAM_r04.json
+
+# 7. Flow planted-recall diagnosis at 1e8 (VERDICT r03 next #4): score
+#    distributions of planted vs background, recall at several depths.
+if [ -f scripts/exp_flow_recall.py ]; then
+  run_step flow_recall 3600 python scripts/exp_flow_recall.py
+fi
+
+echo "[$(date +%T)] round-4 sequence complete"
