@@ -137,13 +137,23 @@ def chunked_to_global_nwk(nwk_chunks: np.ndarray, n_vocab: int) -> np.ndarray:
 
 
 class ShardedGibbsState(NamedTuple):
-    z: jax.Array         # int32 [P, M, nb, B] (K sentinel = padding)
-    n_dk: jax.Array      # int32 [P, Dl, K] doc-topic, data-sharded
-    n_wk: jax.Array      # int32 [M, Vc, K] topic-word chunks, mp-sharded
-    n_k: jax.Array       # int32 [K] replicated
-    keys: jax.Array      # [P, M, 2] uint32 per-device PRNG keys
-    acc_ndk: jax.Array   # float32 [P, Dl, K]
-    acc_nwk: jax.Array   # float32 [M, Vc, K]
+    """Device-sharded sampler state with an UNSHARDED chain axis C.
+
+    C > 1 gives the sharded engine the same restart-ensemble estimator
+    the judged overlap bar rides on the single-device engine
+    (docs/OVERLAP.md): each device vmaps C independent chains over its
+    local tokens, so C chains cost ~one sweep of C× the tokens and the
+    per-sweep psum reduces all chains' deltas in one collective. The
+    chain axis sits BEHIND the device axes so the PartitionSpecs are
+    identical for every C (chains are replicated work, not sharded)."""
+
+    z: jax.Array         # int32 [P, M, C, nb, B] (K sentinel = padding)
+    n_dk: jax.Array      # int32 [P, C, Dl, K] doc-topic, data-sharded
+    n_wk: jax.Array      # int32 [M, C, Vc, K] topic-word chunks, mp-sharded
+    n_k: jax.Array       # int32 [C, K] replicated
+    keys: jax.Array      # [P, M, C, 2] uint32 per-device/chain PRNG keys
+    acc_ndk: jax.Array   # float32 [P, C, Dl, K]
+    acc_nwk: jax.Array   # float32 [M, C, Vc, K]
     n_acc: jax.Array     # int32 []
 
 
@@ -196,15 +206,25 @@ class ShardedGibbsLDA:
                 n_dk_v = (jax.lax.pcast(n_dk[0], M, to="varying")
                           if M else n_dk[0])
                 n_k_v = jax.lax.pcast(n_k, both, to="varying")
-                # Leading shard axes of size (1, 1) inside shard_map.
-                z, n_dk_new, n_wk_new, n_k_new, key = _local_sweep(
-                    z[0, 0], n_dk_v, n_wk_v, n_k_v, keys[0, 0],
-                    d[0, 0], w[0, 0], m[0, 0],
-                    alpha=config.alpha, eta=config.eta,
-                    n_vocab=n_vocab, k_topics=k)
+                # Leading shard axes of size (1, 1) inside shard_map;
+                # the remaining leading axis is the chain axis C: the
+                # SAME local token blocks, C independent sampler states,
+                # batched by vmap into one program.
+                d0, w0, m0 = d[0, 0], w[0, 0], m[0, 0]
+
+                def one_chain(zc, ndkc, nwkc, nkc, keyc):
+                    return _local_sweep(
+                        zc, ndkc, nwkc, nkc, keyc, d0, w0, m0,
+                        alpha=config.alpha, eta=config.eta,
+                        n_vocab=n_vocab, k_topics=k)
+
+                z, n_dk_new, n_wk_new, n_k_new, key = jax.vmap(one_chain)(
+                    z[0, 0], n_dk_v, n_wk_v, n_k_v, keys[0, 0])
                 # The MPI_Reduce+Bcast of the reference, as psums:
                 # chunk deltas over the data axes (ICI, then DCN),
                 # doc-topic deltas over mp, topic totals over both.
+                # All chains' deltas ride ONE collective (leading C axis
+                # reduces elementwise).
                 d_wk = jax.lax.psum(n_wk_new - n_wk_v, D)
                 d_dk = (jax.lax.psum(n_dk_new - n_dk_v, M)
                         if M else n_dk_new - n_dk_v)
@@ -239,26 +259,33 @@ class ShardedGibbsLDA:
             gathering θ or the corpus to the host."""
             def shard_fn(n_dk, n_wk, n_k, d, w, m):
                 n_k_v = jax.lax.pcast(n_k, both, to="varying")
-                ndk = n_dk[0].astype(jnp.float32)
-                theta = ((ndk + config.alpha)
-                         / (ndk.sum(-1, keepdims=True) + k * config.alpha))
-                nwk = n_wk[0].astype(jnp.float32)
-                phi = ((nwk + config.eta)
-                       / (n_k_v.astype(jnp.float32) + n_vocab * config.eta))
-
-                def block(carry, xs):
-                    s, t = carry
-                    db, wb, mb = xs
-                    p = jnp.sum(theta[db] * phi[wb], axis=-1)
-                    p = jnp.maximum(p, 1e-30)
-                    s = s + jnp.sum(mb * jnp.log(p))
-                    return (s, t + jnp.sum(mb)), None
-
+                d0, w0, m0 = d[0, 0], w[0, 0], m[0, 0]
                 zero = jax.lax.pcast(jnp.float32(0), both, to="varying")
-                (s, t), _ = jax.lax.scan(
-                    block, (zero, zero), (d[0, 0], w[0, 0], m[0, 0]))
-                return (jax.lax.psum(s, both)[None],
-                        jax.lax.psum(t, both)[None])
+
+                def one_chain(ndkc, nwkc, nkc):
+                    ndk = ndkc.astype(jnp.float32)
+                    theta = ((ndk + config.alpha)
+                             / (ndk.sum(-1, keepdims=True)
+                                + k * config.alpha))
+                    nwk = nwkc.astype(jnp.float32)
+                    phi = ((nwk + config.eta)
+                           / (nkc.astype(jnp.float32)
+                              + n_vocab * config.eta))
+
+                    def block(carry, xs):
+                        s, t = carry
+                        db, wb, mb = xs
+                        p = jnp.sum(theta[db] * phi[wb], axis=-1)
+                        p = jnp.maximum(p, 1e-30)
+                        s = s + jnp.sum(mb * jnp.log(p))
+                        return (s, t + jnp.sum(mb)), None
+
+                    (s, t), _ = jax.lax.scan(
+                        block, (zero, zero), (d0, w0, m0))
+                    return s, t
+
+                s, t = jax.vmap(one_chain)(n_dk[0], n_wk[0], n_k_v)
+                return jax.lax.psum(s, both), jax.lax.psum(t, both)
 
             mp_spec = (M,) if M else ()
             s, t = jax.shard_map(
@@ -267,7 +294,9 @@ class ShardedGibbsLDA:
                           P(D, *mp_spec), P(D, *mp_spec), P(D, *mp_spec)),
                 out_specs=(P(), P()),
             )(state.n_dk, state.n_wk, state.n_k, docs, words, mask)
-            return s[0] / jnp.maximum(t[0], 1.0)
+            # Per-chain corpus mean log-likelihood, averaged over chains
+            # (matches GibbsLDA's ll_chains).
+            return (s / jnp.maximum(t, 1.0)).mean()
 
         self._sweep = jax.jit(sweep_fn, static_argnames=("accumulate",),
                               donate_argnums=(0,))
@@ -288,27 +317,33 @@ class ShardedGibbsLDA:
     def init_state(self, sc: ShardedCorpus) -> ShardedGibbsState:
         cfg = self.config
         k = cfg.n_topics
+        C = cfg.n_chains
         p, m, nb, b = sc.doc_blocks.shape
         rng = np.random.default_rng(cfg.seed)
-        z = rng.integers(0, k, size=(p, m, nb, b)).astype(np.int32)
-        z = np.where(sc.mask_blocks > 0, z, k)
+        # Independent initial assignments per chain (the restart
+        # ensemble's whole point); padding shares the K sentinel.
+        z = rng.integers(0, k, size=(p, m, C, nb, b)).astype(np.int32)
+        z = np.where(sc.mask_blocks[:, :, None] > 0, z, k)
         # Exact global counts built host-side once (init only).
-        n_dk = np.zeros((p, sc.n_docs_local, k), np.int32)
-        n_wk = np.zeros((m, sc.n_vocab_local, k), np.int32)
-        flat_z = z.reshape(p, m, -1)
+        n_dk = np.zeros((p, C, sc.n_docs_local, k), np.int32)
+        n_wk = np.zeros((m, C, sc.n_vocab_local, k), np.int32)
+        flat_z = z.reshape(p, m, C, -1)
         flat_d = sc.doc_blocks.reshape(p, m, -1)
         flat_w = sc.word_blocks.reshape(p, m, -1)
         flat_m = sc.mask_blocks.reshape(p, m, -1) > 0
         for q in range(p):
             for c in range(m):
                 sel = flat_m[q, c]
-                np.add.at(n_dk[q], (flat_d[q, c][sel], flat_z[q, c][sel]), 1)
-                np.add.at(n_wk[c], (flat_w[q, c][sel], flat_z[q, c][sel]), 1)
-        n_k = n_wk.sum(axis=(0, 1)).astype(np.int32)
-        # Independent per-device streams: split, never adjacent raw seeds
-        # (seed and seed+1 would otherwise share p-1 of p streams).
+                for ch in range(C):
+                    np.add.at(n_dk[q, ch],
+                              (flat_d[q, c][sel], flat_z[q, c, ch][sel]), 1)
+                    np.add.at(n_wk[c, ch],
+                              (flat_w[q, c][sel], flat_z[q, c, ch][sel]), 1)
+        n_k = n_wk.sum(axis=(0, 2)).astype(np.int32)   # [C, K]
+        # Independent per-device/per-chain streams: split, never adjacent
+        # raw seeds (seed and seed+1 would otherwise share most streams).
         keys = jax.random.split(jax.random.PRNGKey(cfg.seed),
-                                p * m).reshape(p, m, -1)
+                                p * m * C).reshape(p, m, C, -1)
 
         specs = self._specs()
         shard = lambda spec: NamedSharding(self.mesh, spec)
@@ -316,8 +351,8 @@ class ShardedGibbsLDA:
             "z": jnp.asarray(z), "n_dk": jnp.asarray(n_dk),
             "n_wk": jnp.asarray(n_wk), "n_k": jnp.asarray(n_k),
             "keys": jnp.asarray(keys),
-            "acc_ndk": jnp.zeros((p, sc.n_docs_local, k), jnp.float32),
-            "acc_nwk": jnp.zeros((m, sc.n_vocab_local, k), jnp.float32),
+            "acc_ndk": jnp.zeros((p, C, sc.n_docs_local, k), jnp.float32),
+            "acc_nwk": jnp.zeros((m, C, sc.n_vocab_local, k), jnp.float32),
             "n_acc": jnp.zeros((), jnp.int32),
         }
         put = {name: (a if specs[name] is None
@@ -364,17 +399,16 @@ class ShardedGibbsLDA:
         n_sweeps = cfg.n_sweeps if n_sweeps is None else n_sweeps
         sc = self.prepare(corpus)
         docs, words, mask = self.device_corpus(sc)
-        # n_chains is a GibbsLDA-only knob this sampler never reads —
-        # normalize it out so toggling it cannot orphan sharded checkpoints.
-        import dataclasses as _dc
-        # layout=2: the mp-sharded state layout (n_wk [M,Vc,K], z/keys
-        # with an mp axis) — bumping it rejects checkpoints written by
-        # the earlier dp-only layout instead of crashing on restore.
-        fp = ckpt.fingerprint(_dc.replace(cfg, n_chains=1),
+        # layout=3: the chained state layout (chain axis C behind the
+        # shard axes on every array) — bumping it rejects checkpoints
+        # written by the earlier layouts instead of crashing on restore.
+        # n_chains is part of the config hash now that this engine
+        # reads it.
+        fp = ckpt.fingerprint(cfg,
                               sc.doc_map.shape[0] * sc.n_docs_local,
                               sc.n_vocab, corpus.n_tokens,
                               extra={"mesh": list(self.mesh.shape.values()),
-                                     "layout": 2})
+                                     "layout": 3})
         if checkpoint_dir is not None:
             import pathlib
             checkpoint_dir = pathlib.Path(checkpoint_dir) / fp
@@ -410,7 +444,12 @@ class ShardedGibbsLDA:
 
     def estimates(self, state: ShardedGibbsState, sc: ShardedCorpus,
                   n_docs: int) -> tuple[np.ndarray, np.ndarray]:
-        """Gather per-shard counts back to global doc/word order."""
+        """Gather per-shard counts back to global doc/word order.
+
+        Matches GibbsLDA's contract: n_chains == 1 returns theta [D, K]
+        and phi_wk [V, K]; n_chains > 1 stacks a leading chain axis
+        (theta [C, D, K], phi_wk [C, V, K]) that scoring.score_events
+        ensemble-averages over."""
         cfg = self.config
         use_acc = int(state.n_acc) > 0
         denom = max(float(state.n_acc), 1.0)
@@ -418,12 +457,20 @@ class ShardedGibbsLDA:
                  else np.asarray(state.n_dk, dtype=np.float64))
         nwk_c = (np.asarray(state.acc_nwk) / denom if use_acc
                  else np.asarray(state.n_wk, dtype=np.float64))
-        nwk = chunked_to_global_nwk(nwk_c, sc.n_vocab)
-        ndk = np.zeros((n_docs, cfg.n_topics))
+        C = ndk_s.shape[1]
         valid = sc.doc_map >= 0
-        ndk[sc.doc_map[valid]] = ndk_s[valid]
-        theta = (ndk + cfg.alpha) / (ndk.sum(-1, keepdims=True)
-                                     + cfg.n_topics * cfg.alpha)
-        phi_wk = (nwk + cfg.eta) / (nwk.sum(0, keepdims=True)
-                                    + self.n_vocab * cfg.eta)
-        return theta.astype(np.float32), phi_wk.astype(np.float32)
+        thetas, phis = [], []
+        for ch in range(C):
+            nwk = chunked_to_global_nwk(nwk_c[:, ch], sc.n_vocab)
+            ndk = np.zeros((n_docs, cfg.n_topics))
+            ndk[sc.doc_map[valid]] = ndk_s[:, ch][valid]
+            thetas.append((ndk + cfg.alpha)
+                          / (ndk.sum(-1, keepdims=True)
+                             + cfg.n_topics * cfg.alpha))
+            phis.append((nwk + cfg.eta) / (nwk.sum(0, keepdims=True)
+                                           + self.n_vocab * cfg.eta))
+        theta = np.stack(thetas).astype(np.float32)
+        phi_wk = np.stack(phis).astype(np.float32)
+        if C == 1:
+            return theta[0], phi_wk[0]
+        return theta, phi_wk

@@ -51,11 +51,11 @@ def load_feedback(cfg: OnixConfig, datatype: str, date: str) -> pd.DataFrame | N
 
 def fit_engine(cfg: OnixConfig, bundle: CorpusBundle, engine: str) -> dict:
     """Fit theta/phi_wk with the requested engine on the bundle's corpus."""
-    if engine != "gibbs" and cfg.lda.n_chains > 1:
+    if engine not in ("gibbs", "sharded") and cfg.lda.n_chains > 1:
         raise ValueError(
             f"lda.n_chains={cfg.lda.n_chains} is only implemented for the "
-            f"'gibbs' engine; the {engine!r} engine would silently run one "
-            "chain")
+            f"'gibbs' and 'sharded' engines; the {engine!r} engine would "
+            "silently run one chain")
     corpus = bundle.corpus
     # Resume-on-preemption (SURVEY.md §5.3-5.4): per-(datatype, date)
     # checkpoint dir, active when the config asks for it.

@@ -1,0 +1,96 @@
+"""OVERLAP_r04_sharded: the judged overlap pairing through the
+MULTI-CHIP engine.
+
+VERDICT r03 weak #5: the 0.95 bar was satisfied by GibbsLDA ensembles
+while ShardedGibbsLDA ignored n_chains — so "1B multi-chip AND >= 0.95
+overlap" had no single-engine path. The sharded engine now vmaps C
+independent chains per device (onix/parallel/sharded_gibbs.py); this
+driver runs the SAME rehearsal pairing as scripts/overlap_r03.py with
+engine="sharded" on a virtual 8-device CPU mesh (dp=8 — the SURVEY §4.3
+hardware-free stand-in the driver's dryrun also uses), producing the
+artifact that shows the multi-chip estimator meets the bar.
+
+    python scripts/overlap_r04_sharded.py --out docs/OVERLAP_r04_sharded.json
+"""
+import argparse
+import json
+import os
+import pathlib
+import sys
+import time
+
+import jax
+
+# Force a CPU 8-device mesh via BOTH the env and the live config — the
+# ambient sitecustomize imports jax (pinning the tunneled accelerator)
+# before this script runs (same trap as tests/conftest.py/bench.py).
+# XLA_FLAGS is read lazily at CPU client creation, so setting it here
+# (before any jax op) still yields 8 virtual devices.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from onix.pipelines.rehearsal import JUDGED_BAR, run_rehearsal  # noqa: E402
+from onix.pipelines.rehearsal import summarize_cells  # noqa: E402
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--events", type=int, default=100_000)
+    ap.add_argument("--sweeps", type=int, default=300)
+    ap.add_argument("--oracle-runs", type=int, default=16)
+    ap.add_argument("--chains", type=int, default=8)
+    ap.add_argument("--seeds", type=int, nargs="+", default=[5])
+    ap.add_argument("--datatypes", nargs="+",
+                    default=["flow", "dns", "proxy"])
+    ap.add_argument("--out", default="docs/OVERLAP_r04_sharded.json")
+    args = ap.parse_args()
+    assert len(jax.devices()) == 8, jax.devices()
+
+    cells = {}
+    t_all = time.monotonic()
+    for dt in args.datatypes:
+        for seed in args.seeds:
+            t = time.monotonic()
+            r = run_rehearsal(n_events=args.events, n_sweeps=args.sweeps,
+                              n_oracle_runs=args.oracle_runs,
+                              n_chains=args.chains, engine="sharded",
+                              seed=seed, datatype=dt)
+            cells[f"{dt}/seed{seed}"] = r
+            print(f"[{dt} seed={seed}] jax_vs_oracle={r['jax_vs_oracle']} "
+                  f"ceiling={r['oracle_vs_oracle']} "
+                  f"({time.monotonic() - t:.0f}s)", flush=True)
+            _write(args.out, cells, args, t_all, partial=True)
+    _write(args.out, cells, args, t_all, partial=False)
+    return 0
+
+
+def _write(out, cells, args, t_all, partial):
+    per_dt = summarize_cells(cells)
+    doc = {
+        "metric": "top-1000 suspicious-connect overlap vs oracle, "
+                  "min over seeds — SHARDED (multi-chip) engine",
+        "engine": "sharded_gibbs dp=8 virtual CPU mesh, vmapped chains",
+        "bar": JUDGED_BAR,
+        "partial": partial,
+        "per_datatype": per_dt,
+        "passes_bar_all": bool(per_dt) and all(
+            v["passes_bar_min"] for v in per_dt.values()) and not partial,
+        "seeds": args.seeds,
+        "n_events": args.events,
+        "n_sweeps": args.sweeps,
+        "wall_seconds_total": round(time.monotonic() - t_all, 1),
+        "cells": cells,
+    }
+    p = pathlib.Path(out)
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(json.dumps(doc, indent=2) + "\n")
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
