@@ -123,6 +123,8 @@ def main() -> int:
         "n_events_total": n_total,
         "device": str(jax.devices()[0]),
         "events_per_second_pipeline_only": round(n_total / pipe_wall, 1),
+        "pipeline_stage_walls_seconds": {
+            k: round(v, 2) for k, v in scorer.stage_walls.items()},
         "walls_seconds": {"synthesize": round(synth_wall, 2),
                           "pipeline": round(pipe_wall, 2)},
         "zero_lag_detection": {

@@ -10,8 +10,7 @@ import pandas as pd
 
 from onix.config import OnixConfig
 from onix.ingest.parsers import format_bluecoat
-from onix.pipelines.streaming import (DocTable, HashedVocabulary,
-                                      StreamingScorer, run_stream)
+from onix.pipelines.streaming import DocTable, StreamingScorer, run_stream
 from onix.pipelines.synth import synth_flow_day, synth_proxy_day
 
 
@@ -24,14 +23,48 @@ def _cfg(**lda_overrides) -> OnixConfig:
     return cfg.validate()
 
 
-def test_hashed_vocab_stable_across_instances():
-    words = np.array([f"w{i}_{i % 7}" for i in range(500)], dtype=object)
-    a = HashedVocabulary(1 << 13).ids(words)
-    b = HashedVocabulary(1 << 13).ids(words)
-    np.testing.assert_array_equal(a, b)          # process-stable hashing
+def test_bucket_of_keys_stable_and_uniform():
+    """Packed-key bucketing: process-stable, in-range, low collision at
+    light fill — the integer twin of the string-hash contract above."""
+    from onix.pipelines.streaming import _bucket_of_keys, _datatype_salt
+    keys = (np.arange(500, dtype=np.int64) * 131071 + 7)
+    salt = _datatype_salt("flow")
+    a = _bucket_of_keys(keys, salt, 1 << 13)
+    b = _bucket_of_keys(keys, salt, 1 << 13)
+    np.testing.assert_array_equal(a, b)
     assert a.min() >= 0 and a.max() < (1 << 13)
-    # Distinct words should rarely collide at this fill factor.
     assert len(np.unique(a)) >= 480
+    # Different datatypes salt differently (no systematic collisions).
+    c = _bucket_of_keys(keys, _datatype_salt("dns"), 1 << 13)
+    assert (a != c).any()
+
+
+def test_streaming_ipv6_batch_switches_to_string_docs():
+    """A mid-stream batch the columnar converter rejects (IPv6 source)
+    falls back to the string word path; previously-seen v4 docs keep
+    their identities across the one-way table conversion."""
+    from onix.pipelines.streaming import DocTable, U32DocTable
+    table, _ = synth_flow_day(n_events=600, n_hosts=50, n_anomalies=4,
+                              seed=3)
+    sc = StreamingScorer(_cfg(), "flow", n_buckets=1 << 12)
+    sc.process(table)
+    assert isinstance(sc.docs, U32DocTable)
+    docs_before = sc.docs.n_docs
+    keys_before = sc.docs.as_strings()
+
+    v6 = table.iloc[:50].copy().reset_index(drop=True)
+    v6.loc[:4, "sip"] = "2001:db8::1"          # rejects _ips_u32
+    res = sc.process(v6)
+    assert res.n_events == 50
+    assert isinstance(sc.docs, DocTable)
+    # Old v4 docs kept their ids (prefix preserved); v6 doc appended.
+    assert sc.docs.keys[:docs_before] == keys_before
+    assert "2001:db8::1" in sc.docs.keys
+
+    # Subsequent v4 batches keep scoring consistently in string mode.
+    res2 = sc.process(table.iloc[:100].reset_index(drop=True))
+    assert np.isfinite(res2.scores).all()
+    assert sc.docs.n_docs >= docs_before + 1
 
 
 def test_doc_table_first_seen_order():
@@ -244,8 +277,11 @@ def test_streaming_eviction_bounds_docs_and_checkpoint(tmp_path):
     assert sc._gamma.shape[0] <= 1024          # pow2 cap over max_docs
     assert sc._last_seen.shape[0] == sc._gamma.shape[0]
     # The latest batch's client IPs survived eviction (membership check
-    # — ids() would insert a missing key and mask the failure).
-    assert "10.5.0.0" in sc.docs.keys
+    # — ids() would insert a missing key and mask the failure). The
+    # columnar stream keys docs by uint32 IP.
+    from onix.ingest.nfdecode import str_to_ip
+    assert str_to_ip(np.array(["10.5.0.0"]))[0] in sc.docs.keys
+    assert "10.5.0.0" in sc.docs.as_strings()
     # Checkpoint carries columnar doc state trimmed to n_docs, no JSON
     # doc_keys blob.
     import json
@@ -282,7 +318,7 @@ def test_streaming_checkpoint_restore_after_eviction(tmp_path):
     b = StreamingScorer(cfg, "flow", n_buckets=1 << 12,
                         checkpoint_dir=tmp_path / "ck", max_docs=120)
     assert b._batch_no == 3
-    assert b.docs.keys == a.docs.keys
+    np.testing.assert_array_equal(b.docs.keys, a.docs.keys)
     table, _ = synth_flow_day(n_events=400, n_hosts=150, n_anomalies=4,
                               seed=13)
     np.testing.assert_allclose(b.process(table).scores, r_all[3],
