@@ -225,8 +225,20 @@ def make_block_step(*, alpha: float, eta: float, n_vocab: int,
         use_matmul = (nwk_matmul if nwk_matmul is not None
                       else (use_gumbel
                             and n_wk.shape[0] <= _NWK_MATMUL_MAX_V
+                            # Exactness bound: every output of the f32
+                            # accumulation is a sum of B {-1,0,1} terms,
+                            # so |output| <= B must stay below 2^24 or
+                            # integers stop being representable exactly.
+                            # MAX_ELEMS implies it for V >= 8 only; the
+                            # explicit bound covers tiny-V/huge-B days.
+                            and w.shape[0] < (1 << 24)
                             and w.shape[0] * n_wk.shape[0]
                             <= _NWK_MATMUL_MAX_ELEMS))
+        if nwk_matmul and w.shape[0] >= (1 << 24):
+            raise ValueError(
+                f"nwk_matmul=True with block size {w.shape[0]} >= 2^24: "
+                "the one-hot matmul's f32 accumulation is no longer "
+                "bit-exact at this block size")
         if use_matmul:
             oh_w = jax.nn.one_hot(w, n_wk.shape[0], dtype=jnp.bfloat16)
             d_wk = jax.lax.dot_general(

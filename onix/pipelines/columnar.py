@@ -38,6 +38,11 @@ def _ips_u32(values: pd.Series, col: str) -> np.ndarray:
     from onix.ingest.nfdecode import str_to_ip
 
     codes, uniq = _factorize(values.astype(str).to_numpy())
+    if uniq.size == 0:
+        # A zero-row part (empty day slice) has nothing to map; without
+        # this guard str_to_ip's vectorized split raises a bare
+        # IndexError instead of returning the empty mapping.
+        return np.zeros(0, np.uint32)
     bad = [s for s in uniq if not _IPV4_RE.match(s)]
     if not bad:
         u32 = str_to_ip(uniq)
