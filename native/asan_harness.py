@@ -110,9 +110,14 @@ def ipfix_blob(long_varlen=False, strip_template=False):
 
 
 def nfcapd_blob(compressed=False, bad_version=False, torn=False,
-                v6_row=False, huge_record_size=False):
+                v6_row=False, huge_record_size=False, compression=None,
+                corrupt_payload=False):
     """Minimal nfcapd layout-v1 file: header, stat record, one type-2
-    block with an extension-map record + two common records."""
+    block with an extension-map record + two common records.
+    `compression` ("lzo"/"lz4"/"bz2") really compresses the block via
+    the fixture encoders; `corrupt_payload` then truncates the
+    compressed payload mid-stream (a torn compressed block — the
+    decompressors must bounds-fail, not overrun)."""
     def common(flags, sport):
         body = struct.pack("<HHHHIIBBBBHH", flags, 0, 100, 200,
                            1467979200, 1467979260, 0, 0x18, 6, 0,
@@ -132,12 +137,45 @@ def nfcapd_blob(compressed=False, bad_version=False, torn=False,
     if huge_record_size:
         recs.append(struct.pack("<HH", 1, 60000))   # size past block end
     payload = b"".join(recs)
+    flags = 0x1 if compressed else 0
+    if compression is not None:
+        # Local stdlib-only encoders (the harness must not import the
+        # repo's Python package): LZO as one initial literal run + EOS
+        # (payload <= 238 bytes here), LZ4 as one all-literals
+        # sequence, BZ2 via the stdlib module. All are valid streams
+        # of their formats; the full-spec decoders are the target.
+        if compression == "lzo":
+            assert len(payload) <= 238, "harness lzo run limit"
+            flags, payload = 0x1, (bytes([len(payload) + 17]) + payload
+                                   + b"\x11\x00\x00")
+        elif compression == "lz4":
+            lit = len(payload)
+            head = bytes([min(lit, 15) << 4])
+            if lit >= 15:
+                rest = lit - 15
+                head += b"\xff" * (rest // 255) + bytes([rest % 255])
+            flags, payload = 0x10, head + payload
+        else:
+            import bz2
+            flags, payload = 0x8, bz2.compress(payload)
+        if corrupt_payload:
+            payload = payload[: len(payload) // 2]
     block = struct.pack("<IIHH", len(recs), len(payload), 2, 0) + payload
-    hdr = struct.pack("<HHII", 0xA50C, 7 if bad_version else 1,
-                      0x1 if compressed else 0, 1)
+    hdr = struct.pack("<HHII", 0xA50C, 7 if bad_version else 1, flags, 1)
     hdr += b"asan".ljust(128, b"\0")
     out = hdr + struct.pack("<Q", 2) + b"\0" * 128 + block
     return out[:len(out) - 9] if torn else out
+
+
+def _have_libbz2() -> bool:
+    import ctypes
+    for name in ("libbz2.so.1.0", "libbz2.so.1", "libbz2.so"):
+        try:
+            ctypes.CDLL(name)
+            return True
+        except OSError:
+            continue
+    return False
 
 
 def pcapng_blob(truncate=0, bad_bom=False):
@@ -249,8 +287,23 @@ def main() -> int:
         # compressed gate, torn block, bad version, lying record size
         ("nfcapd v1 happy path", nfcapd_blob(), 0),
         ("nfcapd v1 with ipv6 row", nfcapd_blob(v6_row=True), 0),
-        ("nfcapd compressed flag", nfcapd_blob(compressed=True), 1),
+        ("nfcapd lying compressed flag", nfcapd_blob(compressed=True), 1),
         ("nfcapd torn block", nfcapd_blob(torn=True), 1),
+        # compressed containers: happy decode per codec, then torn
+        # compressed payloads (the bounds checks ARE the product here)
+        ("nfcapd lzo compressed", nfcapd_blob(compression="lzo"), 0),
+        ("nfcapd lz4 compressed", nfcapd_blob(compression="lz4"), 0),
+        # BZ2 is dlopen-based: without a system libbz2 the decoder's
+        # documented fallback is rc 1 ("compression unavailable"), so
+        # the expected rc is probed, not assumed.
+        ("nfcapd bz2 compressed", nfcapd_blob(compression="bz2"),
+         0 if _have_libbz2() else 1),
+        ("nfcapd lzo torn payload",
+         nfcapd_blob(compression="lzo", corrupt_payload=True), 1),
+        ("nfcapd lz4 torn payload",
+         nfcapd_blob(compression="lz4", corrupt_payload=True), 1),
+        ("nfcapd bz2 torn payload",
+         nfcapd_blob(compression="bz2", corrupt_payload=True), 1),
         ("nfcapd bad layout version", nfcapd_blob(bad_version=True), 1),
         ("nfcapd record size past block end",
          nfcapd_blob(huge_record_size=True), 1),

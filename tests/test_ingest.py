@@ -691,6 +691,24 @@ def test_nfcapd_committed_fixture_decodes():
 
 
 @needs_decoder
+@pytest.mark.parametrize("codec", ["lzo", "lz4"])
+def test_nfcapd_committed_compressed_fixture_decodes(codec):
+    """Committed COMPRESSED fixtures (same flow day as the uncompressed
+    pin, re-encoded block-compressed once and committed — never
+    regenerated in CI) decode natively to the same rows, with no nfdump
+    installed (VERDICT r03 missing #1)."""
+    import pathlib
+    fx = pathlib.Path(__file__).parent / "fixtures"
+    out = nfd.decode_file(fx / f"nfcapd.201607081200.{codec}")
+    plain = nfd.decode_file(fx / "nfcapd.201607081200")
+    assert len(out) == len(plain) == 41
+    for col in ("sip", "dip", "sport", "dport", "proto", "ipkt", "ibyt"):
+        np.testing.assert_array_equal(out[col].to_numpy(object),
+                                      plain[col].to_numpy(object),
+                                      err_msg=col)
+
+
+@needs_decoder
 def test_nfcapd_hand_packed_layout_decodes():
     """An nfcapd v1 file assembled FIELD BY FIELD from the documented
     layout (nfdecode.cpp 'nfcapd v1' header comment) — independently of
@@ -764,21 +782,184 @@ def test_nfcapd_hand_packed_layout_decodes():
 
 
 @needs_decoder
-def test_nfcapd_compressed_falls_back_loudly():
-    """A compressed-flagged nfcapd file routes to the nfdump
-    passthrough; without the tool installed that is a DecoderUnavailable
-    with install guidance, never a silent wrong decode."""
-    import shutil
+def test_nfcapd_lying_compression_flag_rejected():
+    """A header claiming LZO compression over an UNCOMPRESSED payload is
+    a malformed file (the clean-room decoder finds garbage instructions)
+    — rejected loudly, never a silent wrong decode."""
     import tempfile
     table = _synth_flow_arrays(n=5, seed=31)
     data = nfd.write_nfcapd(table, compressed_flag=True)
     with tempfile.NamedTemporaryFile(suffix=".nfcapd", delete=False) as f:
         f.write(data)
         path = f.name
-    if shutil.which("nfdump"):
-        pytest.skip("real nfdump present; passthrough path exercised there")
-    with pytest.raises(nfd.DecoderUnavailable, match="COMPRESSED"):
+    with pytest.raises((ValueError, nfd.DecoderUnavailable)):
         nfd.decode_file(path)
+
+
+@needs_decoder
+@pytest.mark.parametrize("compression", ["lzo", "lz4", "bz2"])
+def test_nfcapd_compressed_roundtrip(compression):
+    """VERDICT r03 missing #1: block-compressed nfcapd (the common real
+    landing variant — nfdump -z/-y/-j) decodes NATIVELY, no nfdump
+    install. Same table through the compressed and uncompressed writers
+    must decode identically."""
+    import tempfile
+    table = _synth_flow_arrays(n=57, seed=33)
+    plain = nfd.write_nfcapd(table, records_per_block=20, n_v6_rows=2)
+    comp = nfd.write_nfcapd(table, records_per_block=20, n_v6_rows=2,
+                            compression=compression)
+    assert comp != plain and comp[4] != 0        # flag set, bytes differ
+
+    def decode(blob):
+        with tempfile.NamedTemporaryFile(suffix=".nfcapd",
+                                         delete=False) as f:
+            f.write(blob)
+            path = f.name
+        return nfd.decode_file(path)
+
+    a, b = decode(plain), decode(comp)
+    assert len(b) == 57
+    for col in a.columns:
+        np.testing.assert_array_equal(a[col].to_numpy(object),
+                                      b[col].to_numpy(object), err_msg=col)
+
+
+@needs_decoder
+def test_lz4_decoder_cross_validated_against_liblz4():
+    """The clean-room LZ4 block decoder must invert the REFERENCE
+    encoder (system liblz4), not just our own fixture writer — matches,
+    overlapping copies, long literal extensions included."""
+    import ctypes
+    try:
+        lz4 = ctypes.CDLL("liblz4.so.1")
+    except OSError:
+        pytest.skip("no system liblz4")
+    lib = nfd.load_library()
+    rng = np.random.default_rng(0)
+    cases = [
+        b"",
+        b"abc" * 1000,                            # dense matches
+        bytes(rng.integers(0, 256, 5000, dtype=np.uint8)),   # incompressible
+        bytes(rng.integers(0, 4, 100_000, dtype=np.uint8)),  # long runs
+        open(__file__, "rb").read(),              # real text
+    ]
+    for payload in cases:
+        bound = lz4.LZ4_compressBound(len(payload))
+        buf = ctypes.create_string_buffer(max(bound, 1))
+        n = lz4.LZ4_compress_default(payload, buf, len(payload), bound)
+        assert n > 0 or len(payload) == 0
+        out = np.zeros(max(len(payload), 1), np.uint8)
+        got = lib.onix_lz4_block_decode(
+            np.frombuffer(buf.raw[:n], np.uint8).ctypes.data_as(
+                ctypes.POINTER(ctypes.c_uint8)) if n else None,
+            n, out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            len(out))
+        if len(payload) == 0:
+            continue
+        assert got == len(payload), (got, len(payload))
+        assert out[:got].tobytes() == payload
+
+
+@needs_decoder
+def test_lzo_decoder_cross_validated_against_liblzo2():
+    """Mirror of the liblz4 cross-validation for LZO: when a system
+    liblzo2 is present, real lzo1x_1 streams (M1/M2/M3/M4 mixes the
+    fixture encoder never emits) must decode byte-identically. Skips
+    where the library is absent — the hand-stream test below pins those
+    instruction classes unconditionally either way."""
+    import ctypes
+    lzo = None
+    for name in ("liblzo2.so.2", "liblzo2.so"):
+        try:
+            lzo = ctypes.CDLL(name)
+            break
+        except OSError:
+            continue
+    if lzo is None:
+        pytest.skip("no system liblzo2")
+    rc = lzo.__lzo_init_v2(1, 2, 4, 4, 4, 8, 1, 8, 8, ctypes.sizeof(
+        ctypes.c_void_p))
+    assert rc == 0
+    lib = nfd.load_library()
+    rng = np.random.default_rng(2)
+    cases = [b"abc" * 2000,
+             bytes(rng.integers(0, 256, 8000, dtype=np.uint8)),
+             bytes(rng.integers(0, 5, 60_000, dtype=np.uint8)),
+             open(__file__, "rb").read()]
+    wrk = ctypes.create_string_buffer(1 << 17)   # LZO1X_1_MEM_COMPRESS
+    for payload in cases:
+        out = ctypes.create_string_buffer(len(payload) + len(payload) // 16
+                                          + 128)
+        out_len = ctypes.c_size_t(0)
+        rc = lzo.lzo1x_1_compress(payload, len(payload), out,
+                                  ctypes.byref(out_len), wrk)
+        assert rc == 0
+        dec = np.zeros(len(payload), np.uint8)
+        got = lib.onix_lzo1x_decode(
+            np.frombuffer(out.raw[:out_len.value], np.uint8)
+            .ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            out_len.value,
+            dec.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), len(dec))
+        assert got == len(payload), (got, len(payload))
+        assert dec.tobytes() == payload
+
+
+@needs_decoder
+def test_lzo_decoder_hand_streams_and_roundtrip():
+    """LZO1X decoder: hand-assembled streams pin the instruction classes
+    the fixture encoder doesn't emit (first-byte short run, M1 after
+    1-3 literals, M2, long-run extension), and the fixture encoder's
+    output (literal runs + M3 + trailing-literal rides + EOS) round
+    trips. Malformed streams return -1, never crash (ASan covers the
+    same surface natively)."""
+    import ctypes
+    lib = nfd.load_library()
+
+    def dec(stream: bytes, cap: int = 1 << 16):
+        out = np.zeros(cap, np.uint8)
+        src = np.frombuffer(stream, np.uint8)
+        got = lib.onix_lzo1x_decode(
+            src.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            len(stream),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), cap)
+        return got, out[:max(got, 0)].tobytes()
+
+    eos = bytes((0x11, 0x00, 0x00))
+    # First byte 21: 4 literals, state 4; then EOS.
+    got, out = dec(bytes([21]) + b"WXYZ" + eos)
+    assert (got, out) == (4, b"WXYZ")
+    # First byte 19: 2 literals (state 2) -> M1 t=1 h=0: copy 2 from
+    # distance (0<<2)+(1>>2)+1 = 1 -> "bbb"... then trailing t&3=1
+    # literal 'c'; EOS. Output: "ab" + "bb" + "c".
+    got, out = dec(bytes([19]) + b"ab" + bytes([1, 0]) + b"c" + eos)
+    assert (got, out) == (5, b"abbbc")
+    # Long literal run via t=0 extension: 18+237=255 'x's, then EOS.
+    got, out = dec(bytes([0, 237]) + b"x" * 255 + eos)
+    assert (got, out) == (255, b"x" * 255)
+    # M2 (t=69: 01_0_001_01): len 3, distance (h<<3)+1+1; h=0 -> 2;
+    # trailing t&3=1. After 4 literals "abcd": copy "cdc", then "Z".
+    got, out = dec(bytes([21]) + b"abcd" + bytes([69, 0]) + b"Z" + eos)
+    assert (got, out) == (8, b"abcdcdcZ")
+    # Malformed: truncated match header / missing EOS / bad distance.
+    assert dec(bytes([21]) + b"abcd" + bytes([69]))[0] == -1
+    assert dec(bytes([21]) + b"abcd")[0] == -1
+    assert dec(bytes([19]) + b"ab" + bytes([1, 200]) + b"c" + eos)[0] == -1
+    assert dec(b"")[0] == -1
+
+    # Fixture-encoder round trips, incl. payloads with 1-3 byte gaps
+    # between matches (trailing-literal ride) and huge literal runs.
+    from onix.ingest.nfdecode import _lzo1x_compress
+    rng = np.random.default_rng(1)
+    payloads = [
+        b"A" * 10_000,
+        (b"flowrec-0001" + bytes(range(48))) * 400,
+        b"ab" + b"XYZQ" * 600 + b"k",
+        bytes(rng.integers(0, 3, 50_000, dtype=np.uint8)),
+    ]
+    for p in payloads:
+        got, out = dec(_lzo1x_compress(p), cap=len(p) + 64)
+        assert got == len(p)
+        assert out == p
 
 
 @needs_decoder
