@@ -171,6 +171,11 @@ struct V9Record {
   uint8_t proto = 0, tcp_flags = 0;
   uint32_t first_ms = 0, last_ms = 0;
   bool has_first = false, has_last = false;
+  // IPv6 addresses (nfcapd container records with kFlagIpv6Addr): the
+  // 128-bit values as big-endian (hi, lo) u64 halves. v4 rows leave
+  // them zero with is_v6 false.
+  bool is_v6 = false;
+  uint64_t sip6_hi = 0, sip6_lo = 0, dip6_hi = 0, dip6_lo = 0;
 };
 
 // Sampling-scaled counters saturate at UINT32_MAX rather than wrapping
@@ -1075,18 +1080,25 @@ int nfcapd_walk_records(const uint8_t* blk, size_t blk_size,
       out.sport = le16(c + 24);
       out.dport = le16(c + 26);
       size_t d = 28;  // required extensions follow the fixed head
-      bool skip = false;
       if (rflags & kFlagIpv6Addr) {
-        // v6 flow: no u32 rendering in the flow schema — skip the
-        // row (consistently in count and decode).
-        skip = true;
+        // v6 flow: two 16-byte addresses stored big-endian. Decoded
+        // into (hi, lo) u64 halves; the SINK decides whether its
+        // output schema can carry them (the v4-only entry points
+        // filter, the v6-aware ones render strings host-side).
+        if (d + 32 > rsize) return -1;
+        out.is_v6 = true;
+        out.sip6_hi = ((uint64_t)be32(c + d) << 32) | be32(c + d + 4);
+        out.sip6_lo = ((uint64_t)be32(c + d + 8) << 32) | be32(c + d + 12);
+        out.dip6_hi = ((uint64_t)be32(c + d + 16) << 32) | be32(c + d + 20);
+        out.dip6_lo = ((uint64_t)be32(c + d + 24) << 32) | be32(c + d + 28);
+        d += 32;
       } else {
         if (d + 8 > rsize) return -1;
         out.sip = le32(c + d);
         out.dip = le32(c + d + 4);
         d += 8;
       }
-      if (!skip) {
+      {
         const size_t pkt_w = (rflags & kFlagPkts64) ? 8 : 4;
         const size_t byt_w = (rflags & kFlagBytes64) ? 8 : 4;
         if (d + pkt_w + byt_w > rsize) return -1;
@@ -1180,6 +1192,17 @@ extern "C" {
 int64_t nfcapd_count(const uint8_t* buf, int64_t len) {
   int64_t n = 0;
   const int64_t rc = nfcapd_walk(
+      buf, len, [&](const V9Record& r, double, double) {
+        if (!r.is_v6) ++n;  // v4-only output schema
+        return true;
+      });
+  return rc < 0 ? rc : n;
+}
+
+// Count ALL flow rows (v4 + v6) — pairs with nfcapd_decode_v6.
+int64_t nfcapd_count_all(const uint8_t* buf, int64_t len) {
+  int64_t n = 0;
+  const int64_t rc = nfcapd_walk(
       buf, len, [&](const V9Record&, double, double) {
         ++n;
         return true;
@@ -1215,9 +1238,56 @@ int64_t nfcapd_decode(const uint8_t* buf, int64_t len, int64_t n,
   int64_t i = 0;
   const int64_t rc = nfcapd_walk(
       buf, len, [&](const V9Record& r, double t0, double t1) {
+        if (r.is_v6) return true;  // v4-only output schema
         if (i >= n) return false;
         sip[i] = r.sip;
         dip[i] = r.dip;
+        sport[i] = r.sport;
+        dport[i] = r.dport;
+        proto[i] = r.proto;
+        tcp_flags[i] = r.tcp_flags;
+        dpkts[i] = r.dpkts;
+        doctets[i] = r.doctets;
+        start_ts[i] = t0;
+        end_ts[i] = t1;
+        ++i;
+        return true;
+      });
+  return rc < 0 ? rc : i;
+}
+
+// v6-aware decode: every flow row (count from nfcapd_count_all). v4
+// rows put the address in the *_lo halves with is_v6[i] = 0; v6 rows
+// carry the 128-bit addresses as big-endian (hi, lo) u64 halves with
+// is_v6[i] = 1 — the Python layer renders display strings per row
+// kind (SURVEY.md §2.1 #2's decoder scope; VERDICT r03 next #8).
+int64_t nfcapd_decode_v6(const uint8_t* buf, int64_t len, int64_t n,
+                         uint64_t* sip_hi, uint64_t* sip_lo,
+                         uint64_t* dip_hi, uint64_t* dip_lo,
+                         uint8_t* is_v6, uint16_t* sport, uint16_t* dport,
+                         uint8_t* proto, uint8_t* tcp_flags,
+                         uint32_t* dpkts, uint32_t* doctets,
+                         double* start_ts, double* end_ts) {
+  if (!sip_hi || !sip_lo || !dip_hi || !dip_lo || !is_v6 || !sport ||
+      !dport || !proto || !tcp_flags || !dpkts || !doctets || !start_ts ||
+      !end_ts)
+    return -1;
+  int64_t i = 0;
+  const int64_t rc = nfcapd_walk(
+      buf, len, [&](const V9Record& r, double t0, double t1) {
+        if (i >= n) return false;
+        if (r.is_v6) {
+          sip_hi[i] = r.sip6_hi;
+          sip_lo[i] = r.sip6_lo;
+          dip_hi[i] = r.dip6_hi;
+          dip_lo[i] = r.dip6_lo;
+        } else {
+          sip_hi[i] = 0;
+          sip_lo[i] = r.sip;
+          dip_hi[i] = 0;
+          dip_lo[i] = r.dip;
+        }
+        is_v6[i] = r.is_v6 ? 1 : 0;
         sport[i] = r.sport;
         dport[i] = r.dport;
         proto[i] = r.proto;

@@ -81,11 +81,19 @@ def load_library() -> ctypes.CDLL:
     lib.nfx_sampling.argtypes = [u8, ctypes.c_int64]
     lib.nfx_decode_scaled.restype = ctypes.c_int64
     lib.nfx_decode_scaled.argtypes = list(lib.nfx_decode.argtypes)
-    # nfcapd v1 container (clean-room reader; uncompressed files).
+    # nfcapd v1 container (clean-room reader; uncompressed or
+    # block-compressed files).
     lib.nfcapd_count.restype = ctypes.c_int64
     lib.nfcapd_count.argtypes = [u8, ctypes.c_int64]
     lib.nfcapd_decode.restype = ctypes.c_int64
     lib.nfcapd_decode.argtypes = list(lib.nfx_decode.argtypes)
+    u64 = ctypes.POINTER(ctypes.c_uint64)
+    lib.nfcapd_count_all.restype = ctypes.c_int64
+    lib.nfcapd_count_all.argtypes = [u8, ctypes.c_int64]
+    lib.nfcapd_decode_v6.restype = ctypes.c_int64
+    lib.nfcapd_decode_v6.argtypes = [
+        u8, ctypes.c_int64, ctypes.c_int64,
+        u64, u64, u64, u64, u8, u16, u16, u8, u8, u32, u32, f64, f64]
     # Raw block decompressors (tests cross-validate the clean-room LZ4
     # against the system liblz4; ASan drives torn/lying payloads).
     for fn in (lib.onix_lz4_block_decode, lib.onix_lzo1x_decode):
@@ -183,14 +191,17 @@ def _call_decode(fn, bp, n_bytes: int, n: int,
         p("start_ts", ctypes.c_double), p("end_ts", ctypes.c_double))
 
 
-def _arrays_to_table(arrays: dict[str, np.ndarray], n: int) -> pd.DataFrame:
+def _arrays_to_table(arrays: dict[str, np.ndarray], n: int,
+                     ips_rendered: bool = False) -> pd.DataFrame:
     """Decoded column arrays -> the ingest flow table schema (shared by
-    the wire-format and nfcapd-container decode paths)."""
+    the wire-format and nfcapd-container decode paths). With
+    `ips_rendered`, sip/dip are already display strings (the container
+    path's mixed v4/v6 rendering)."""
     ts = pd.to_datetime(arrays["start_ts"], unit="s")
     return pd.DataFrame({
         "treceived": ts.strftime("%Y-%m-%d %H:%M:%S"),
-        "sip": ip_to_str(arrays["sip"]),
-        "dip": ip_to_str(arrays["dip"]),
+        "sip": arrays["sip"] if ips_rendered else ip_to_str(arrays["sip"]),
+        "dip": arrays["dip"] if ips_rendered else ip_to_str(arrays["dip"]),
         "sport": arrays["sport"].astype(np.int32),
         "dport": arrays["dport"].astype(np.int32),
         "proto": np.array([PROTO_NAMES.get(x, str(x))
@@ -233,7 +244,7 @@ def decode_nfcapd(path: str | pathlib.Path) -> pd.DataFrame:
     lib = load_library()
     buf = np.frombuffer(data, np.uint8)
     bp = buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
-    n = lib.nfcapd_count(bp, len(data))
+    n = lib.nfcapd_count_all(bp, len(data))
     if n == -1:
         raise ValueError(f"malformed nfcapd file: {path}")
     if n == -3:
@@ -245,11 +256,53 @@ def decode_nfcapd(path: str | pathlib.Path) -> pd.DataFrame:
     # file or decoder gap): all adjudicated by the format owner's tool.
     if n < 0:
         return _decode_nfcapd_nfdump(path)
-    arrays = _flow_arrays(n)
-    wrote = _call_decode(lib.nfcapd_decode, bp, len(data), n, arrays)
+    arrays = {
+        "sip_hi": np.empty(n, np.uint64), "sip_lo": np.empty(n, np.uint64),
+        "dip_hi": np.empty(n, np.uint64), "dip_lo": np.empty(n, np.uint64),
+        "is_v6": np.empty(n, np.uint8),
+        "sport": np.empty(n, np.uint16), "dport": np.empty(n, np.uint16),
+        "proto": np.empty(n, np.uint8), "tcp_flags": np.empty(n, np.uint8),
+        "ipkt": np.empty(n, np.uint32), "ibyt": np.empty(n, np.uint32),
+        "start_ts": np.empty(n, np.float64), "end_ts": np.empty(n, np.float64),
+    }
+
+    def p(name, ct):
+        return arrays[name].ctypes.data_as(ctypes.POINTER(ct))
+
+    wrote = lib.nfcapd_decode_v6(
+        bp, len(data), n,
+        p("sip_hi", ctypes.c_uint64), p("sip_lo", ctypes.c_uint64),
+        p("dip_hi", ctypes.c_uint64), p("dip_lo", ctypes.c_uint64),
+        p("is_v6", ctypes.c_uint8),
+        p("sport", ctypes.c_uint16), p("dport", ctypes.c_uint16),
+        p("proto", ctypes.c_uint8), p("tcp_flags", ctypes.c_uint8),
+        p("ipkt", ctypes.c_uint32), p("ibyt", ctypes.c_uint32),
+        p("start_ts", ctypes.c_double), p("end_ts", ctypes.c_double))
     if wrote != n:
         raise ValueError(f"nfcapd decode error: wrote {wrote} of {n}")
-    return _arrays_to_table(arrays, n)
+    v6 = arrays["is_v6"] != 0
+    arrays["sip"] = _mixed_ip_strings(arrays["sip_hi"], arrays["sip_lo"], v6)
+    arrays["dip"] = _mixed_ip_strings(arrays["dip_hi"], arrays["dip_lo"], v6)
+    return _arrays_to_table(arrays, n, ips_rendered=True)
+
+
+def _mixed_ip_strings(hi: np.ndarray, lo: np.ndarray,
+                      v6: np.ndarray) -> np.ndarray:
+    """(hi, lo) u64 halves + v6 mask -> display strings: dotted-quad
+    for v4 rows, RFC 5952 compressed form for v6 (rendered per UNIQUE
+    128-bit value — v6 rows are typically few)."""
+    import ipaddress
+
+    out = np.empty(len(lo), object)
+    out[~v6] = ip_to_str(lo[~v6].astype(np.uint32)).astype(object)
+    if v6.any():
+        pairs = np.stack([hi[v6], lo[v6]], axis=1)
+        uniq, inv = np.unique(pairs, axis=0, return_inverse=True)
+        strs = np.array(
+            [ipaddress.IPv6Address((int(h) << 64) | int(l)).compressed
+             for h, l in uniq.tolist()], dtype=object)
+        out[v6] = strs[inv]
+    return out
 
 
 def _decode_nfcapd_nfdump(path: str | pathlib.Path) -> pd.DataFrame:

@@ -22,40 +22,73 @@ import re
 import numpy as np
 import pandas as pd
 
-from onix.pipelines.words import _factorize
+from onix.pipelines.words import IP_TAG, _factorize
 from onix.store import Store, hour_of
 
 _IPV4_RE = re.compile(r"^\d{1,3}\.\d{1,3}\.\d{1,3}\.\d{1,3}$")
 
 
-def _ips_u32(values: pd.Series, col: str) -> np.ndarray:
-    """IP column -> uint32, via the unique table (rows >> uniques, so
-    the per-string work is O(distinct IPs)). The u32 mapping must be
-    INJECTIVE on the day's strings for doc-identity parity with the
-    string path, so only canonical dotted-quad IPv4 is accepted — an
-    IPv6 or non-canonical string raises with guidance instead of
-    silently merging documents."""
+# Doc-key encoding: canonical v4 keys are the u32 address value; keys
+# with words.IP_TAG set index the day's sorted dictionary of other
+# strings (IPv6, non-canonical v4). A pure-v4 day downcasts to uint32
+# and takes the fast path everywhere.
+
+
+def _canonical_v4_mask(uniq: np.ndarray):
+    """(mask of canonical dotted-quad v4 strings, their u32 values)."""
     from onix.ingest.nfdecode import str_to_ip
 
-    codes, uniq = _factorize(values.astype(str).to_numpy())
-    if uniq.size == 0:
-        # A zero-row part (empty day slice) has nothing to map; without
-        # this guard str_to_ip's vectorized split raises a bare
-        # IndexError instead of returning the empty mapping.
-        return np.zeros(0, np.uint32)
-    bad = [s for s in uniq if not _IPV4_RE.match(s)]
-    if not bad:
-        u32 = str_to_ip(uniq)
-        canon = [f"{v >> 24}.{(v >> 16) & 255}.{(v >> 8) & 255}.{v & 255}"
-                 for v in u32.tolist()]
-        bad = [s for s, c in zip(uniq, canon) if s != c]
-    if bad:
-        raise ValueError(
-            f"column {col!r} holds non-IPv4/non-canonical addresses "
-            f"(e.g. {bad[0]!r}); the columnar day reader needs a "
-            "canonical uint32 IP mapping — run with "
-            "pipeline.columnar=off for this day")
-    return u32[codes]
+    shaped = np.array([bool(_IPV4_RE.match(s)) for s in uniq])
+    vals = np.zeros(len(uniq), np.uint32)
+    if shaped.any():
+        v4 = str_to_ip(uniq[shaped])
+        canon = np.array(
+            [f"{v >> 24}.{(v >> 16) & 255}.{(v >> 8) & 255}.{v & 255}"
+             for v in v4.tolist()], dtype=object)
+        ok = canon == uniq[shaped]
+        shaped[np.flatnonzero(shaped)[~ok]] = False
+        vals[shaped] = v4[ok]
+    return shaped, vals[shaped]
+
+
+def _ip_keys(series: list[pd.Series]) -> tuple[list[np.ndarray], np.ndarray]:
+    """IP columns -> per-column uint64 doc keys + the shared dictionary
+    table, via the joint unique set (rows >> uniques, so per-string
+    work is O(distinct IPs)). Doc identity is the raw STRING — exactly
+    the pandas path's semantics — so canonical v4 maps to its u32 value
+    and everything else (IPv6, non-canonical v4) gets a tagged index
+    into one per-day sorted dictionary SHARED by all columns (the same
+    address in sip and dip must be one document)."""
+    arrs = [s.astype(str).to_numpy() for s in series]
+    if sum(len(a) for a in arrs) == 0:
+        return [np.zeros(0, np.uint64) for _ in arrs], np.empty(0, object)
+    joint = np.concatenate([np.asarray(a, object) for a in arrs])
+    uniq, inv = np.unique(joint, return_inverse=True)
+    is_v4, v4_vals = _canonical_v4_mask(uniq)
+    keys = np.zeros(len(uniq), np.uint64)
+    keys[is_v4] = v4_vals.astype(np.uint64)
+    table = uniq[~is_v4]                      # already sorted (np.unique)
+    keys[~is_v4] = IP_TAG | np.arange(len(table), dtype=np.uint64)
+    flat = keys[inv]
+    out, lo = [], 0
+    for a in arrs:
+        out.append(flat[lo:lo + len(a)])
+        lo += len(a)
+    return out, table
+
+
+def _ip_cols(series: list[pd.Series], names: list[str]) -> dict:
+    """IP columns -> frame-cols entries: pure-v4 parts downcast to the
+    uint32 fast path under `<name>_u32`; a part with any IPv6 or
+    non-canonical string ships uint64 keys under `<name>_u64` plus the
+    shared `ip_table` dictionary."""
+    keys, table = _ip_keys(series)
+    if len(table) == 0:
+        return {f"{n}_u32": k.astype(np.uint32)
+                for n, k in zip(names, keys)}
+    out = {f"{n}_u64": k for n, k in zip(names, keys)}
+    out["ip_table"] = table
+    return out
 
 
 def flow_frame_cols(df: pd.DataFrame) -> dict:
@@ -64,8 +97,7 @@ def flow_frame_cols(df: pd.DataFrame) -> dict:
     proto_codes, protos = _factorize(
         df["proto"].astype(str).str.upper().to_numpy())
     return {
-        "sip_u32": _ips_u32(df["sip"], "sip"),
-        "dip_u32": _ips_u32(df["dip"], "dip"),
+        **_ip_cols([df["sip"], df["dip"]], ["sip", "dip"]),
         "sport": df["sport"].to_numpy(np.int32),
         "dport": df["dport"].to_numpy(np.int32),
         "proto_id": proto_codes,
@@ -79,7 +111,7 @@ def flow_frame_cols(df: pd.DataFrame) -> dict:
 def dns_frame_cols(df: pd.DataFrame) -> dict:
     codes, uniq = _factorize(df["dns_qry_name"].astype(str).to_numpy())
     return {
-        "client_u32": _ips_u32(df["ip_dst"], "ip_dst"),
+        **_ip_cols([df["ip_dst"]], ["client"]),
         "qname_codes": codes,
         "qnames": uniq,
         "qtype": df["dns_qry_type"].to_numpy(np.int64),
@@ -94,7 +126,7 @@ def proxy_frame_cols(df: pd.DataFrame) -> dict:
     host_codes, hosts = _factorize(df["host"].astype(str).to_numpy())
     ua_codes, agents = _factorize(df["useragent"].astype(str).to_numpy())
     return {
-        "client_u32": _ips_u32(df["clientip"], "clientip"),
+        **_ip_cols([df["clientip"]], ["client"]),
         "uri_codes": uri_codes, "uris": uris,
         "host_codes": host_codes, "hosts": hosts,
         "ua_codes": ua_codes, "agents": agents,
@@ -117,15 +149,47 @@ _DICT_PAIRS = {
 }
 
 
+_IP_COL_NAMES = {"flow": ("sip", "dip"), "dns": ("client",),
+                 "proxy": ("client",)}
+
+
+def _merge_ip_keys(datatype: str, parts: list[dict]) -> dict:
+    """Unify the per-part IP key spaces: if ANY part carries a
+    dictionary (`ip_table`), upcast every part to u64 keys and re-index
+    tagged entries against the merged sorted table."""
+    names = _IP_COL_NAMES[datatype]
+    if not any("ip_table" in p for p in parts):
+        return {}
+    merged = np.unique(np.concatenate(
+        [p.get("ip_table", np.empty(0, object)) for p in parts]))
+    out: dict = {"ip_table": merged}
+    for n in names:
+        pieces = []
+        for p in parts:
+            if f"{n}_u32" in p:
+                pieces.append(p[f"{n}_u32"].astype(np.uint64))
+                continue
+            k = p[f"{n}_u64"]
+            tagged = (k & IP_TAG) != 0
+            k = k.copy()
+            idx = (k[tagged] & ~IP_TAG).astype(np.int64)
+            k[tagged] = IP_TAG | np.searchsorted(
+                merged, p["ip_table"][idx]).astype(np.uint64)
+            pieces.append(k)
+        out[f"{n}_u64"] = np.concatenate(pieces)
+    return out
+
+
 def merge_cols(datatype: str, parts: list[dict]) -> dict:
     """Concatenate per-part column dicts; dictionary codes are re-keyed
     into one merged unique table per string column (sorted-unique merge
     + searchsorted remap — O(total uniques log uniques), tiny)."""
     if len(parts) == 1:
         return parts[0]
+    ip_merged = _merge_ip_keys(datatype, parts)
     dict_pairs = _DICT_PAIRS[datatype]
     uniq_cols = {u for _, u in dict_pairs}
-    out: dict = {}
+    out: dict = dict(ip_merged)
     for code_col, uniq_col in dict_pairs:
         merged = np.unique(np.concatenate([p[uniq_col] for p in parts]))
         remapped = []
@@ -134,8 +198,13 @@ def merge_cols(datatype: str, parts: list[dict]) -> dict:
             remapped.append(remap[p[code_col]])
         out[code_col] = np.concatenate(remapped)
         out[uniq_col] = merged
+    # Per-part IP columns already unified above when any part carried a
+    # dictionary; their per-part names must not re-concatenate.
+    ip_handled = ({f"{n}_u32" for n in _IP_COL_NAMES[datatype]}
+                  | {f"{n}_u64" for n in _IP_COL_NAMES[datatype]}
+                  | {"ip_table"} if ip_merged else set())
     for key in parts[0]:
-        if key in out or key in uniq_cols:
+        if key in out or key in uniq_cols or key in ip_handled:
             continue
         out[key] = np.concatenate([p[key] for p in parts])
     return out

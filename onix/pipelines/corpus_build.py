@@ -150,10 +150,17 @@ def build_corpus(words: WordTable,
         vocab = Vocabulary.fit(words.word)
         word_ids = vocab.ids(words.word)
 
-    if words.ip_u32 is not None:
-        from onix.pipelines.words import u32_to_ips
-        udocs, dinv = _unique_inverse(words.ip_u32)
-        dstrings = u32_to_ips(udocs)
+    if words.ip_u32 is not None or words.ip_u64 is not None:
+        from onix.pipelines.words import ip_keys_to_strings, u32_to_ips
+        if words.ip_u32 is not None:
+            udocs, dinv = _unique_inverse(words.ip_u32)
+            dstrings = u32_to_ips(udocs)
+        else:
+            # uint64 keys: canonical-v4 values plus IP_TAG'd dictionary
+            # entries (IPv6 / non-canonical strings) — same unique-then-
+            # render recipe, same string-sorted final ids.
+            udocs, dinv = _unique_inverse(words.ip_u64)
+            dstrings = ip_keys_to_strings(udocs, words.ip_table)
         dorder = np.argsort(dstrings)
         drank = np.empty(len(dorder), np.int64)
         drank[dorder] = np.arange(len(dorder))

@@ -179,8 +179,9 @@ def run_scoring(cfg: OnixConfig, engine: str = "gibbs",
                     cols = columnar.read_day_cols(store, datatype, date)
                     n_events = len(cols["hour"])
                 except ValueError as e:
-                    # e.g. non-IPv4 addresses: the u32 doc mapping
-                    # cannot hold. auto falls back to the reference
+                    # A malformed/unconvertible column (IPv6 days ride
+                    # the tagged-u64 dictionary since r04 and no longer
+                    # land here). auto falls back to the reference
                     # path (and says so); an explicit "on" propagates.
                     if mode == "on":
                         raise

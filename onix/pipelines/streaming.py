@@ -374,11 +374,13 @@ class StreamingScorer:
 
         The frame converters do the per-UNIQUE-value string work and the
         *_words_from_arrays builders everything per-row in NumPy — the
-        same machinery as the batch scale runner. A frame the converter
-        rejects (e.g. non-canonical or IPv6 addresses) falls back to the
-        string word path for that batch; word identity is unaffected
-        (both paths emit the same packed word_key) and the doc table
-        switches one-way to string keys (same dotted-quad identities)."""
+        same machinery as the batch scale runner. IPv6/non-canonical
+        addresses ride the tagged-u64 dictionary (words.IP_TAG), which
+        has no uint32 doc keys — such batches flip the doc table
+        one-way to string keys (same raw-string identities). A frame
+        the converter rejects outright (malformed columns) falls back
+        to the string word path; word identity is unaffected either
+        way (both paths emit the same packed word_key)."""
         from onix.pipelines import columnar
 
         conv = columnar.FRAME_COLS[self.datatype]

@@ -116,14 +116,33 @@ def u32_to_ips(vals: np.ndarray) -> np.ndarray:
     return ip_to_str(vals).astype(object)
 
 
+# High bit of a uint64 doc key marks a dictionary entry (IPv6 or any
+# non-canonical-v4 string; low bits index the day's sorted `ip_table`);
+# untagged keys are canonical-v4 u32 values. Doc identity is the raw
+# STRING either way — exactly the pandas path's semantics.
+IP_TAG = np.uint64(1) << np.uint64(63)
+
+
+def ip_keys_to_strings(keys: np.ndarray, ip_table: np.ndarray) -> np.ndarray:
+    """uint64 doc keys -> IP strings (v4 rendered, tagged from table)."""
+    out = np.empty(len(keys), object)
+    tagged = (keys & IP_TAG) != 0
+    out[~tagged] = u32_to_ips(keys[~tagged].astype(np.uint32))
+    if tagged.any():
+        out[tagged] = ip_table[(keys[tagged] & ~IP_TAG).astype(np.int64)]
+    return out
+
+
 class WordTable:
     """(document, word) rows with provenance back to source events.
 
     Canonical storage is integer: `word_key` (packed int64 per the
-    table's `spec`) and, when the producer had numeric IPs, `ip_u32`.
-    `word` / `ip` are lazily-rendered string views (rendered per UNIQUE
-    value then broadcast — never per-row Python formatting), kept for
-    display, vocab dumps, and the feedback CSV contract.
+    table's `spec`) and, when the producer had numeric IPs, `ip_u32`
+    (pure-v4 days) or `ip_u64` + `ip_table` (days with IPv6 or
+    non-canonical addresses — see IP_TAG). `word` / `ip` are
+    lazily-rendered string views (rendered per UNIQUE value then
+    broadcast — never per-row Python formatting), kept for display,
+    vocab dumps, and the feedback CSV contract.
 
     `event_idx[i]` is the source row of pair i — flow events contribute
     two rows (src-IP doc and dst-IP doc), dns/proxy one. `edges` holds
@@ -135,9 +154,13 @@ class WordTable:
                  word_key: np.ndarray | None = None,
                  word: np.ndarray | None = None,
                  ip: np.ndarray | None = None,
-                 ip_u32: np.ndarray | None = None):
-        if ip is None and ip_u32 is None:
-            raise ValueError("need ip strings or ip_u32")
+                 ip_u32: np.ndarray | None = None,
+                 ip_u64: np.ndarray | None = None,
+                 ip_table: np.ndarray | None = None):
+        if ip is None and ip_u32 is None and ip_u64 is None:
+            raise ValueError("need ip strings, ip_u32, or ip_u64")
+        if ip_u64 is not None and ip_table is None:
+            raise ValueError("ip_u64 needs the ip_table dictionary")
         if word is None and word_key is None:
             raise ValueError("need word strings or (word_key, spec)")
         if word is None and spec is None:
@@ -147,6 +170,8 @@ class WordTable:
         self.spec = spec
         self.word_key = word_key
         self.ip_u32 = ip_u32
+        self.ip_u64 = ip_u64
+        self.ip_table = ip_table
         self._ip = ip
         self._word = word
 
@@ -158,8 +183,12 @@ class WordTable:
     @property
     def ip(self) -> np.ndarray:
         if self._ip is None:
-            uniq, inv = np.unique(self.ip_u32, return_inverse=True)
-            self._ip = u32_to_ips(uniq)[inv]
+            if self.ip_u32 is not None:
+                uniq, inv = np.unique(self.ip_u32, return_inverse=True)
+                self._ip = u32_to_ips(uniq)[inv]
+            else:
+                uniq, inv = np.unique(self.ip_u64, return_inverse=True)
+                self._ip = ip_keys_to_strings(uniq, self.ip_table)[inv]
         return self._ip
 
     @property
@@ -225,13 +254,20 @@ def _port_class_codes(sport: np.ndarray, dport: np.ndarray) -> np.ndarray:
 
 
 def flow_words_from_arrays(
-        *, sip_u32: np.ndarray, dip_u32: np.ndarray, sport: np.ndarray,
-        dport: np.ndarray, proto_id: np.ndarray, hour: np.ndarray,
-        ibyt: np.ndarray, ipkt: np.ndarray, proto_classes: list[str],
+        *, sport: np.ndarray, dport: np.ndarray, proto_id: np.ndarray,
+        hour: np.ndarray, ibyt: np.ndarray, ipkt: np.ndarray,
+        proto_classes: list[str],
+        sip_u32: np.ndarray | None = None,
+        dip_u32: np.ndarray | None = None,
+        sip_u64: np.ndarray | None = None,
+        dip_u64: np.ndarray | None = None,
+        ip_table: np.ndarray | None = None,
         n_bins: int = N_BINS_DEFAULT, edges: dict | None = None) -> WordTable:
     """Numeric fast path: flow words straight from columnar arrays —
     zero per-row Python, the 10⁹-row ingest contract (BASELINE.json
-    configs[3]). `proto_id` indexes `proto_classes` (uppercase names)."""
+    configs[3]). `proto_id` indexes `proto_classes` (uppercase names).
+    IPs come as uint32 (pure-v4 days) or uint64 keys + `ip_table`
+    (days with IPv6/non-canonical addresses, IP_TAG encoding)."""
     edges = dict(edges) if edges else {}
     edges.setdefault("proto_classes", sorted(proto_classes))
     # proto_id refers to caller order; remap to the sorted fitted table,
@@ -244,7 +280,11 @@ def flow_words_from_arrays(
     pos_c = np.clip(pos, 0, max(len(table) - 1, 0))
     remap = np.where(len(table) and table[pos_c] == names,
                      pos_c, _PROTO_UNK).astype(np.int64)
-    n = sip_u32.shape[0]
+    u64 = sip_u64 is not None
+    if u64 == (sip_u32 is not None):
+        raise ValueError("need exactly one of sip_u32/dip_u32 or "
+                         "sip_u64/dip_u64(+ip_table)")
+    n = (sip_u64 if u64 else sip_u32).shape[0]
     hbin = _bins(np.asarray(hour, np.float64), "hour", n_bins, edges)
     bbin = _bins(np.log1p(np.asarray(ibyt, np.float64)), "log_ibyt",
                  n_bins, edges)
@@ -255,12 +295,15 @@ def flow_words_from_arrays(
         "pclass": _port_class_codes(sport, dport),
         "hbin": hbin, "bbin": bbin, "pbin": pbin,
     })
+    ip_kw = (dict(ip_u64=np.concatenate([np.asarray(sip_u64, np.uint64),
+                                         np.asarray(dip_u64, np.uint64)]),
+                  ip_table=ip_table) if u64 else
+             dict(ip_u32=np.concatenate([np.asarray(sip_u32, np.uint32),
+                                         np.asarray(dip_u32, np.uint32)])))
     return WordTable(
-        ip_u32=np.concatenate([np.asarray(sip_u32, np.uint32),
-                               np.asarray(dip_u32, np.uint32)]),
         word_key=np.concatenate([key, key]),
         event_idx=np.concatenate([np.arange(n), np.arange(n)]).astype(np.int64),
-        edges=edges, spec=FLOW_SPEC,
+        edges=edges, spec=FLOW_SPEC, **ip_kw,
     )
 
 
@@ -339,10 +382,24 @@ def dns_words(table: pd.DataFrame, n_bins: int = N_BINS_DEFAULT,
     )
 
 
+
+def _client_ip_kw(client_u32, client_u64, ip_table) -> dict:
+    """One-client-column twin of the flow builders' ip_kw selection."""
+    if (client_u64 is not None) == (client_u32 is not None):
+        raise ValueError("need exactly one of client_u32 or "
+                         "client_u64(+ip_table)")
+    if client_u64 is not None:
+        return dict(ip_u64=np.asarray(client_u64, np.uint64),
+                    ip_table=ip_table)
+    return dict(ip_u32=np.asarray(client_u32, np.uint32))
+
 def dns_words_from_arrays(
-        *, client_u32: np.ndarray, qname_codes: np.ndarray,
+        *, qname_codes: np.ndarray,
         qnames: np.ndarray, qtype: np.ndarray, rcode: np.ndarray,
         frame_len: np.ndarray, hour: np.ndarray,
+        client_u32: np.ndarray | None = None,
+        client_u64: np.ndarray | None = None,
+        ip_table: np.ndarray | None = None,
         n_bins: int = N_BINS_DEFAULT, edges: dict | None = None) -> WordTable:
     """Numeric fast path: DNS words from dictionary-encoded columns —
     `qnames` is the UNIQUE name table, `qname_codes` the per-row index
@@ -357,10 +414,10 @@ def dns_words_from_arrays(
         n_bins=n_bins, edges=edges)
     n = key.shape[0]
     return WordTable(
-        ip_u32=np.asarray(client_u32, np.uint32),
         word_key=key,
         event_idx=np.arange(n, dtype=np.int64),
         edges=edges, spec=DNS_SPEC,
+        **_client_ip_kw(client_u32, client_u64, ip_table),
     )
 
 
@@ -444,9 +501,12 @@ def proxy_words(table: pd.DataFrame, n_bins: int = N_BINS_DEFAULT,
 
 
 def proxy_words_from_arrays(
-        *, client_u32: np.ndarray, uri_codes: np.ndarray, uris: np.ndarray,
+        *, uri_codes: np.ndarray, uris: np.ndarray,
         host_codes: np.ndarray, hosts: np.ndarray, ua_codes: np.ndarray,
         agents: np.ndarray, respcode: np.ndarray, hour: np.ndarray,
+        client_u32: np.ndarray | None = None,
+        client_u64: np.ndarray | None = None,
+        ip_table: np.ndarray | None = None,
         n_bins: int = N_BINS_DEFAULT, edges: dict | None = None) -> WordTable:
     """Numeric fast path: proxy words from dictionary-encoded columns —
     `uris`/`hosts`/`agents` are UNIQUE string tables, `*_codes` the
@@ -459,10 +519,10 @@ def proxy_words_from_arrays(
         n_bins=n_bins, edges=edges)
     n = key.shape[0]
     return WordTable(
-        ip_u32=np.asarray(client_u32, np.uint32),
         word_key=key,
         event_idx=np.arange(n, dtype=np.int64),
         edges=edges, spec=PROXY_SPEC,
+        **_client_ip_kw(client_u32, client_u64, ip_table),
     )
 
 

@@ -107,24 +107,39 @@ def test_auto_mode_row_threshold(tmp_path, monkeypatch):
         assert modes and modes[-1]["columnar"] is want
 
 
-def test_non_ipv4_day_rejected_on_and_falls_back_auto(tmp_path,
-                                                      monkeypatch):
-    table, _ = SYNTH["dns"](n_events=200, n_anomalies=5, seed=3)
+@pytest.mark.parametrize("datatype", ["flow", "dns", "proxy"])
+def test_mixed_v4_v6_day_scores_identically(tmp_path, datatype):
+    """VERDICT r03 next #8: a day carrying IPv6 (and non-canonical v4)
+    addresses goes THROUGH the columnar path — doc identity is the raw
+    string via the tagged-u64 dictionary (words.IP_TAG) — and scores
+    byte-identically to the pandas path. Split across parts so the
+    dictionary merge/remap is exercised too."""
+    table, _ = SYNTH[datatype](n_events=1200, n_anomalies=10, seed=3)
     table = table.copy()
-    table.loc[table.index[3], "ip_dst"] = "2001:db8::1"
-    Store(f"{tmp_path}/store").append("dns", DATE, table)
-    # Explicit on: loud rejection with guidance.
-    cfg = _cfg(tmp_path, "dns", extra=("pipeline.columnar=on",))
-    with pytest.raises(ValueError, match="columnar=off"):
-        run_scoring(cfg)
-    # auto: falls back to the reference path and completes.
-    monkeypatch.setattr(columnar, "COLUMNAR_AUTO_MIN_ROWS", 10)
-    cfg = _cfg(tmp_path, "dns",
-               extra=(f"store.results_dir={tmp_path}/r-fb",))
-    assert run_scoring(cfg) == 0
-    runlog = (results_path(f"{tmp_path}/r-fb", "dns", DATE)
-              .with_suffix(".runlog.jsonl").read_text())
-    assert "columnar_fallback" in runlog
+    ip_col = {"flow": "sip", "dns": "ip_dst", "proxy": "clientip"}[datatype]
+    # v6 in both halves (forces a cross-part dictionary merge), plus a
+    # non-canonical v4 spelling (its own doc, exactly as pandas sees it)
+    table.loc[table.index[3], ip_col] = "2001:db8::1"
+    table.loc[table.index[700], ip_col] = "2001:db8::2"
+    table.loc[table.index[701], ip_col] = "2001:db8::1"
+    table.loc[table.index[5], ip_col] = "010.1.1.1"
+    if datatype == "flow":
+        table.loc[table.index[9], "dip"] = "2001:db8::1"   # shared sip/dip doc
+    store = Store(f"{tmp_path}/store")
+    store.append(datatype, DATE, table.iloc[:600])
+    store.append(datatype, DATE, table.iloc[600:])
+    outs = {}
+    for mode in ("off", "on"):
+        cfg = _cfg(tmp_path, datatype,
+                   extra=(f"pipeline.columnar={mode}",))
+        assert run_scoring(cfg) == 0
+        res = results_path(cfg.store.results_dir, datatype, DATE)
+        outs[mode] = (pd.read_csv(res),
+                      json.loads(res.with_suffix(".manifest.json")
+                                 .read_text()))
+    pd.testing.assert_frame_equal(outs["off"][0], outs["on"][0])
+    for k in ("n_events", "n_docs", "n_vocab", "n_tokens", "n_results"):
+        assert outs["off"][1][k] == outs["on"][1][k], k
 
 
 def test_empty_results_schema_matches_reference(tmp_path):

@@ -642,7 +642,8 @@ def test_nfcapd_native_roundtrip():
     """VERDICT r2 next #7: uncompressed nfcapd v1 decodes natively —
     no external nfdump. Round trip through write_nfcapd covers 32/64-bit
     counter flags, optional-extension tails, skip-whole records
-    (extension map, exporter), and IPv6 rows the v4 schema drops."""
+    (extension map, exporter), and IPv6 rows — decoded into the flow
+    table as RFC 5952 strings since round 4."""
     table = _synth_flow_arrays(n=57, seed=30)
     table = table.copy()
     table.loc[3, "ibyt"] = 0x1_2345_6789          # forces FLAG_BYTES_64
@@ -653,7 +654,12 @@ def test_nfcapd_native_roundtrip():
         f.write(data)
         path = f.name
     out = nfd.decode_file(path)
-    assert len(out) == 57                          # v6 rows skipped
+    assert len(out) == 60                 # v6 rows DECODED (r04: #8)
+    v6 = out["sip"].str.contains(":").to_numpy()
+    assert v6.sum() == 3
+    assert set(out.loc[v6, "sip"]) == {"2001:db8::"}
+    assert set(out.loc[v6, "dip"]) == {"2001:db8::1"}
+    out = out[~v6].reset_index(drop=True)
     np.testing.assert_array_equal(
         out["sip"].to_numpy(object),
         nfd.ip_to_str(table["sip"].to_numpy(np.uint32)).astype(object))
@@ -701,7 +707,7 @@ def test_nfcapd_committed_compressed_fixture_decodes(codec):
     fx = pathlib.Path(__file__).parent / "fixtures"
     out = nfd.decode_file(fx / f"nfcapd.201607081200.{codec}")
     plain = nfd.decode_file(fx / "nfcapd.201607081200")
-    assert len(out) == len(plain) == 41
+    assert len(out) == len(plain) == 43           # 41 v4 + 2 v6 rows
     for col in ("sip", "dip", "sport", "dport", "proto", "ipkt", "ibyt"):
         np.testing.assert_array_equal(out[col].to_numpy(object),
                                       plain[col].to_numpy(object),
@@ -741,8 +747,8 @@ def test_nfcapd_hand_packed_layout_decodes():
         body += struct.pack("<QQ" if wide else "<II", pkts, byts)
         return struct.pack("<HH", 1, 4 + len(body)) + body
 
-    # v6 record (flags bit0): 2x16B addresses; reader must skip it
-    # consistently in count and decode.
+    # v6 record (flags bit0): 2x16B addresses, decoded into the flow
+    # table as RFC 5952 strings (round 4, VERDICT #8).
     v6_body = struct.pack("<HHHHII", 0x1, 0, 0, 0, 1467979200, 1467979201)
     v6_body += struct.pack("<BBBBHH", 0, 0, 17, 0, 53, 53) + b"\x11" * 32
     v6_body += struct.pack("<II", 7, 700)
@@ -768,17 +774,19 @@ def test_nfcapd_hand_packed_layout_decodes():
         f.write(blob)
         path = f.name
     out = nfd.decode_file(path)
-    assert len(out) == 2
-    assert out["sip"].tolist() == ["10.0.0.1", "192.168.1.1"]
-    assert out["dip"].tolist() == ["10.0.0.2", "8.8.8.8"]
-    assert out["sport"].tolist() == [443, 53]
-    assert out["dport"].tolist() == [52000, 4242]
-    assert out["proto"].tolist() == ["TCP", "UDP"]
-    assert out["ipkt"].tolist() == [12, 5]
+    assert len(out) == 3
+    v6_addr = "1111:1111:1111:1111:1111:1111:1111:1111"
+    assert out["sip"].tolist() == ["10.0.0.1", "192.168.1.1", v6_addr]
+    assert out["dip"].tolist() == ["10.0.0.2", "8.8.8.8", v6_addr]
+    assert out["sport"].tolist() == [443, 53, 53]
+    assert out["dport"].tolist() == [52000, 4242, 53]
+    assert out["proto"].tolist() == ["TCP", "UDP", "UDP"]
+    assert out["ipkt"].tolist() == [12, 5, 7]
     # 64-bit byte counter saturates at the uint32 ABI ceiling.
-    assert out["ibyt"].tolist() == [3456, 0xFFFFFFFF]
+    assert out["ibyt"].tolist() == [3456, 0xFFFFFFFF, 700]
     assert out["treceived"].tolist() == ["2016-07-08 12:00:00",
-                                         "2016-07-08 12:01:00"]
+                                         "2016-07-08 12:01:00",
+                                         "2016-07-08 12:00:00"]
 
 
 @needs_decoder
@@ -818,7 +826,7 @@ def test_nfcapd_compressed_roundtrip(compression):
         return nfd.decode_file(path)
 
     a, b = decode(plain), decode(comp)
-    assert len(b) == 57
+    assert len(b) == 59                           # 57 v4 + 2 v6 rows
     for col in a.columns:
         np.testing.assert_array_equal(a[col].to_numpy(object),
                                       b[col].to_numpy(object), err_msg=col)

@@ -40,9 +40,10 @@ def test_bucket_of_keys_stable_and_uniform():
 
 
 def test_streaming_ipv6_batch_switches_to_string_docs():
-    """A mid-stream batch the columnar converter rejects (IPv6 source)
-    falls back to the string word path; previously-seen v4 docs keep
-    their identities across the one-way table conversion."""
+    """A mid-stream batch carrying IPv6 rides the tagged-u64 columnar
+    word path (no uint32 doc keys), flipping the doc table one-way to
+    string keys; previously-seen v4 docs keep their identities across
+    the conversion."""
     from onix.pipelines.streaming import DocTable, U32DocTable
     table, _ = synth_flow_day(n_events=600, n_hosts=50, n_anomalies=4,
                               seed=3)
@@ -53,7 +54,7 @@ def test_streaming_ipv6_batch_switches_to_string_docs():
     keys_before = sc.docs.as_strings()
 
     v6 = table.iloc[:50].copy().reset_index(drop=True)
-    v6.loc[:4, "sip"] = "2001:db8::1"          # rejects _ips_u32
+    v6.loc[:4, "sip"] = "2001:db8::1"        # forces tagged-u64 keys
     res = sc.process(v6)
     assert res.n_events == 50
     assert isinstance(sc.docs, DocTable)
