@@ -174,6 +174,9 @@ class StoreConfig:
     feedback_dir: str = ""
     results_dir: str = ""
     checkpoint_dir: str = ""
+    # Hourly sub-partitions (y=/m=/d=/h=HH) on ingest — the reference's
+    # /h Hive level. Readers fold hour parts into day scans either way.
+    partition_hours: bool = False
 
 
 @dataclass
@@ -185,6 +188,9 @@ class OAConfig:
     # time, so one --set store.root=... override relocates the whole
     # store, OA outputs included.
     data_dir: str = ""
+    # Per-cell wall deadline for the in-dashboard notebook kernels; a
+    # cell past it is killed (the analyst restarts the session).
+    kernel_cell_timeout_s: float = 120.0
     geoip_db: str = ""          # CSV: network,country,city,latitude,longitude,isp
     reputation: str = ""        # plugin specs, comma-separated: local:<path>|noop
     top_domains: str = ""       # popular-domains list file (rank order)

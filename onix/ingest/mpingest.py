@@ -146,7 +146,8 @@ def worker_loop(cfg: OnixConfig, datatype: str,
                 continue
             try:
                 counts = ingest_file(store, datatype, path,
-                                     apply_sampling=cfg.ingest.apply_sampling)
+                                     apply_sampling=cfg.ingest.apply_sampling,
+                                     by_hour=cfg.store.partition_hours)
                 claims.commit(digest)
                 stats["files"] += 1
                 stats["rows"] += sum(counts.values())

@@ -53,18 +53,43 @@ def _day_of(datatype: str, table: pd.DataFrame) -> pd.Series:
     return table["p_date"].astype(str)
 
 
+def _hour_of(datatype: str, table: pd.DataFrame) -> pd.Series:
+    """Integer hour-of-day per row — the `h=` partition key. Same
+    robust parsing as store.hour_of (format="mixed" handles unpadded
+    hours like a bluecoat '9:15:00'); a fragile two-digit regex would
+    file such rows into the wrong hour silently."""
+    if datatype == "flow":
+        col = table["treceived"]
+    elif datatype == "dns":
+        col = table["frame_time"]
+    else:
+        col = table["p_time"].astype(str)
+    return pd.to_datetime(col, format="mixed").dt.hour
+
+
 def ingest_file(store: Store, datatype: str,
                 path: str | pathlib.Path,
-                apply_sampling: bool = False) -> dict[str, int]:
+                apply_sampling: bool = False,
+                by_hour: bool = False) -> dict[str, int]:
     """Decode one raw file and append its rows to the day partitions it
     spans (Store.append allocates part numbers atomically, so parallel
-    worker threads AND processes never collide). Returns {date: n_rows}."""
+    worker threads AND processes never collide). With `by_hour`
+    (store.partition_hours), rows land in y=/m=/d=/h= sub-partitions —
+    the reference's hourly Hive level (SURVEY.md §2.1 #3) — which every
+    day-scoped reader folds in transparently. Returns {date: n_rows}."""
     table = decode(datatype, path, apply_sampling=apply_sampling)
     out: dict[str, int] = {}
     if not len(table):
         return out
     for date, day_rows in table.groupby(_day_of(datatype, table)):
-        store.append(datatype, str(date), day_rows.reset_index(drop=True))
+        if by_hour:
+            for hour, hr_rows in day_rows.groupby(
+                    _hour_of(datatype, day_rows)):
+                store.append(datatype, str(date),
+                             hr_rows.reset_index(drop=True), hour=int(hour))
+        else:
+            store.append(datatype, str(date),
+                         day_rows.reset_index(drop=True))
         out[str(date)] = len(day_rows)
     return out
 
@@ -74,7 +99,8 @@ def run_ingest(cfg: OnixConfig, datatype: str, paths: list[str]) -> int:
     total = 0
     for p in paths:
         counts = ingest_file(store, datatype, p,
-                             apply_sampling=cfg.ingest.apply_sampling)
+                             apply_sampling=cfg.ingest.apply_sampling,
+                             by_hour=cfg.store.partition_hours)
         for date, n in sorted(counts.items()):
             print(f"{p}: {n} rows -> {datatype} {date}")
             total += n

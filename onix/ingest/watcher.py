@@ -129,7 +129,8 @@ class IngestWatcher:
     def _work(self, path: pathlib.Path) -> None:
         try:
             counts = ingest_file(self.store, self.datatype, path,
-                                 apply_sampling=self.cfg.ingest.apply_sampling)
+                                 apply_sampling=self.cfg.ingest.apply_sampling,
+                                 by_hour=self.cfg.store.partition_hours)
             self.ledger.commit(path)
             with self._stats_lock:
                 self.stats["files"] += 1

@@ -278,7 +278,7 @@ def _ingest_volumes(cfg: OnixConfig, datatype: str, date: str) -> dict:
     from onix.store import Store
 
     pdir = Store(cfg.store.root).partition_dir(datatype, date)
-    parts = sorted(pdir.glob("part-*.parquet"))
+    parts = Store.day_part_files(pdir)
     if not parts:
         return {"available": False, "rows_total": 0, "n_parts": 0,
                 "bytes_total": 0, "hourly": None, "hourly_skipped": None}

@@ -213,7 +213,7 @@ def merge_cols(datatype: str, parts: list[dict]) -> dict:
 def read_day_cols(store: Store, datatype: str, date: str) -> dict:
     """Read a stored day part by part into merged columnar form."""
     pdir = store.partition_dir(datatype, date)
-    part_files = sorted(pdir.glob("part-*.parquet"))
+    part_files = Store.day_part_files(pdir)
     if not part_files:
         raise FileNotFoundError(
             f"no data for {datatype} {date} under {pdir}")
@@ -259,7 +259,9 @@ def rows_at(store: Store, datatype: str, date: str,
     pdir = store.partition_dir(datatype, date)
     chunks = []
     offset = 0
-    for p in sorted(pdir.glob("part-*.parquet")):
+    # Same enumeration as Store.read/read_day_cols — the row-index
+    # contract (winners re-read by index) depends on matching order.
+    for p in Store.day_part_files(pdir):
         n = pq.ParquetFile(p).metadata.num_rows
         lo = np.searchsorted(wanted, offset)
         hi = np.searchsorted(wanted, offset + n)
@@ -274,7 +276,7 @@ def rows_at(store: Store, datatype: str, date: str,
         # schema (parquet metadata only), matching table.iloc[[]].
         import pyarrow.parquet as pq
 
-        first = sorted(pdir.glob("part-*.parquet"))[0]
+        first = Store.day_part_files(pdir)[0]
         return (pq.ParquetFile(first).schema_arrow.empty_table()
                 .to_pandas())
     allf = pd.concat(chunks)
@@ -289,4 +291,4 @@ def day_row_count(store: Store, datatype: str, date: str) -> int:
 
     pdir = store.partition_dir(datatype, date)
     return sum(pq.ParquetFile(p).metadata.num_rows
-               for p in sorted(pdir.glob("part-*.parquet")))
+               for p in Store.day_part_files(pdir))

@@ -5,7 +5,13 @@ partitioned by y/m/d(/h) on HDFS (SURVEY.md §2.1 #3, L3; reference
 README.md:37 "Load data in Hadoop"). onix keeps the same logical layout
 as a local (or network-mounted) Parquet dataset:
 
-    <root>/<datatype>/y=YYYY/m=MM/d=DD/part-NNNNN.parquet
+    <root>/<datatype>/y=YYYY/m=MM/d=DD[/h=HH]/part-NNNNN.parquet
+
+The hourly level (the reference's `/h` — SURVEY.md §2.1 #3) is
+optional per write: day-level parts and hour sub-partitions coexist,
+and every day-scoped reader sees both. Hour partitions are what
+streaming-by-hour ingest appends to and what `read_hour` slices
+without touching the rest of the day.
 
 Stage boundaries remain files (SURVEY.md §1 "Interfaces between layers
 are files, not RPCs") so every stage stays independently re-runnable.
@@ -37,21 +43,37 @@ def parse_date(date: str) -> tuple[str, str, str]:
 class Store:
     root: str | pathlib.Path
 
-    def partition_dir(self, datatype: str, date: str) -> pathlib.Path:
+    def partition_dir(self, datatype: str, date: str,
+                      hour: int | None = None) -> pathlib.Path:
         y, mo, d = parse_date(date)
-        return pathlib.Path(self.root) / datatype / f"y={y}" / f"m={mo}" / f"d={d}"
+        pdir = (pathlib.Path(self.root) / datatype
+                / f"y={y}" / f"m={mo}" / f"d={d}")
+        if hour is not None:
+            if not 0 <= int(hour) <= 23:
+                raise ValueError(f"bad hour {hour!r}")
+            pdir = pdir / f"h={int(hour):02d}"
+        return pdir
+
+    @staticmethod
+    def day_part_files(pdir: pathlib.Path) -> list[pathlib.Path]:
+        """All part files under a DAY dir: day-level parts first, then
+        hour sub-partitions in hour order — the one enumeration every
+        day-scoped reader shares."""
+        return (sorted(pdir.glob("part-*.parquet"))
+                + sorted(pdir.glob("h=*/part-*.parquet")))
 
     def write(self, datatype: str, date: str, table: pd.DataFrame,
-              part: int = 0) -> pathlib.Path:
+              part: int = 0, hour: int | None = None) -> pathlib.Path:
         """Write one partition file (append-style via distinct part numbers)."""
-        pdir = self.partition_dir(datatype, date)
+        pdir = self.partition_dir(datatype, date, hour)
         pdir.mkdir(parents=True, exist_ok=True)
         path = pdir / f"part-{part:05d}.parquet"
         table.to_parquet(path, index=False)
         return path
 
     def append(self, datatype: str, date: str,
-               table: pd.DataFrame) -> pathlib.Path:
+               table: pd.DataFrame,
+               hour: int | None = None) -> pathlib.Path:
         """Append rows as the next free part file, safely across
         processes AND hosts sharing the store.
 
@@ -61,7 +83,7 @@ class Store:
         local filesystems and NFSv3+, unlike flock), in which case the
         next slot is tried. The visible part file is therefore always a
         complete parquet."""
-        pdir = self.partition_dir(datatype, date)
+        pdir = self.partition_dir(datatype, date, hour)
         pdir.mkdir(parents=True, exist_ok=True)
         tmp = pdir / f".tmp-{os.getpid()}-{uuid.uuid4().hex[:8]}.parquet"
         table.to_parquet(tmp, index=False)
@@ -83,21 +105,38 @@ class Store:
             tmp.unlink(missing_ok=True)
 
     def read(self, datatype: str, date: str) -> pd.DataFrame:
-        """Read a full day partition (all part files, concatenated in order)."""
+        """Read a full day partition — day-level parts AND hour
+        sub-partitions, concatenated in enumeration order."""
         pdir = self.partition_dir(datatype, date)
-        parts = sorted(pdir.glob("part-*.parquet"))
+        parts = self.day_part_files(pdir)
         if not parts:
             raise FileNotFoundError(
                 f"no data for {datatype} {date} under {pdir}")
         return pd.concat([pd.read_parquet(p) for p in parts],
                          ignore_index=True)
 
+    def read_hour(self, datatype: str, date: str, hour: int) -> pd.DataFrame:
+        """Read ONE hour sub-partition."""
+        pdir = self.partition_dir(datatype, date, hour)
+        parts = sorted(pdir.glob("part-*.parquet"))
+        if not parts:
+            raise FileNotFoundError(
+                f"no data for {datatype} {date} h={hour:02d} under {pdir}")
+        return pd.concat([pd.read_parquet(p) for p in parts],
+                         ignore_index=True)
+
+    def hours(self, datatype: str, date: str) -> list[int]:
+        """Hour sub-partitions present for a day, ascending."""
+        pdir = self.partition_dir(datatype, date)
+        return sorted(int(h.name[2:]) for h in pdir.glob("h=*")
+                      if any(h.glob("part-*.parquet")))
+
     def dates(self, datatype: str) -> list[str]:
         """All dates with data for a datatype, ascending."""
         base = pathlib.Path(self.root) / datatype
         out = []
         for ddir in base.glob("y=*/m=*/d=*"):
-            if any(ddir.glob("part-*.parquet")):
+            if self.day_part_files(ddir):
                 y = ddir.parent.parent.name[2:]
                 mo = ddir.parent.name[2:]
                 d = ddir.name[2:]
@@ -106,7 +145,8 @@ class Store:
 
     def has(self, datatype: str, date: str) -> bool:
         try:
-            return any(self.partition_dir(datatype, date).glob("part-*.parquet"))
+            return bool(self.day_part_files(self.partition_dir(datatype,
+                                                               date)))
         except ValueError:
             return False
 

@@ -1012,3 +1012,80 @@ def test_nfcapd_big_endian_diagnosed(tmp_path):
     p.write_bytes(b"\xa5\x0c" + b"\x00" * 300)
     with pytest.raises(ValueError, match="big-endian"):
         nfd.decode_file(p)
+
+
+# ---------------------------------------------------------------------------
+# hourly partitioning (y=/m=/d=/h=HH — SURVEY.md §2.1 #3's /h level)
+# ---------------------------------------------------------------------------
+
+
+def test_store_hour_partitions_roundtrip(tmp_path):
+    """Hour sub-partitions coexist with day-level parts; every
+    day-scoped reader folds both, and read_hour slices one hour."""
+    from onix.pipelines.synth import synth_flow_day
+    table, _ = synth_flow_day(n_events=300, n_hosts=30, n_anomalies=3,
+                              seed=1)
+    hours = pd.to_datetime(table["treceived"]).dt.hour
+    store = Store(tmp_path / "store")
+    date = "2016-07-08"
+    # half the day at day level, half split by hour
+    store.append("flow", date, table.iloc[:150].reset_index(drop=True))
+    for h, rows in table.iloc[150:].groupby(hours.iloc[150:]):
+        store.append("flow", date, rows.reset_index(drop=True), hour=int(h))
+    assert store.has("flow", date)
+    assert store.dates("flow") == [date]
+    got = store.read("flow", date)
+    assert len(got) == 300
+    hs = store.hours("flow", date)
+    assert hs == sorted(set(hours.iloc[150:].tolist()))
+    one = store.read_hour("flow", date, hs[0])
+    assert (pd.to_datetime(one["treceived"]).dt.hour == hs[0]).all()
+    with pytest.raises(ValueError, match="bad hour"):
+        store.partition_dir("flow", date, hour=24)
+    with pytest.raises(FileNotFoundError):
+        store.read_hour("flow", date, (hs[0] + 1) % 24
+                        if (hs[0] + 1) % 24 not in hs else
+                        max(set(range(24)) - set(hs)))
+
+
+@needs_decoder
+def test_ingest_by_hour_partitions(tmp_path):
+    """store.partition_hours routes ingest into h= sub-partitions; the
+    day read sees every row exactly once."""
+    table = _synth_flow_arrays(n=80, seed=9)
+    raw = tmp_path / "cap.nf5"
+    raw.write_bytes(nfd.write_v5(table.sort_values("start_ts")))
+    store = Store(tmp_path / "store")
+    counts = ingest_file(store, "flow", raw, by_hour=True)
+    assert sum(counts.values()) == 80
+    date = next(iter(counts))
+    assert store.hours("flow", date), "no hour partitions written"
+    day = store.read("flow", date)
+    assert len(day) == 80
+    # no day-level parts: everything landed under h=
+    pdir = store.partition_dir("flow", date)
+    assert not list(pdir.glob("part-*.parquet"))
+
+
+def test_columnar_reads_hour_partitions_consistently(tmp_path):
+    """The columnar day scan and winner re-read enumerate hour parts in
+    the same order as Store.read — the row-index contract."""
+    from onix.pipelines import columnar
+    from onix.pipelines.synth import synth_flow_day
+    table, _ = synth_flow_day(n_events=400, n_hosts=40, n_anomalies=4,
+                              seed=2)
+    hours = pd.to_datetime(table["treceived"]).dt.hour
+    store = Store(tmp_path / "store")
+    date = "2016-07-08"
+    store.append("flow", date, table.iloc[:100].reset_index(drop=True))
+    for h, rows in table.iloc[100:].groupby(hours.iloc[100:]):
+        store.append("flow", date, rows.reset_index(drop=True), hour=int(h))
+    day = store.read("flow", date)
+    assert columnar.day_row_count(store, "flow", date) == 400
+    cols = columnar.read_day_cols(store, "flow", date)
+    np.testing.assert_array_equal(cols["sport"],
+                                  day["sport"].to_numpy(np.int32))
+    idx = np.array([0, 150, 399, 77])
+    got = columnar.rows_at(store, "flow", date, idx)
+    pd.testing.assert_frame_equal(got,
+                                  day.iloc[idx].reset_index(drop=True))
