@@ -695,6 +695,11 @@ async function load() {
   nb.href = `/data/notebooks/${TYPE}_threat_investigation.ipynb`;
   nb.setAttribute("download", `${TYPE}_threat_investigation.ipynb`);
   document.getElementById("notebook-view").href = `/notebooks/${TYPE}.html`;
+  // "edit" opens the in-dashboard editor: cells editable in place,
+  // executed against a PERSISTENT kernel session (state carries
+  // between runs), saved back to the hosted template.
+  document.getElementById("notebook-edit").href =
+    `/notebook.html?datatype=${TYPE}&date=${encodeURIComponent(date)}`;
   const nbRun = document.getElementById("notebook-run");
   let nbRunning = false;          // one kernel at a time per dashboard
   nbRun.onclick = async (ev) => {

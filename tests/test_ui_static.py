@@ -150,6 +150,13 @@ def test_notebook_link_matches_generated_filenames():
 
     for rel, html in DASHBOARDS.items():
         assert 'id="notebook-link"' in html, rel
+        # Round 4: the in-place editor entry (persistent-kernel loop).
+        assert 'id="notebook-edit"' in html, rel
+    assert "/notebook.html?datatype=" in JS, "editor link not built"
+    editor = (UI_ROOT / "notebook.html").read_text()
+    for hook in ("/notebooks/kernel", "/notebooks/kernel/exec",
+                 "/notebooks/save", "run-all", "restart"):
+        assert hook in editor, hook
     m = re.search(r"/data/notebooks/\$\{TYPE\}([^\s`\"]+)", JS)
     assert m, "notebook link not built in onix.js"
     suffix = m.group(1)
