@@ -41,6 +41,7 @@ def run_rehearsal(n_events: int = 100_000, n_sweeps: int = 300,
                   n_topics: int = 20, alpha: float = 0.5, eta: float = 0.05,
                   seed: int = 5, datatype: str = "flow",
                   bf16_arm: bool = False, engine: str = "gibbs",
+                  engine_mesh: tuple[int, int] | None = None,
                   out_path=None) -> dict:
     """engine="sharded" runs the SAME judged pairing with the multi-chip
     ShardedGibbsLDA (chained restart ensemble vmapped per device over
@@ -92,8 +93,11 @@ def run_rehearsal(n_events: int = 100_000, n_sweeps: int = 300,
                     n_sweeps=n_sweeps, burn_in=n_sweeps // 2,
                     block_size=8192, seed=0, n_chains=n_chains)
     if engine == "sharded":
+        from onix.parallel.mesh import make_mesh
         from onix.parallel.sharded_gibbs import ShardedGibbsLDA
-        fit = ShardedGibbsLDA(cfg, corpus.n_vocab).fit(corpus)
+        mesh = (make_mesh(dp=engine_mesh[0], mp=engine_mesh[1])
+                if engine_mesh else None)
+        fit = ShardedGibbsLDA(cfg, corpus.n_vocab, mesh=mesh).fit(corpus)
     else:
         fit = GibbsLDA(cfg, corpus.n_docs, corpus.n_vocab).fit(corpus)
     jx = np.asarray(score_all(fit["theta"], fit["phi_wk"],
@@ -144,6 +148,7 @@ def run_rehearsal(n_events: int = 100_000, n_sweeps: int = 300,
         "planted_hit_at_k": hits,
         "config": {
             "datatype": datatype, "engine": engine,
+            "engine_mesh": list(engine_mesh) if engine_mesh else None,
             "n_events": n_events, "n_docs": int(corpus.n_docs),
             "n_vocab": int(corpus.n_vocab),
             "n_tokens": int(corpus.n_tokens), "n_topics": n_topics,

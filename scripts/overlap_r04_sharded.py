@@ -48,8 +48,14 @@ def main() -> int:
     ap.add_argument("--seeds", type=int, nargs="+", default=[5])
     ap.add_argument("--datatypes", nargs="+",
                     default=["flow", "dns", "proxy"])
+    ap.add_argument("--mesh", default=None,
+                    help="dp,mp for the sharded engine (default: all "
+                         "devices on dp). dp=4,mp=2 halves cross-shard "
+                         "staleness AND exercises vocabulary sharding.")
     ap.add_argument("--out", default="docs/OVERLAP_r04_sharded.json")
     args = ap.parse_args()
+    mesh = (tuple(int(x) for x in args.mesh.split(",")) if args.mesh
+            else None)
     assert len(jax.devices()) == 8, jax.devices()
 
     cells = {}
@@ -60,6 +66,7 @@ def main() -> int:
             r = run_rehearsal(n_events=args.events, n_sweeps=args.sweeps,
                               n_oracle_runs=args.oracle_runs,
                               n_chains=args.chains, engine="sharded",
+                              engine_mesh=mesh,
                               seed=seed, datatype=dt)
             cells[f"{dt}/seed{seed}"] = r
             print(f"[{dt} seed={seed}] jax_vs_oracle={r['jax_vs_oracle']} "
@@ -75,7 +82,8 @@ def _write(out, cells, args, t_all, partial):
     doc = {
         "metric": "top-1000 suspicious-connect overlap vs oracle, "
                   "min over seeds — SHARDED (multi-chip) engine",
-        "engine": "sharded_gibbs dp=8 virtual CPU mesh, vmapped chains",
+        "engine": ("sharded_gibbs virtual 8-device CPU mesh "
+                   f"({args.mesh or 'dp=8'}), vmapped chains"),
         "bar": JUDGED_BAR,
         "partial": partial,
         "per_datatype": per_dt,
