@@ -69,6 +69,15 @@ run_step scale_dns 5400 python -m onix.pipelines.scale --datatype dns \
 run_step scale_proxy 5400 python -m onix.pipelines.scale --datatype proxy \
   --events 1e8 --out docs/SCALE_PROXY_r04.json
 
+# 5b. Chained-ensemble flow 1e8: the north-star combination (multi-chip
+#     sharded engine + the judged restart-ensemble estimator) in ONE
+#     config — chains vmapped per device, geometric-merged score table.
+#     --hosts bounds the chain-aware [C, D, V] table under the device
+#     budget (4 x 40k x V~640 ~ 1e8 <= 2^27).
+run_step flow1e8_chains 5400 \
+  python -m onix.pipelines.scale --events 1e8 --train-events 2e7 \
+  --chains 4 --hosts 40000 --out docs/SCALE_FLOW_CHAINS_r04.json
+
 # 6. Streaming rerun (configs[4]) with whatever host-path speedups the
 #    round has landed by the time the tunnel answers.
 run_step stream 3600 python scripts/stream_scale.py \
