@@ -98,7 +98,7 @@ def load_latest(ckpt_dir: str | pathlib.Path) -> Checkpoint | None:
 # tweaking checkpoint_every / svi_* knobs the sampler never reads, must
 # not discard resumable progress.
 _SAMPLING_FIELDS = ("n_topics", "alpha", "eta", "burn_in", "block_size",
-                    "seed", "n_chains")
+                    "seed", "n_chains", "sync_splits")
 
 
 def fingerprint(config, n_docs: int, n_vocab: int, n_tokens: int,

@@ -65,9 +65,12 @@ class ShardedCorpus(NamedTuple):
 
 
 def shard_corpus(corpus: Corpus, n_data: int, block_size: int,
-                 seed: int = 0, n_mp: int = 1) -> ShardedCorpus:
+                 seed: int = 0, n_mp: int = 1,
+                 n_groups: int = 1) -> ShardedCorpus:
     """Partition documents (greedy balance) over data shards and tokens
-    over vocabulary chunks; lay out every bucket in blocked form."""
+    over vocabulary chunks; lay out every bucket in blocked form.
+    `n_groups` pads the block count to a multiple so the sweep can
+    synchronize counts after every group (cfg.sync_splits)."""
     n_docs = corpus.n_docs
     lengths = corpus.doc_lengths()
     # Snake round-robin over docs sorted by length (desc): near-optimal
@@ -100,8 +103,9 @@ def shard_corpus(corpus: Corpus, n_data: int, block_size: int,
     bucket_counts = np.bincount(bucket, minlength=n_data * n_mp)
     max_tokens = int(bucket_counts.max()) if corpus.n_tokens else 1
     block = min(block_size, max(max_tokens, 1))
-    padded_len = -(-max_tokens // block) * block
-    nb = padded_len // block
+    nb = -(-max_tokens // block)
+    nb = -(-nb // n_groups) * n_groups     # sync groups need equal splits
+    padded_len = nb * block
 
     doc_blocks = np.zeros((n_data, n_mp, padded_len), np.int32)
     word_blocks = np.zeros((n_data, n_mp, padded_len), np.int32)
@@ -197,41 +201,70 @@ class ShardedGibbsLDA:
         M = MP_AXIS if MP_AXIS in self.mesh.shape else None
         both = D + ((M,) if M else ())
 
+        S = max(1, int(config.sync_splits))
+
         def sweep_fn(state: ShardedGibbsState, docs, words, mask,
                      accumulate: bool) -> ShardedGibbsState:
             def shard_fn(z, n_dk, n_wk, n_k, keys, d, w, m):
-                # Replicated replicas become device-varying once each
-                # device starts updating them locally — mark them so.
-                n_wk_v = jax.lax.pcast(n_wk[0], D, to="varying")
-                n_dk_v = (jax.lax.pcast(n_dk[0], M, to="varying")
-                          if M else n_dk[0])
-                n_k_v = jax.lax.pcast(n_k, both, to="varying")
                 # Leading shard axes of size (1, 1) inside shard_map;
                 # the remaining leading axis is the chain axis C: the
                 # SAME local token blocks, C independent sampler states,
-                # batched by vmap into one program.
-                d0, w0, m0 = d[0, 0], w[0, 0], m[0, 0]
+                # batched by vmap into one program. Blocks split into S
+                # sync groups (shard_corpus pads nb to a multiple): each
+                # group sweeps against counts at most 1/S of a sweep
+                # stale, psums its deltas, and folds them in before the
+                # next group — S=1 is the reference's MPI cadence.
+                C = z.shape[2]
+                nb, B = d.shape[2], d.shape[3]
+                assert nb % S == 0, (
+                    f"block count {nb} not divisible by "
+                    f"sync_splits={S}: the corpus was laid out without "
+                    "this engine's prepare() (shard_corpus needs "
+                    "n_groups=sync_splits)")
+                d_g = d[0, 0].reshape(S, nb // S, B)
+                w_g = w[0, 0].reshape(S, nb // S, B)
+                m_g = m[0, 0].reshape(S, nb // S, B)
+                z_g = (z[0, 0].reshape(C, S, nb // S, B)
+                       .swapaxes(0, 1))
 
-                def one_chain(zc, ndkc, nwkc, nkc, keyc):
-                    return _local_sweep(
-                        zc, ndkc, nwkc, nkc, keyc, d0, w0, m0,
-                        alpha=config.alpha, eta=config.eta,
-                        n_vocab=n_vocab, k_topics=k)
+                def group_step(carry, xs):
+                    ndk_r, nwk_r, nk_r, key_c = carry
+                    dg, wg, mg, zg = xs
+                    # Replicated bases become device-varying once each
+                    # device starts updating them locally — mark them
+                    # per group; the psum fold below restores the
+                    # replication the carry (and out_specs) demand.
+                    nwk_v = jax.lax.pcast(nwk_r, D, to="varying")
+                    ndk_v = (jax.lax.pcast(ndk_r, M, to="varying")
+                             if M else ndk_r)
+                    nk_v = jax.lax.pcast(nk_r, both, to="varying")
 
-                z, n_dk_new, n_wk_new, n_k_new, key = jax.vmap(one_chain)(
-                    z[0, 0], n_dk_v, n_wk_v, n_k_v, keys[0, 0])
-                # The MPI_Reduce+Bcast of the reference, as psums:
-                # chunk deltas over the data axes (ICI, then DCN),
-                # doc-topic deltas over mp, topic totals over both.
-                # All chains' deltas ride ONE collective (leading C axis
-                # reduces elementwise).
-                d_wk = jax.lax.psum(n_wk_new - n_wk_v, D)
-                d_dk = (jax.lax.psum(n_dk_new - n_dk_v, M)
-                        if M else n_dk_new - n_dk_v)
-                d_k = jax.lax.psum(n_k_new - n_k_v, both)
-                return (z[None, None], (n_dk[0] + d_dk)[None],
-                        (n_wk[0] + d_wk)[None], n_k + d_k,
-                        key[None, None])
+                    def one_chain(zc, ndkc, nwkc, nkc, keyc):
+                        return _local_sweep(
+                            zc, ndkc, nwkc, nkc, keyc, dg, wg, mg,
+                            alpha=config.alpha, eta=config.eta,
+                            n_vocab=n_vocab, k_topics=k)
+
+                    z_new, ndk_new, nwk_new, nk_new, key_new = \
+                        jax.vmap(one_chain)(zg, ndk_v, nwk_v, nk_v, key_c)
+                    # The MPI_Reduce+Bcast of the reference, as psums:
+                    # chunk deltas over the data axes (ICI, then DCN),
+                    # doc-topic deltas over mp, topic totals over both.
+                    # All chains' deltas ride ONE collective (leading C
+                    # axis reduces elementwise).
+                    d_wk = jax.lax.psum(nwk_new - nwk_v, D)
+                    d_dk = (jax.lax.psum(ndk_new - ndk_v, M)
+                            if M else ndk_new - ndk_v)
+                    d_k = jax.lax.psum(nk_new - nk_v, both)
+                    return (ndk_r + d_dk, nwk_r + d_wk, nk_r + d_k,
+                            key_new), z_new
+
+                (ndk_f, nwk_f, nk_f, key_f), z_out = jax.lax.scan(
+                    group_step, (n_dk[0], n_wk[0], n_k, keys[0, 0]),
+                    (d_g, w_g, m_g, z_g))
+                z_full = z_out.swapaxes(0, 1).reshape(C, nb, B)
+                return (z_full[None, None], ndk_f[None], nwk_f[None],
+                        nk_f, key_f[None, None])
 
             mp_spec = (M,) if M else ()
             z, n_dk, n_wk, n_k, keys = jax.shard_map(
@@ -374,7 +407,8 @@ class ShardedGibbsLDA:
 
     def prepare(self, corpus: Corpus) -> ShardedCorpus:
         return shard_corpus(corpus, self.n_data, self.config.block_size,
-                            self.config.seed, n_mp=self.n_mp)
+                            self.config.seed, n_mp=self.n_mp,
+                            n_groups=self.config.sync_splits)
 
     def device_corpus(self, sc: ShardedCorpus):
         D = self.data_axes
