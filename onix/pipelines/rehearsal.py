@@ -42,6 +42,7 @@ def run_rehearsal(n_events: int = 100_000, n_sweeps: int = 300,
                   seed: int = 5, datatype: str = "flow",
                   bf16_arm: bool = False, engine: str = "gibbs",
                   engine_mesh: tuple[int, int] | None = None,
+                  sync_splits: int = 1,
                   out_path=None) -> dict:
     """engine="sharded" runs the SAME judged pairing with the multi-chip
     ShardedGibbsLDA (chained restart ensemble vmapped per device over
@@ -91,7 +92,8 @@ def run_rehearsal(n_events: int = 100_000, n_sweeps: int = 300,
     t = time.monotonic()
     cfg = LDAConfig(n_topics=n_topics, alpha=alpha, eta=eta,
                     n_sweeps=n_sweeps, burn_in=n_sweeps // 2,
-                    block_size=8192, seed=0, n_chains=n_chains)
+                    block_size=8192, seed=0, n_chains=n_chains,
+                    sync_splits=sync_splits)
     if engine == "sharded":
         from onix.parallel.mesh import make_mesh
         from onix.parallel.sharded_gibbs import ShardedGibbsLDA
@@ -154,6 +156,7 @@ def run_rehearsal(n_events: int = 100_000, n_sweeps: int = 300,
             "n_tokens": int(corpus.n_tokens), "n_topics": n_topics,
             "alpha": alpha, "eta": eta, "n_sweeps": n_sweeps,
             "n_chains": n_chains, "n_oracle_runs": n_oracle_runs,
+            "sync_splits": sync_splits,
             "seed": seed},
         "walls_seconds": walls,
     }

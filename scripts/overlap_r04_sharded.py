@@ -48,6 +48,7 @@ def main() -> int:
     ap.add_argument("--seeds", type=int, nargs="+", default=[5])
     ap.add_argument("--datatypes", nargs="+",
                     default=["flow", "dns", "proxy"])
+    ap.add_argument("--sync-splits", type=int, default=1)
     ap.add_argument("--mesh", default=None,
                     help="dp,mp for the sharded engine (default: all "
                          "devices on dp). dp=4,mp=2 halves cross-shard "
@@ -67,6 +68,7 @@ def main() -> int:
                               n_oracle_runs=args.oracle_runs,
                               n_chains=args.chains, engine="sharded",
                               engine_mesh=mesh,
+                              sync_splits=args.sync_splits,
                               seed=seed, datatype=dt)
             cells[f"{dt}/seed{seed}"] = r
             print(f"[{dt} seed={seed}] jax_vs_oracle={r['jax_vs_oracle']} "
