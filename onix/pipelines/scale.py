@@ -62,7 +62,7 @@ def run_scale(n_events: int, n_hosts: int | None = None,
               n_anomalies: int | None = None, n_sweeps: int = 20,
               n_topics: int = 20, max_results: int = 3000, seed: int = 0,
               train_events: int | None = None, datatype: str = "flow",
-              n_chains: int = 1,
+              n_chains: int = 1, resume_dir: str | None = None,
               out_path: str | pathlib.Path | None = None) -> dict:
     """End-to-end scale run; returns (and optionally writes) the manifest.
 
@@ -101,6 +101,22 @@ def run_scale(n_events: int, n_hosts: int | None = None,
         n_anomalies = _default_anomalies(train_events)
     walls: dict[str, float] = {}
     t_all = time.monotonic()
+    ckpt = None
+    prior_elapsed = 0.0
+    resumed_sessions = 0
+    if resume_dir is not None:
+        ckpt = _ResumeState(resume_dir, {
+            "n_events": n_events, "train_events": train_events,
+            "n_hosts": n_hosts, "n_anomalies": n_anomalies,
+            "n_sweeps": n_sweeps, "n_topics": n_topics, "seed": seed,
+            "datatype": datatype, "n_chains": n_chains,
+            "max_results": max_results,
+            "device_words": os.environ.get("ONIX_DEVICE_WORDS", "0"),
+        })
+        meta = ckpt.load("meta")
+        if meta is not None:
+            prior_elapsed = float(meta["elapsed"])
+            resumed_sessions = int(meta["sessions"])
 
     t = time.monotonic()
     cols = SYNTH_ARRAYS[datatype](train_events, n_hosts=n_hosts,
@@ -130,9 +146,25 @@ def run_scale(n_events: int, n_hosts: int | None = None,
                     block_size=1 << 17, seed=seed, n_chains=n_chains)
     mesh = make_mesh(dp=n_dev, mp=1)
     model = ShardedGibbsLDA(cfg, corpus.n_vocab, mesh=mesh)
-    fit = model.fit(corpus)
-    theta, phi_wk = fit["theta"], fit["phi_wk"]  # host np arrays: synced
-    walls["gibbs_fit"] = time.monotonic() - t
+    saved_model = ckpt.load("model") if ckpt is not None else None
+    if saved_model is not None:
+        # A prior session already paid for the fit — the single
+        # longest atomic device stage. walls carry ITS cost, not this
+        # session's load time, so rates stay honest across sessions.
+        theta = saved_model["theta"]
+        phi_wk = saved_model["phi_wk"]
+        walls["gibbs_fit"] = float(saved_model["wall"])
+    else:
+        fit = model.fit(corpus)
+        theta, phi_wk = fit["theta"], fit["phi_wk"]  # host np: synced
+        walls["gibbs_fit"] = time.monotonic() - t
+        if ckpt is not None:
+            ckpt.save("model", theta=np.asarray(theta),
+                      phi_wk=np.asarray(phi_wk),
+                      wall=np.float64(walls["gibbs_fit"]))
+            ckpt.save("meta", elapsed=np.float64(
+                prior_elapsed + time.monotonic() - t_all),
+                sessions=np.int64(resumed_sessions + 1))
 
     planted = set(cols["anomaly_idx"].tolist())
     stream_info: dict = {}
@@ -150,13 +182,35 @@ def run_scale(n_events: int, n_hosts: int | None = None,
         walls["score_select"] = time.monotonic() - t
     else:
         del cols
+
+        def _save_meta():
+            if ckpt is not None:
+                ckpt.save("meta", elapsed=np.float64(
+                    prior_elapsed + time.monotonic() - t_all),
+                    sessions=np.int64(resumed_sessions + 1))
+
         top_idx, top_scores = _stream_score(
             bundle, wt.edges, theta, phi_wk, n_events=n_events,
             chunk_events=train_events, n_hosts=n_hosts, seed=seed,
             max_results=max_results, planted=planted, walls=walls,
-            datatype=datatype, info=stream_info)
+            datatype=datatype, info=stream_info, ckpt=ckpt,
+            save_meta=_save_meta)
 
-    walls["total"] = time.monotonic() - t_all
+    if resumed_sessions:
+        # Resumed runs replay the deterministic CPU stages, so raw
+        # elapsed double-counts them; the single-run-equivalent total
+        # (each stage's wall counted once — device stages carry the
+        # session that actually paid them) is what the rate means.
+        # Raw all-session elapsed rides along for transparency.
+        walls["wall_all_sessions"] = round(
+            prior_elapsed + time.monotonic() - t_all, 2)
+        walls["total"] = sum(
+            walls.get(k, 0.0) for k in
+            ("synthesize", "word_creation", "corpus_build", "gibbs_fit",
+             "score_select", "stream_synth", "stream_words_map",
+             "stream_score"))
+    else:
+        walls["total"] = time.monotonic() - t_all
     # The judged rate excludes generating the benchmark's own input —
     # a real deployment reads landed telemetry, it does not synthesize
     # it (VERDICT r2 weak #3 / next #2).
@@ -191,6 +245,8 @@ def run_scale(n_events: int, n_hosts: int | None = None,
                                  if len(finite) else None),
         "max_results": max_results,
         "seed": seed,
+        **({"resumed_sessions": resumed_sessions + 1}
+           if resumed_sessions else {}),
         **stream_info,
     }
     if out_path is not None:
@@ -198,6 +254,52 @@ def run_scale(n_events: int, n_hosts: int | None = None,
         out_path.parent.mkdir(parents=True, exist_ok=True)
         out_path.write_text(json.dumps(manifest, indent=2) + "\n")
     return manifest
+
+
+class _ResumeState:
+    """Stage/chunk checkpointing for scale runs on the intermittent
+    tunnel (VERDICT r04 next #1: the ~51-min 1B run must survive
+    ~40-minute tunnel windows). The design persists only the SMALL
+    state — the fitted model (theta/phi, ≤ tens of MB) and each
+    completed stream chunk's bottom-k winners (≤ max_results rows) —
+    because the big stages before the fit (synthesize → words →
+    corpus) are deterministic in `seed` and CPU-only: a resumed run
+    replays them without touching the device, loads the model instead
+    of re-fitting, and continues streaming at the first chunk that
+    never finished. Checkpoints are fingerprinted over every argument
+    that changes the numbers; a mismatch starts clean rather than
+    resuming somebody else's run."""
+
+    def __init__(self, resume_dir, fingerprint: dict):
+        self.dir = pathlib.Path(resume_dir)
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self.fp = json.dumps(fingerprint, sort_keys=True)
+        fp_file = self.dir / "fingerprint.json"
+        if fp_file.exists() and fp_file.read_text() != self.fp:
+            for p in self.dir.glob("*.npz"):
+                p.unlink()
+            fp_file.unlink()
+        self.fresh = not fp_file.exists()
+        if self.fresh:
+            fp_file.write_text(self.fp)
+
+    def _path(self, name: str) -> pathlib.Path:
+        return self.dir / f"{name}.npz"
+
+    def save(self, name: str, **arrays) -> None:
+        tmp = self._path(name).with_suffix(".tmp.npz")
+        np.savez(tmp, **arrays)
+        os.replace(tmp, self._path(name))
+
+    def load(self, name: str):
+        p = self._path(name)
+        if not p.exists():
+            return None
+        try:
+            return np.load(p, allow_pickle=False)
+        except Exception:               # torn write from a killed run
+            p.unlink()
+            return None
 
 
 def _default_anomalies(n_events: int) -> int:
@@ -235,7 +337,8 @@ def extend_model_for_unseen(theta, phi_wk):
 def _stream_score(bundle, fitted_edges, theta, phi_wk, *, n_events: int,
                   chunk_events: int, n_hosts: int, seed: int,
                   max_results: int, planted: set, walls: dict,
-                  datatype: str = "flow", info: dict | None = None):
+                  datatype: str = "flow", info: dict | None = None,
+                  ckpt=None, save_meta=None):
     """Stream the FULL day through the fused device scorer in
     chunk_events-sized pieces against a model fitted on chunk 0.
 
@@ -319,6 +422,35 @@ def _stream_score(bundle, fitted_edges, theta, phi_wk, *, n_events: int,
     walls["stream_score"] = 0.0
     offset = 0
     c = 0
+    prog = ckpt.load("stream") if ckpt is not None else None
+    if prog is not None:
+        # Resume at the first chunk that never completed: restore the
+        # winners-so-far, the planted ids streamed chunks added, and
+        # the stream walls the prior sessions already paid.
+        c = int(prog["c"])
+        offset = min(c * chunk_events, n_events)
+        all_idx.append(prog["idx"].astype(np.int64))
+        all_scores.append(prog["scores"].astype(np.float32))
+        planted.update(prog["planted"].tolist())
+        for k in ("stream_synth", "stream_words_map", "stream_score"):
+            walls[k] += float(prog[f"wall_{k}"])
+        info["resumed_at_chunk"] = c
+
+    def _save_progress():
+        if ckpt is None:
+            return
+        ckpt.save(
+            "stream", c=np.int64(c),
+            idx=(np.concatenate(all_idx) if all_idx
+                 else np.zeros(0, np.int64)),
+            scores=(np.concatenate(all_scores) if all_scores
+                    else np.zeros(0, np.float32)),
+            planted=np.asarray(sorted(planted), np.int64),
+            **{f"wall_{k}": np.float64(walls[k]) for k in
+               ("stream_synth", "stream_words_map", "stream_score")})
+        if save_meta is not None:
+            save_meta()
+
     while offset < n_events:
         m = min(chunk_events, n_events - offset)
         t = time.monotonic()
@@ -398,6 +530,7 @@ def _stream_score(bundle, fitted_edges, theta, phi_wk, *, n_events: int,
         walls["stream_score"] += time.monotonic() - t
         offset += m
         c += 1
+        _save_progress()
 
     scores = np.concatenate(all_scores)
     idxs = np.concatenate(all_idx)
@@ -427,12 +560,18 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--chains", type=int, default=1,
                     help="restart-ensemble chains on the sharded "
                          "engine (the judged-overlap estimator)")
+    ap.add_argument("--resume-dir", default=None,
+                    help="stage/chunk checkpoint dir: a run killed "
+                         "mid-way (severed TPU tunnel window) resumes "
+                         "from the last completed stage / stream chunk "
+                         "instead of restarting")
     args = ap.parse_args(argv)
     m = run_scale(int(args.events), n_hosts=args.hosts,
                   n_sweeps=args.sweeps, seed=args.seed,
                   train_events=(None if args.train_events is None
                                 else int(args.train_events)),
                   datatype=args.datatype, n_chains=args.chains,
+                  resume_dir=args.resume_dir,
                   out_path=args.out)
     print(json.dumps(m, indent=2))
     return 0

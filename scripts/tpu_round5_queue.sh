@@ -1,0 +1,159 @@
+#!/bin/bash
+# Round-5 TPU measurement queue — re-sized for the tunnel's OBSERVED
+# behavior (down for hours, then up for ~40-minute windows; VERDICT r04
+# weak #1). Differences from the r04 queue that never got a device:
+#   * a TRIMMED bench arm (scoring_uniform only, ~5-8 min incl. compile)
+#     fires FIRST, so even a short window yields the judged number;
+#   * steps are stamped — a severed window resumes the queue where it
+#     stopped instead of replaying finished work;
+#   * the tunnel is re-probed after every step; a dead probe returns to
+#     the poll loop rather than burning the remaining steps' timeouts;
+#   * the 1B headline run carries --resume-dir, so each window extends
+#     the same run (scale.py stage/chunk checkpoints) instead of
+#     restarting it;
+#   * CPU studies (overlap cells etc.) are SIGSTOPped while TPU steps
+#     run — this host has ONE core and a starved feeder stalls the
+#     device — and SIGCONTed the moment the queue goes back to polling.
+# Usage: nohup bash scripts/tpu_round5_queue.sh > /tmp/tpu_r05.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.."
+STAMPS=.tpu_r05_stamps
+mkdir -p "$STAMPS"
+
+CPU_STUDY_RE='overlap_r04_sharded|overlap_r05|exp_flow_recall|synth2|rehearsal'
+
+probe() {
+  timeout 75 python -c "
+import jax, jax.numpy as jnp
+x = jnp.ones((256, 256)); float((x @ x).sum())
+assert jax.devices()[0].platform not in ('cpu',)
+print('TPU OK')" 2>/dev/null | grep -q "TPU OK"
+}
+
+pause_cpu_studies()  { pkill -STOP -f "$CPU_STUDY_RE" 2>/dev/null; true; }
+resume_cpu_studies() { pkill -CONT -f "$CPU_STUDY_RE" 2>/dev/null; true; }
+
+# run_step name max_attempts timeout_s command...
+# rc 0 → stamped done. Nonzero → attempt counted; after max_attempts
+# the step is stamped failed so the queue moves on. Window loss is
+# detected by the caller re-probing, not here.
+run_step() {
+  local name=$1 max_att=$2 tmo=$3; shift 3
+  [ -f "$STAMPS/$name.done" ] && return 0
+  [ -f "$STAMPS/$name.failed" ] && return 0
+  local att=0
+  [ -f "$STAMPS/$name.attempts" ] && att=$(cat "$STAMPS/$name.attempts")
+  att=$((att + 1)); echo "$att" > "$STAMPS/$name.attempts"
+  echo "[$(date +%T)] step $name attempt $att/$max_att (timeout ${tmo}s): $*"
+  timeout --signal=KILL "$tmo" "$@" > "/tmp/step_r05_$name.log" 2>&1
+  local rc=$?
+  echo "[$(date +%T)] step $name rc=$rc (log /tmp/step_r05_$name.log)"
+  if [ $rc -eq 0 ]; then
+    touch "$STAMPS/$name.done"
+  elif [ "$att" -ge "$max_att" ]; then
+    echo "[$(date +%T)] step $name exhausted $max_att attempts — marking failed"
+    touch "$STAMPS/$name.failed"
+  fi
+  return $rc
+}
+
+# Validate a bench line and install it as the round-5 builder artifact.
+# A complete TPU run replaces the canonical artifact; a watchdog-cut
+# TPU partial lands in the sidecar UNLESS no canonical artifact exists
+# yet and the partial still carries a scoring value (the r03 judged
+# number itself came from exactly such a partial). CPU fallbacks are
+# never installed.
+install_bench() {  # logfile
+  tail -1 "$1" | python -c "
+import json, os, sys
+line = sys.stdin.readline()
+doc = json.loads(line)
+assert doc['metric'] and doc['value'] > 0
+plat = str(doc['detail'].get('platform', ''))
+if not plat.startswith('tpu'):
+    print('bench platform is %r — not installing' % plat); sys.exit(1)
+complete = 'watchdog' not in doc['detail']
+canon = 'docs/BENCH_r05_builder.json'
+if complete or not os.path.exists(canon):
+    dst = canon
+else:
+    dst = 'docs/BENCH_r05_builder_partial.json'
+open(dst, 'w').write(line)
+print('bench ->', dst, doc['value'], 'vs_baseline', doc['vs_baseline'])"
+}
+
+step_bench_trim() {
+  run_step bench_trim 3 900 env ONIX_BENCH_COMPONENTS=scoring_uniform \
+    ONIX_BENCH_TIMEOUT_S=840 python bench.py || return $?
+  [ -f "$STAMPS/bench_trim.done" ] && [ ! -f "$STAMPS/bench_trim.inst" ] && {
+    install_bench /tmp/step_r05_bench_trim.log && touch "$STAMPS/bench_trim.inst"
+  }
+  return 0
+}
+
+step_bench_full() {
+  run_step bench_full 2 2500 env ONIX_BENCH_TIMEOUT_S=2400 \
+    python bench.py || return $?
+  [ -f "$STAMPS/bench_full.done" ] && [ ! -f "$STAMPS/bench_full.inst" ] && {
+    install_bench /tmp/step_r05_bench_full.log && touch "$STAMPS/bench_full.inst"
+  }
+  return 0
+}
+
+# Value order (VERDICT r04 next #1): judged number first, then the two
+# lever validations (fit-gap verdict, device-words), then streaming,
+# then the resumable 1B headline, then the 1e8 regens and the recall
+# confirmation. Short steps early; everything after bench_trim is
+# gravy for a short window.
+all_steps() {
+  step_bench_trim || return $?
+  run_step fit_gap 2 1800 python scripts/exp_fit_gap.py 5e7 || return $?
+  run_step flow1e8_dev 2 2400 env ONIX_DEVICE_WORDS=1 \
+    python -m onix.pipelines.scale --events 1e8 --train-events 2e7 \
+    --resume-dir .scale_ckpt_flow1e8 \
+    --out docs/SCALE_FLOW_DEVWORDS_r05.json || return $?
+  run_step stream 2 2400 python scripts/stream_scale.py \
+    --out docs/STREAM_r05.json || return $?
+  run_step scale1b 6 3300 env ONIX_DEVICE_WORDS=1 \
+    python -m onix.pipelines.scale --events 1e9 --train-events 1e8 \
+    --chains 4 --hosts 40000 --resume-dir .scale_ckpt_1b \
+    --out docs/SCALE_1B_r05.json || return $?
+  step_bench_full || return $?
+  run_step scale_dns 2 2400 python -m onix.pipelines.scale \
+    --datatype dns --events 1e8 --resume-dir .scale_ckpt_dns \
+    --out docs/SCALE_DNS_r05.json || return $?
+  run_step scale_proxy 2 2400 python -m onix.pipelines.scale \
+    --datatype proxy --events 1e8 --resume-dir .scale_ckpt_proxy \
+    --out docs/SCALE_PROXY_r05.json || return $?
+  run_step flow_recall 2 2400 python scripts/exp_flow_recall.py \
+    --events 1e8 --out docs/FLOW_RECALL_r05.json || return $?
+  return 0
+}
+
+remaining() {  # any step neither done nor failed?
+  for s in bench_trim fit_gap flow1e8_dev stream scale1b bench_full \
+           scale_dns scale_proxy flow_recall; do
+    [ -f "$STAMPS/$s.done" ] || [ -f "$STAMPS/$s.failed" ] || return 0
+  done
+  return 1
+}
+
+echo "[$(date +%T)] round-5 queue up; polling for a live tunnel..."
+while remaining; do
+  until probe; do sleep 90; done
+  echo "[$(date +%T)] tunnel up — running queue (CPU studies paused)"
+  pause_cpu_studies
+  # Walk the steps; a nonzero rc means either a real failure or a lost
+  # window — re-probe decides which. Lost window → back to polling.
+  while remaining; do
+    all_steps && break
+    if ! probe; then
+      echo "[$(date +%T)] tunnel lost mid-queue — back to polling"
+      break
+    fi
+    echo "[$(date +%T)] step failed but tunnel alive — continuing"
+  done
+  resume_cpu_studies
+done
+resume_cpu_studies
+echo "[$(date +%T)] round-5 queue complete: $(ls $STAMPS)"
