@@ -97,21 +97,30 @@ def build_flow_tables(bundle, edges: dict,
     pos_c = np.clip(pos, 0, max(len(table) - 1, 0))
     remap = np.where(len(table) and table[pos_c] == names,
                      pos_c, _COMPACT_UNK).astype(np.int32)
-    nb = N_BINS_DEFAULT - 1
     return FlowDeviceTables(
         word_key_c=jnp.asarray(key_c[order].astype(np.int32)),
         word_ids=jnp.asarray(
             np.asarray(bundle.word_key_ids)[order].astype(np.int32)),
         doc_u32=jnp.asarray(np.asarray(bundle.doc_u32_sorted)),
         doc_ids=jnp.asarray(np.asarray(bundle.doc_u32_ids).astype(np.int32)),
-        hour_edges=jnp.asarray(
-            np.asarray(edges["hour"], np.float32).reshape(nb)),
-        byt_edges=jnp.asarray(
-            np.asarray(edges["log_ibyt"], np.float32).reshape(nb)),
-        pkt_edges=jnp.asarray(
-            np.asarray(edges["log_ipkt"], np.float32).reshape(nb)),
+        hour_edges=_edges1d(edges, "hour"),
+        byt_edges=_edges1d(edges, "log_ibyt"),
+        pkt_edges=_edges1d(edges, "log_ipkt"),
         proto_remap=jnp.asarray(remap),
     )
+
+
+def _edges1d(edges: dict, name: str) -> "jnp.ndarray":
+    """Fitted edge array as f32 [n_edges] for device searchsorted.
+
+    Sized from the FITTED edges, not N_BINS_DEFAULT: magnitude features
+    carry two extra tail-resolution cut points (words._bins tail=True),
+    so edge counts differ per feature and the old fixed reshape(nb)
+    crashed the flow path / silently disabled the dns path."""
+    e = np.asarray(edges[name], np.float32).ravel()
+    if e.size and np.any(np.diff(e) < 0):
+        raise ValueError(f"fitted edges for {name!r} are not sorted")
+    return jnp.asarray(e)
 
 
 def _lookup_sorted(table: jax.Array, ids: jax.Array, keys: jax.Array,
@@ -234,17 +243,14 @@ def build_dns_tables(bundle, edges: dict) -> DnsDeviceTables:
              | fields["rcode"] << _DNS_RCODE_SHIFT
              | fields["tld"] << _DNS_TLD_SHIFT).astype(np.int64)
     order = np.argsort(key_c, kind="stable")
-    nb = N_BINS_DEFAULT - 1
     return DnsDeviceTables(
         word_key_c=jnp.asarray(key_c[order].astype(np.int32)),
         word_ids=jnp.asarray(
             np.asarray(bundle.word_key_ids)[order].astype(np.int32)),
         doc_u32=jnp.asarray(np.asarray(bundle.doc_u32_sorted)),
         doc_ids=jnp.asarray(np.asarray(bundle.doc_u32_ids).astype(np.int32)),
-        hour_edges=jnp.asarray(
-            np.asarray(edges["hour"], np.float32).reshape(nb)),
-        flen_edges=jnp.asarray(
-            np.asarray(edges["frame_len"], np.float32).reshape(nb)),
+        hour_edges=_edges1d(edges, "hour"),
+        flen_edges=_edges1d(edges, "frame_len"),
     )
 
 
@@ -352,15 +358,13 @@ def build_proxy_tables(bundle, edges: dict) -> ProxyDeviceTables:
              | fields["hostip"] << _PROXY_HOSTIP_SHIFT
              | ua_c << _PROXY_UA_SHIFT).astype(np.int64)
     order = np.argsort(key_c, kind="stable")
-    nb = N_BINS_DEFAULT - 1
     return ProxyDeviceTables(
         word_key_c=jnp.asarray(key_c[order].astype(np.int32)),
         word_ids=jnp.asarray(
             np.asarray(bundle.word_key_ids)[order].astype(np.int32)),
         doc_u32=jnp.asarray(np.asarray(bundle.doc_u32_sorted)),
         doc_ids=jnp.asarray(np.asarray(bundle.doc_u32_ids).astype(np.int32)),
-        hour_edges=jnp.asarray(
-            np.asarray(edges["hour"], np.float32).reshape(nb)),
+        hour_edges=_edges1d(edges, "hour"),
     )
 
 

@@ -63,6 +63,7 @@ def run_scale(n_events: int, n_hosts: int | None = None,
               n_topics: int = 20, max_results: int = 3000, seed: int = 0,
               train_events: int | None = None, datatype: str = "flow",
               n_chains: int = 1, resume_dir: str | None = None,
+              generator: str = "mixture",
               out_path: str | pathlib.Path | None = None) -> dict:
     """End-to-end scale run; returns (and optionally writes) the manifest.
 
@@ -110,7 +111,7 @@ def run_scale(n_events: int, n_hosts: int | None = None,
             "n_hosts": n_hosts, "n_anomalies": n_anomalies,
             "n_sweeps": n_sweeps, "n_topics": n_topics, "seed": seed,
             "datatype": datatype, "n_chains": n_chains,
-            "max_results": max_results,
+            "max_results": max_results, "generator": generator,
             "device_words": os.environ.get("ONIX_DEVICE_WORDS", "0"),
         })
         meta = ckpt.load("meta")
@@ -119,8 +120,22 @@ def run_scale(n_events: int, n_hosts: int | None = None,
             resumed_sessions = int(meta["sessions"])
 
     t = time.monotonic()
-    cols = SYNTH_ARRAYS[datatype](train_events, n_hosts=n_hosts,
-                                  n_anomalies=n_anomalies, seed=seed)
+    # generator="sessions" swaps in the INDEPENDENT session/state-
+    # machine generator (synth2.py) whose generative assumptions the
+    # model family does NOT share — the anti-self-referential witness
+    # (VERDICT r04 next #4). Same schema, same pipeline, same planted
+    # contract.
+    if generator == "sessions":
+        from onix.pipelines.synth2 import SYNTH2_ARRAYS as gen_arrays
+    elif generator == "mixture":
+        gen_arrays = SYNTH_ARRAYS
+    else:
+        # A typo'd generator silently producing MIXTURE data would
+        # stamp independent-witness claims on evidence that isn't.
+        raise ValueError(f"unknown generator {generator!r}; "
+                         "expected 'mixture' or 'sessions'")
+    cols = gen_arrays[datatype](train_events, n_hosts=n_hosts,
+                                n_anomalies=n_anomalies, seed=seed)
     walls["synthesize"] = time.monotonic() - t
 
     t = time.monotonic()
@@ -193,8 +208,8 @@ def run_scale(n_events: int, n_hosts: int | None = None,
             bundle, wt.edges, theta, phi_wk, n_events=n_events,
             chunk_events=train_events, n_hosts=n_hosts, seed=seed,
             max_results=max_results, planted=planted, walls=walls,
-            datatype=datatype, info=stream_info, ckpt=ckpt,
-            save_meta=_save_meta)
+            datatype=datatype, info=stream_info, gen_arrays=gen_arrays,
+            ckpt=ckpt, save_meta=_save_meta)
 
     if resumed_sessions:
         # Resumed runs replay the deterministic CPU stages, so raw
@@ -338,7 +353,7 @@ def _stream_score(bundle, fitted_edges, theta, phi_wk, *, n_events: int,
                   chunk_events: int, n_hosts: int, seed: int,
                   max_results: int, planted: set, walls: dict,
                   datatype: str = "flow", info: dict | None = None,
-                  ckpt=None, save_meta=None):
+                  gen_arrays=None, ckpt=None, save_meta=None):
     """Stream the FULL day through the fused device scorer in
     chunk_events-sized pieces against a model fitted on chunk 0.
 
@@ -465,7 +480,7 @@ def _stream_score(bundle, fitted_edges, theta, phi_wk, *, n_events: int,
             idx = (d_ids.astype(np.int32) * np.int32(v_x)
                    + w_ids.astype(np.int32))
         else:
-            cols = SYNTH_ARRAYS[datatype](
+            cols = gen_arrays[datatype](
                 m, n_hosts=n_hosts, n_anomalies=anomalies_per_chunk,
                 seed=seed + 1000 * c)
             planted.update((cols["anomaly_idx"] + offset).tolist())
@@ -560,6 +575,11 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--chains", type=int, default=1,
                     help="restart-ensemble chains on the sharded "
                          "engine (the judged-overlap estimator)")
+    ap.add_argument("--generator", choices=("mixture", "sessions"),
+                    default="mixture",
+                    help="telemetry generator: the round-1 role-mixture "
+                         "synth, or the independent session/state-"
+                         "machine generator (synth2)")
     ap.add_argument("--resume-dir", default=None,
                     help="stage/chunk checkpoint dir: a run killed "
                          "mid-way (severed TPU tunnel window) resumes "
@@ -571,7 +591,7 @@ def main(argv: list[str] | None = None) -> int:
                   train_events=(None if args.train_events is None
                                 else int(args.train_events)),
                   datatype=args.datatype, n_chains=args.chains,
-                  resume_dir=args.resume_dir,
+                  resume_dir=args.resume_dir, generator=args.generator,
                   out_path=args.out)
     print(json.dumps(m, indent=2))
     return 0

@@ -32,7 +32,8 @@ import numpy as np
 import pandas as pd
 
 from onix.store import hour_of
-from onix.utils.features import (digitize, entropy_array, qname_features,
+from onix.utils.features import (tail_quantile_edges,
+                                 digitize, entropy_array, qname_features,
                                  quantile_edges)
 
 # Coarse on purpose: words must repeat for topic structure to exist. A
@@ -202,10 +203,17 @@ class WordTable:
         return render_words(self.spec, keys, self.edges)
 
 
-def _bins(values: np.ndarray, name: str, n_bins: int, edges: dict) -> np.ndarray:
-    """Quantile-bin `values`, fitting edges if absent (fit vs apply mode)."""
+def _bins(values: np.ndarray, name: str, n_bins: int, edges: dict,
+          tail: bool = False) -> np.ndarray:
+    """Quantile-bin `values`, fitting edges if absent (fit vs apply
+    mode). tail=True adds 99/99.9th-percentile cut points so
+    out-of-support magnitudes isolate into rare-by-construction words
+    instead of saturating the top equal-mass bin — applied to every
+    magnitude-like feature (sizes, lengths, entropies), never to
+    cyclic ones (hour). See features.tail_quantile_edges."""
     if name not in edges:
-        edges[name] = quantile_edges(values, n_bins)
+        edges[name] = (tail_quantile_edges(values, n_bins) if tail
+                       else quantile_edges(values, n_bins))
     return digitize(values, edges[name])
 
 
@@ -287,9 +295,9 @@ def flow_words_from_arrays(
     n = (sip_u64 if u64 else sip_u32).shape[0]
     hbin = _bins(np.asarray(hour, np.float64), "hour", n_bins, edges)
     bbin = _bins(np.log1p(np.asarray(ibyt, np.float64)), "log_ibyt",
-                 n_bins, edges)
+                 n_bins, edges, tail=True)
     pbin = _bins(np.log1p(np.asarray(ipkt, np.float64)), "log_ipkt",
-                 n_bins, edges)
+                 n_bins, edges, tail=True)
     key = FLOW_SPEC.pack({
         "proto": remap[np.asarray(proto_id, np.int64)],
         "pclass": _port_class_codes(sport, dport),
@@ -315,9 +323,9 @@ def flow_words(table: pd.DataFrame, n_bins: int = N_BINS_DEFAULT,
     hour = hour_of(table["treceived"])
     hbin = _bins(hour, "hour", n_bins, edges)
     bbin = _bins(np.log1p(table["ibyt"].to_numpy(np.float64)),
-                 "log_ibyt", n_bins, edges)
+                 "log_ibyt", n_bins, edges, tail=True)
     pbin = _bins(np.log1p(table["ipkt"].to_numpy(np.float64)),
-                 "log_ipkt", n_bins, edges)
+                 "log_ipkt", n_bins, edges, tail=True)
     pclass = _port_class_codes(table["sport"].to_numpy(),
                                table["dport"].to_numpy())
     proto = table["proto"].astype(str).str.upper().to_numpy()
@@ -349,10 +357,11 @@ def _dns_pack(*, qname_codes: np.ndarray, qf: dict, hour: np.ndarray,
     implementation exactly."""
     hbin = _bins(np.asarray(hour, np.float64), "hour", n_bins, edges)
     flbin = _bins(np.asarray(frame_len, np.float64), "frame_len",
-                  n_bins, edges)
-    slbin = _bins(qf["sub_len"][qname_codes], "sub_len", n_bins, edges)
+                  n_bins, edges, tail=True)
+    slbin = _bins(qf["sub_len"][qname_codes], "sub_len", n_bins, edges,
+                  tail=True)
     ebin = _bins(qf["sub_entropy"][qname_codes].astype(np.float64),
-                 "sub_entropy", n_bins, edges)
+                 "sub_entropy", n_bins, edges, tail=True)
     return DNS_SPEC.pack({
         "flbin": flbin, "hbin": hbin, "slbin": slbin, "ebin": ebin,
         "nlabels": qf["n_labels"][qname_codes],
@@ -462,9 +471,10 @@ def _proxy_pack(*, uri_codes: np.ndarray, uris: np.ndarray,
     hbin = _bins(np.asarray(hour, np.float64), "hour", n_bins, edges)
     uri_len_u = np.fromiter((len(str(u)) for u in uris), np.float64,
                             len(uris))
-    ulbin = _bins(uri_len_u[uri_codes], "uri_len", n_bins, edges)
+    ulbin = _bins(uri_len_u[uri_codes], "uri_len", n_bins, edges,
+                  tail=True)
     uebin = _bins(entropy_array(uris)[uri_codes].astype(np.float64),
-                  "uri_entropy", n_bins, edges)
+                  "uri_entropy", n_bins, edges, tail=True)
     host_ip_u = np.fromiter(
         (int(bool(_IP_RE.match(str(h)))) for h in hosts), np.int64,
         len(hosts))

@@ -96,25 +96,55 @@ def qname_features(qnames) -> dict[str, np.ndarray]:
 _QUANTILE_SAMPLE_MAX = 1 << 22
 
 
-def quantile_edges(values: np.ndarray, n_bins: int) -> np.ndarray:
-    """Interior quantile cut points (n_bins - 1 edges) for equal-mass bins.
-
-    The flow word binning of the reference (SURVEY.md §2.1 #5:
-    "quantile-binned bytes, packets, and time-of-day"). Beyond
-    _QUANTILE_SAMPLE_MAX elements the fit uses a deterministic stride
-    sample (same input -> same edges; the fitted edges are archived in
-    the run manifest either way, so apply-mode reproducibility is exact).
-    """
-    if n_bins < 1:
-        raise ValueError("n_bins must be >= 1")
-    qs = np.linspace(0.0, 1.0, n_bins + 1)[1:-1]
+def _edge_sample(values: np.ndarray) -> np.ndarray:
+    """Deterministic stride sample for edge fitting (same input ->
+    same edges; fitted edges are archived in the run manifest, so
+    apply-mode reproducibility is exact either way)."""
     values = np.asarray(values, dtype=np.float64)
-    if values.size == 0:
-        return np.zeros(n_bins - 1, dtype=np.float64)
     if values.size > _QUANTILE_SAMPLE_MAX:
         stride = -(-values.size // _QUANTILE_SAMPLE_MAX)   # ceil div
         values = values[::stride]
+    return values
+
+
+def quantile_edges(values: np.ndarray, n_bins: int,
+                   tail_qs: tuple = ()) -> np.ndarray:
+    """Interior quantile cut points (n_bins - 1 edges) for equal-mass
+    bins, plus optional extra upper-tail cut points (one np.quantile
+    pass over one sample for both).
+
+    The flow word binning of the reference (SURVEY.md §2.1 #5:
+    "quantile-binned bytes, packets, and time-of-day").
+    """
+    if n_bins < 1:
+        raise ValueError("n_bins must be >= 1")
+    qs = np.concatenate([np.linspace(0.0, 1.0, n_bins + 1)[1:-1],
+                         np.asarray(tail_qs, np.float64)])
+    values = _edge_sample(values)
+    if values.size == 0:
+        return np.zeros(len(qs), dtype=np.float64)
     return np.quantile(values, qs)
+
+
+def tail_quantile_edges(values: np.ndarray, n_bins: int,
+                        tail_qs: tuple = (0.99, 0.999)) -> np.ndarray:
+    """Equal-mass interior edges PLUS upper-tail cut points.
+
+    Uniform quantile bins put ~1/n_bins of the event mass in the top
+    bin, so any magnitude beyond the background's support lands in a
+    bin it shares with ordinary large values — on independent
+    session-machine telemetry (synth2.py) this made 40-80-char
+    exfiltration URIs word-identical to 17-char asset paths and the
+    detector blind to them (docs/RECALL_r05_sessions.json, "before"
+    arm). Rarity detection needs resolution where the rare things
+    live: two extra edges at the 99th / 99.9th percentile cap the top
+    bin at 0.1% mass, so out-of-support magnitudes isolate into words
+    that are rare BY CONSTRUCTION. In-support behavior is unchanged
+    (the uniform edges are identical); the extra bins stay within
+    every word spec's 6-bit field. Duplicate edges (discrete or
+    short-tailed features where q99 equals an interior edge) are
+    harmless: they produce empty bins, not misbinned values."""
+    return quantile_edges(values, n_bins, tail_qs=tail_qs)
 
 
 def digitize(values: np.ndarray, edges: np.ndarray) -> np.ndarray:

@@ -1,0 +1,121 @@
+"""The independent session/state-machine generator (synth2.py): schema
+contract against synth.SYNTH_ARRAYS, determinism, campaign plants, and
+the full pipeline running unchanged on data the model family did not
+generate (VERDICT r04 next #4)."""
+
+import numpy as np
+import pytest
+
+from onix.pipelines.synth import SYNTH_ARRAYS
+from onix.pipelines.synth2 import SYNTH2_ARRAYS
+
+DATATYPES = ("flow", "dns", "proxy")
+
+
+@pytest.mark.parametrize("datatype", DATATYPES)
+def test_schema_contract(datatype):
+    """Same keys and array dtypes as the mixture generator — the whole
+    point is that every downstream stage runs unchanged."""
+    c1 = SYNTH_ARRAYS[datatype](2_000, n_hosts=150, n_anomalies=20,
+                                seed=7)
+    c2 = SYNTH2_ARRAYS[datatype](2_000, n_hosts=150, n_anomalies=20,
+                                 seed=7)
+    assert set(c1) == set(c2)
+    for k in c1:
+        if isinstance(c1[k], np.ndarray) and c1[k].dtype != object:
+            assert c2[k].dtype == c1[k].dtype, k
+    n = len(c2["hour"])
+    assert n == 2_000
+    ai = c2["anomaly_idx"]
+    assert len(ai) == 20 and ai.min() >= 0 and ai.max() < n
+
+
+@pytest.mark.parametrize("datatype", DATATYPES)
+def test_deterministic_in_seed(datatype):
+    a = SYNTH2_ARRAYS[datatype](5_000, n_hosts=200, n_anomalies=15,
+                                seed=11)
+    b = SYNTH2_ARRAYS[datatype](5_000, n_hosts=200, n_anomalies=15,
+                                seed=11)
+    c = SYNTH2_ARRAYS[datatype](5_000, n_hosts=200, n_anomalies=15,
+                                seed=12)
+    for k, v in a.items():
+        if isinstance(v, np.ndarray) and v.dtype != object:
+            np.testing.assert_array_equal(v, b[k])
+    # A different seed actually changes the data.
+    assert any(isinstance(v, np.ndarray) and v.dtype != object
+               and not np.array_equal(v, c[k]) for k, v in a.items())
+
+
+def test_flow_state_machine_couplings():
+    """The properties that make this generator NOT a topic mixture:
+    packets derive from bytes; sessions alternate direction with a
+    shared ephemeral port; responses are heavier-tailed than
+    requests."""
+    c = SYNTH2_ARRAYS["flow"](200_000, n_hosts=1_000, n_anomalies=50,
+                              seed=3)
+    bg = slice(0, 200_000 - 50)
+    ibyt, ipkt = c["ibyt"][bg], c["ipkt"][bg]
+    # bytes-per-packet bounded by wire realities (synth.py draws the
+    # two independently; here ipkt = ibyt // pkt_size).
+    bpp = ibyt / ipkt
+    assert (bpp <= 1461).mean() > 0.99
+    # Both directions exist: some rows have a service port as sport
+    # (responses), some as dport (requests).
+    svc_ports = {443, 80, 53, 22, 25}
+    req = np.isin(c["dport"][bg], list(svc_ports))
+    resp = np.isin(c["sport"][bg], list(svc_ports))
+    assert req.mean() > 0.2 and resp.mean() > 0.2
+    # Heavy tail: the response size distribution has a fat right tail
+    # (99.9th percentile orders of magnitude above the median).
+    assert np.quantile(ibyt, 0.999) > 50 * np.median(ibyt)
+
+
+def test_dns_graph_structure():
+    """Third-party names recur under many clients (bipartite graph);
+    anomaly names are per-row unique and high-entropy."""
+    c = SYNTH2_ARRAYS["dns"](100_000, n_hosts=1_000, n_anomalies=60,
+                             seed=5)
+    n = 100_000
+    codes = c["qname_codes"]
+    names = c["qnames"]
+    assert codes.max() < len(names)
+    # Background name reuse is heavy (graph), anomaly names unique.
+    bg_codes = codes[:n - 60]
+    an_codes = codes[c["anomaly_idx"]]
+    assert len(np.unique(bg_codes)) < 0.1 * len(bg_codes)
+    tun = an_codes[30:]          # tunnel half: all distinct subdomains
+    assert len(np.unique(tun)) == len(tun)
+    # Tunnel names share one apex domain.
+    apexes = {str(names[i]).split(".", 1)[1] for i in tun}
+    assert len(apexes) == 1
+
+
+def test_proxy_ua_and_campaigns():
+    c = SYNTH2_ARRAYS["proxy"](100_000, n_hosts=1_000, n_anomalies=40,
+                               seed=9)
+    # Every uri/host/ua code indexes its table.
+    assert c["uri_codes"].max() < len(c["uris"])
+    assert c["host_codes"].max() < len(c["hosts"])
+    assert c["ua_codes"].max() < len(c["agents"])
+    # C2 half beacons to one host with one URI, spread across the day.
+    ai = c["anomaly_idx"]
+    c2 = ai[:20]
+    assert len(np.unique(c["host_codes"][c2])) == 1
+    assert len(np.unique(c["uri_codes"][c2])) == 1
+    assert c["hour"][c2].max() - c["hour"][c2].min() > 20
+
+
+@pytest.mark.parametrize("datatype", DATATYPES)
+def test_pipeline_end_to_end_on_sessions_data(datatype):
+    """words -> corpus -> sharded Gibbs -> scoring runs unchanged on
+    the independent data, and surfaces a nontrivial share of the
+    planted campaigns. The bar here is deliberately modest — the
+    generator is mis-specified FOR the model on purpose; the honest
+    at-scale numbers live in docs/RECALL_r05_sessions.json."""
+    from onix.pipelines.scale import run_scale
+    # 16 sweeps: mis-specified data converges slower than the mixture
+    # synth (6-8 sweeps leave the proxy arm far short of its plateau,
+    # especially under the 8-device test mesh's cross-shard staleness).
+    m = run_scale(60_000, n_hosts=500, n_sweeps=16, datatype=datatype,
+                  generator="sessions", max_results=2000)
+    assert m["planted_in_bottom_k"] >= 0.3 * m["planted_anomalies"], m
