@@ -20,9 +20,11 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import time
 import urllib.error
 import urllib.request
+from urllib.parse import quote
 
 from onix.oa.components import REPUTATION_REGISTRY, ReputationClient
 
@@ -187,3 +189,143 @@ class HTTPReputationClient(ReputationClient):
 
 
 REPUTATION_REGISTRY["http"] = HTTPReputationClient
+
+
+class GTIReputationClient(HTTPReputationClient):
+    """McAfee GTI-style adapter (SURVEY.md §2.1 #12; the reference's
+    `oni-gti` plugin). Wire shape: POST {"queries": [{"url": <v>}]} ->
+    {"answers": [{"url": <v>, "rep": <int>}]} — the TrustedSource-style
+    numeric reputation, higher = riskier. The adapter owns only the
+    schema and the rep -> NONE/LOW/MEDIUM/HIGH mapping (thresholds
+    configurable); batching, retries, backoff, breaker, cache and
+    fail-open all come from HTTPReputationClient. Spec: `gti:<url>`
+    (+ ONIX_GTI_API_KEY for auth)."""
+
+    name = "gti"
+
+    def __init__(self, url: str = "", *, low: int = 30, medium: int = 50,
+                 high: int = 70, **kw):
+        kw.setdefault("api_key", os.environ.get("ONIX_GTI_API_KEY", ""))
+        super().__init__(url, **kw)
+        if not low <= medium <= high:
+            raise ValueError("thresholds must be ordered low<=medium<=high")
+        self.thresholds = (low, medium, high)
+        _require_key_for_network(self, "ONIX_GTI_API_KEY")
+
+    def encode_request(self, batch: list[str]) -> bytes:
+        return json.dumps({"queries": [{"url": v} for v in batch]}).encode()
+
+    def parse_response(self, body: bytes) -> dict[str, str]:
+        data = json.loads(body)
+        answers = data.get("answers", [])
+        if not isinstance(answers, list):
+            raise ValueError("answers must be a list")
+        low, medium, high = self.thresholds
+        out: dict[str, str] = {}
+        for a in answers:
+            # One malformed answer must not poison the batch: skip it
+            # (its indicator degrades to NONE downstream) and keep the
+            # valid verdicts.
+            try:
+                rep = int(a.get("rep", 0))
+                url = str(a["url"])
+            except (TypeError, ValueError, KeyError):
+                continue
+            out[url] = ("HIGH" if rep >= high else
+                        "MEDIUM" if rep >= medium else
+                        "LOW" if rep >= low else "NONE")
+        return out
+
+
+class ThreatExchangeClient(HTTPReputationClient):
+    """Facebook ThreatExchange-style adapter (the reference's `oni-tx`
+    plugin). Wire shape: the Graph API batch envelope — POST
+    {"batch": [{"method": "GET", "relative_url":
+    "threat_descriptors?text=<v>&..."}]} with an access token; each
+    sub-response body is {"data": [{"indicator": ..,
+    "severity": INFO|WARNING|SUSPICIOUS|SEVERE|APOCALYPSE}]}. The
+    worst severity over a value's descriptors maps to the level.
+    Spec: `threatexchange:<url>` (+ ONIX_TX_ACCESS_TOKEN)."""
+
+    name = "threatexchange"
+
+    _SEVERITY = {"APOCALYPSE": "HIGH", "SEVERE": "HIGH",
+                 "SUSPICIOUS": "MEDIUM", "WARNING": "LOW"}
+    _RANK = {"NONE": 0, "LOW": 1, "MEDIUM": 2, "HIGH": 3}
+
+    def __init__(self, url: str = "", **kw):
+        kw.setdefault("api_key", os.environ.get("ONIX_TX_ACCESS_TOKEN", ""))
+        # The Graph batch API rejects envelopes above 50 sub-requests.
+        kw.setdefault("batch_size", 50)
+        super().__init__(url, **kw)
+        self._current_batch: list[str] | None = None
+        _require_key_for_network(self, "ONIX_TX_ACCESS_TOKEN")
+
+    def encode_request(self, batch: list[str]) -> bytes:
+        return json.dumps({"batch": [
+            {"method": "GET",
+             "relative_url": ("threat_descriptors?text="
+                              f"{quote(v)}&fields=indicator,severity")}
+            for v in batch]}).encode()
+
+    def _post_batch(self, batch: list[str]) -> dict[str, str]:
+        # Stash the request order: the Graph batch API guarantees
+        # response order matches request order, and the text= search
+        # returns descriptors whose `indicator` strings are routinely
+        # NOT byte-identical to the query (URL forms, subdomains) —
+        # keying by indicator would silently drop and NONE-cache real
+        # hits. parse_response attributes the i-th sub-response to the
+        # i-th queried value instead.
+        self._current_batch = list(batch)
+        try:
+            return super()._post_batch(batch)
+        finally:
+            self._current_batch = None
+
+    def parse_response(self, body: bytes) -> dict[str, str]:
+        responses = json.loads(body)
+        if not isinstance(responses, list):
+            raise ValueError("batch response must be a list")
+        queried = getattr(self, "_current_batch", None) or []
+        out: dict[str, str] = {}
+        for i, sub in enumerate(responses):
+            if i >= len(queried):
+                break
+            value = queried[i]
+            # Graph batch: each entry is {"code": .., "body": "<json>"}
+            # (body is a STRING per the batch API contract). Malformed
+            # entries skip THIS value only.
+            try:
+                if not isinstance(sub, dict) or int(sub.get("code")) != 200:
+                    continue
+                payload = sub.get("body", "{}")
+                data = json.loads(payload) if isinstance(payload, str) \
+                    else payload
+                descriptors = data.get("data", [])
+            except (TypeError, ValueError):
+                continue
+            worst = "NONE"
+            for d in descriptors:
+                lvl = self._SEVERITY.get(
+                    str(d.get("severity", "")).upper(), "NONE")
+                if self._RANK[lvl] > self._RANK[worst]:
+                    worst = lvl
+            out[value] = worst
+        return out
+
+
+def _require_key_for_network(client: HTTPReputationClient,
+                             env_var: str) -> None:
+    """Fail FAST on the one misconfiguration detectable at construction:
+    a vendor client on the real network transport with no credential
+    would 401 on every lookup and silently enrich nothing (4xx is
+    non-retryable, check() fail-opens to NONE). Injected transports
+    (tests, offline demos) stay keyless by design."""
+    if not client.api_key and client.transport is _urllib_transport:
+        raise ValueError(
+            f"{client.name} reputation client has no API key; set "
+            f"{env_var} (or inject a transport for offline use)")
+
+
+REPUTATION_REGISTRY["gti"] = GTIReputationClient
+REPUTATION_REGISTRY["threatexchange"] = ThreatExchangeClient

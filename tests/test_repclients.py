@@ -145,3 +145,111 @@ def test_reputation_column_merges_with_local(tmp_path):
     local = build_reputation(f"local:{lst}")[0]
     col = reputation_column([local, http], ["evil.biz", "fine.org"])
     assert list(col) == ["HIGH", "NONE"]   # max across clients
+
+
+def test_gti_adapter_wire_and_mapping():
+    """gti spec: TrustedSource-style numeric rep mapped through ordered
+    thresholds; the shared discipline (batching/fail-open) untouched."""
+    import json as _json
+
+    from onix.oa.components import build_reputation
+    from onix.oa.repclients import GTIReputationClient
+
+    seen = {}
+
+    def transport(url, payload, timeout, headers):
+        req = _json.loads(payload)
+        seen["queries"] = req["queries"]
+        return 200, _json.dumps({"answers": [
+            {"url": q["url"],
+             "rep": {"a.com": 80, "b.com": 55, "c.com": 35,
+                     "d.com": 5}[q["url"]]}
+            for q in req["queries"]]}).encode()
+
+    c = GTIReputationClient("https://gti.example/query",
+                            transport=transport)
+    got = c.check(["a.com", "b.com", "c.com", "d.com"])
+    assert got == {"a.com": "HIGH", "b.com": "MEDIUM", "c.com": "LOW",
+                   "d.com": "NONE"}
+    assert seen["queries"][0] == {"url": "a.com"}
+    # registry spec round-trip (a real key present: the default
+    # transport without one fails fast by design).
+    import os
+
+    os.environ["ONIX_GTI_API_KEY"] = "test-key"
+    try:
+        (cl,) = build_reputation("gti:https://gti.example/query")
+        assert isinstance(cl, GTIReputationClient)
+    finally:
+        del os.environ["ONIX_GTI_API_KEY"]
+
+
+def test_threatexchange_adapter_batch_envelope():
+    """threatexchange: Graph-batch envelope out, worst severity per
+    indicator in; non-200 sub-responses skipped (fail-open to NONE)."""
+    import json as _json
+
+    from onix.oa.repclients import ThreatExchangeClient
+
+    def transport(url, payload, timeout, headers):
+        req = _json.loads(payload)
+        assert req["batch"][0]["method"] == "GET"
+        assert "threat_descriptors?text=evil.example" \
+            in req["batch"][0]["relative_url"]
+        return 200, _json.dumps([
+            {"code": 200, "body": _json.dumps({"data": [
+                {"indicator": "evil.example", "severity": "WARNING"},
+                {"indicator": "evil.example", "severity": "SEVERE"},
+            ]})},
+            {"code": 500, "body": "{}"},
+        ]).encode()
+
+    c = ThreatExchangeClient("https://graph.example", transport=transport)
+    got = c.check(["evil.example", "dead.example"])
+    assert got["evil.example"] == "HIGH"          # worst severity wins
+    assert got["dead.example"] == "NONE"          # absent -> fail-open
+
+
+def test_threatexchange_positional_attribution_and_caps():
+    """Sub-responses attribute to queried values POSITIONALLY (the
+    text= search returns URL-form indicators that never match the
+    query string byte-for-byte); batch envelope capped at 50; missing
+    credential on the real transport fails fast, injected transports
+    stay keyless."""
+    import json as _json
+
+    import pytest as _pytest
+
+    from onix.oa.repclients import ThreatExchangeClient
+
+    def transport(url, payload, timeout, headers):
+        req = _json.loads(payload)
+        assert len(req["batch"]) <= 50
+        return 200, _json.dumps([
+            {"code": 200, "body": _json.dumps({"data": [
+                {"indicator": "https://evil.example/malware.bin",
+                 "severity": "SEVERE"}]})}
+            for _ in req["batch"]]).encode()
+
+    c = ThreatExchangeClient("https://graph.example", transport=transport)
+    got = c.check([f"host{i}.example" for i in range(60)])
+    # URL-form indicator still lands on the queried value.
+    assert got["host0.example"] == "HIGH" and len(got) == 60
+    with _pytest.raises(ValueError, match="ONIX_TX_ACCESS_TOKEN"):
+        ThreatExchangeClient("https://graph.example")
+
+
+def test_gti_malformed_answer_does_not_poison_batch():
+    import json as _json
+
+    from onix.oa.repclients import GTIReputationClient
+
+    def transport(url, payload, timeout, headers):
+        return 200, _json.dumps({"answers": [
+            {"url": "a.com", "rep": None},
+            {"url": "evil.com", "rep": 99}]}).encode()
+
+    c = GTIReputationClient("https://gti.example", transport=transport)
+    got = c.check(["a.com", "evil.com"])
+    assert got["evil.com"] == "HIGH"      # valid verdict survives
+    assert got["a.com"] == "NONE"         # malformed degrades alone
