@@ -1,0 +1,125 @@
+"""OVERLAP_r05_sharded: the judged bar on ALL THREE datatypes through
+the multi-chip engine, one artifact, with the staleness levers ON.
+
+VERDICT r04 weak #2/#3: dns seed17 (0.947, sync_splits=1) and proxy
+seed41 (0.948, sync_splits=2) missed the 0.95 bar through the sharded
+engine; the built mitigations (dp=4×mp=2 mesh + sync_splits) were never
+combined. Round-5 recipe per cell: dp=4×mp=2, sync_splits=4, sweeps
+450, chains 16 / oracle 32 for dns+proxy; flow keeps its r04-passing
+dp=8, 8/16/300 recipe. Cells checkpoint into the artifact as they
+land, so a killed driver resumes at the first missing cell; externally
+produced cells (the hard-seed rescue runs) merge in by key.
+
+    python scripts/overlap_r05.py --out docs/OVERLAP_r05_sharded.json
+"""
+import argparse
+import json
+import os
+import pathlib
+import sys
+import time
+
+import jax
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from onix.pipelines.rehearsal import run_rehearsal, summarize_cells  # noqa
+
+# (datatype, seed) -> cell recipe. dns/proxy: the combined-lever cell;
+# flow: the r04-passing recipe (re-run under THIS code so the artifact
+# is one engine, one round, one provenance).
+CELLS = [
+    ("dns", 17, dict(mesh=(4, 2), sync_splits=4, sweeps=450,
+                     chains=16, oracle=32)),
+    ("proxy", 41, dict(mesh=(4, 2), sync_splits=4, sweeps=450,
+                       chains=16, oracle=32)),
+    ("dns", 5, dict(mesh=(4, 2), sync_splits=4, sweeps=450,
+                    chains=16, oracle=32)),
+    ("dns", 41, dict(mesh=(4, 2), sync_splits=4, sweeps=450,
+                     chains=16, oracle=32)),
+    ("proxy", 5, dict(mesh=(4, 2), sync_splits=4, sweeps=450,
+                      chains=16, oracle=32)),
+    ("proxy", 17, dict(mesh=(4, 2), sync_splits=4, sweeps=450,
+                       chains=16, oracle=32)),
+    ("flow", 5, dict(mesh=None, sync_splits=1, sweeps=300,
+                     chains=8, oracle=16)),
+    ("flow", 17, dict(mesh=None, sync_splits=1, sweeps=300,
+                      chains=8, oracle=16)),
+    ("flow", 41, dict(mesh=None, sync_splits=1, sweeps=300,
+                      chains=8, oracle=16)),
+]
+
+
+def _load(path: pathlib.Path) -> dict:
+    if path.exists():
+        try:
+            return json.loads(path.read_text())
+        except Exception:
+            pass
+    return {}
+
+
+def _write(path, cells, t0, partial):
+    summary = summarize_cells(cells)
+    doc = {
+        "metric": "top-1000 suspicious-connect overlap vs oracle, min "
+                  "over seeds — SHARDED (multi-chip) engine, combined "
+                  "levers (dp=4x2 mesh + sync_splits)",
+        "engine": "sharded_gibbs virtual 8-device CPU mesh, vmapped "
+                  "chains",
+        "bar": 0.95,
+        **summary,
+        "partial": partial,
+        "n_events": 100_000,
+        "wall_seconds_total": round(time.monotonic() - t0, 1),
+        "cells": cells,
+    }
+    path = pathlib.Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(json.dumps(doc, indent=2) + "\n")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="docs/OVERLAP_r05_sharded.json")
+    ap.add_argument("--merge", nargs="*", default=[],
+                    help="existing artifacts whose cells merge in by "
+                         "key (externally run hard-seed cells)")
+    args = ap.parse_args()
+    outp = pathlib.Path(args.out)
+    prior = _load(outp)
+    cells = dict(prior.get("cells", {}))
+    for m in args.merge:
+        for k, c in _load(pathlib.Path(m)).get("cells", {}).items():
+            cells.setdefault(k, c)
+    t0 = time.monotonic()
+    for dt, seed, r in CELLS:
+        key = f"{dt}/seed{seed}"
+        if key in cells:
+            print(f"[{key}] cached", flush=True)
+            continue
+        t = time.monotonic()
+        res = run_rehearsal(
+            n_events=100_000, n_sweeps=r["sweeps"],
+            n_oracle_runs=r["oracle"], n_chains=r["chains"],
+            engine="sharded", engine_mesh=r["mesh"],
+            sync_splits=r["sync_splits"], seed=seed, datatype=dt)
+        cells[key] = res
+        print(f"[{key}] jax_vs_oracle={res['jax_vs_oracle']} "
+              f"ceiling={res['oracle_vs_oracle']} "
+              f"({time.monotonic() - t:.0f}s)", flush=True)
+        _write(outp, cells, t0, partial=True)
+    _write(outp, cells, t0, partial=False)
+    print(json.dumps(summarize_cells(cells), indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
