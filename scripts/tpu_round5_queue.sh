@@ -20,7 +20,7 @@ cd "$(dirname "$0")/.."
 STAMPS=.tpu_r05_stamps
 mkdir -p "$STAMPS"
 
-CPU_STUDY_RE='overlap_r04_sharded|overlap_r05|exp_flow_recall|synth2|rehearsal'
+CPU_STUDY_RE='overlap_r04_sharded|overlap_r05|exp_flow_recall|exp_sessions_recall|pytest tests'
 
 probe() {
   timeout 75 python -c "
