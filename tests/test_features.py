@@ -48,3 +48,45 @@ def test_subdomain_split():
     sub, sld, n, valid = subdomain_split("localhost")
     assert n == 1 and sld == "localhost"
     assert subdomain_split("")[2] == 0
+
+
+def test_tail_quantile_edges_isolate_out_of_support():
+    """The round-5 binning fix: two tail cut points cap the top bin at
+    0.1% mass so out-of-support magnitudes isolate, while the interior
+    (equal-mass) edges are bit-identical to the uniform fit."""
+    import numpy as np
+
+    from onix.utils.features import (digitize, quantile_edges,
+                                     tail_quantile_edges)
+
+    rng = np.random.default_rng(0)
+    bg = rng.normal(10.0, 2.0, 100_000)          # in-support background
+    uniform = quantile_edges(bg, 5)
+    tailed = tail_quantile_edges(bg, 5)
+    assert len(uniform) == 4 and len(tailed) == 6
+    np.testing.assert_array_equal(tailed[:4], uniform)
+    assert np.all(np.diff(tailed) >= 0)
+    # An outlier far beyond the support gets the NEW top bin, which
+    # holds <= 0.1% of background mass; under uniform edges it shared
+    # the top bin with ~20%.
+    out_bin = digitize(np.array([1e6]), tailed)[0]
+    assert out_bin == 6
+    bg_top = (digitize(bg, tailed) == 6).mean()
+    assert bg_top <= 0.0015
+    # Degenerate distributions: duplicate edges produce empty bins,
+    # never misbinned values.
+    const = np.full(1000, 3.0)
+    e = tail_quantile_edges(const, 5)
+    assert np.all(digitize(const, e) == digitize(const, e)[0])
+
+
+def test_quantile_edges_tail_qs_single_pass_contract():
+    """quantile_edges(tail_qs=...) is the single-pass primitive
+    tail_quantile_edges rides; empty input keeps the widened edge
+    count so fitted-edge consumers see a stable shape."""
+    import numpy as np
+
+    from onix.utils.features import quantile_edges
+
+    e = quantile_edges(np.zeros(0), 5, tail_qs=(0.99, 0.999))
+    assert len(e) == 6
