@@ -123,6 +123,11 @@ def build_parser() -> argparse.ArgumentParser:
     _add_common(p_demo)
     p_demo.add_argument("--events", type=int, default=20000,
                         help="synthetic events per datatype")
+    p_demo.add_argument("--generator", choices=("mixture", "sessions"),
+                        default="mixture",
+                        help="telemetry source: role-mixture synth or "
+                             "the independent session/state-machine "
+                             "generator")
     p_demo.add_argument("--serve", action="store_true",
                         help="serve the dashboards when done")
     p_demo.add_argument("--port", type=int, default=8889)
@@ -215,7 +220,7 @@ def main(argv: list[str] | None = None) -> int:
     if args.command == "demo":
         from onix.setup_cmd import run_demo
         return run_demo(cfg, n_events=args.events, serve=args.serve,
-                        port=args.port)
+                        port=args.port, generator=args.generator)
 
     if args.command == "label":
         from onix.oa.feedback import label_by_rank

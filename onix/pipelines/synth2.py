@@ -683,3 +683,105 @@ def sessions_proxy_day_arrays(n_events: int, n_hosts: int = 100_000,
 SYNTH2_ARRAYS = {"flow": sessions_flow_day_arrays,
                  "dns": sessions_dns_day_arrays,
                  "proxy": sessions_proxy_day_arrays}
+
+
+# ---------------------------------------------------------------------------
+# pandas day frames (store/demo surface)
+# ---------------------------------------------------------------------------
+
+def _day_frame(datatype: str, cols: dict, date: str, rng):
+    """Render columnar session arrays into the store's day-frame schema
+    (same columns as synth.synth_*_day) so `onix demo --generator
+    sessions` and store-backed scoring run on the independent data."""
+    import pandas as pd
+
+    from onix.pipelines.synth import _shuffle, _times
+    from onix.pipelines.words import u32_to_ips
+
+    n = len(cols["hour"])
+    n_bg = n - len(cols["anomaly_idx"])
+    if datatype == "flow":
+        proto_tbl = np.asarray(cols["proto_classes"], dtype=object)
+        table = pd.DataFrame({
+            "treceived": _times(date, cols["hour"]),
+            "sip": u32_to_ips(cols["sip_u32"]),
+            "dip": u32_to_ips(cols["dip_u32"]),
+            "sport": cols["sport"].astype(np.int32),
+            "dport": cols["dport"].astype(np.int32),
+            "proto": proto_tbl[cols["proto_id"]],
+            "ipkt": cols["ipkt"],
+            "ibyt": cols["ibyt"],
+            # Reverse-direction columns aren't modeled per-exchange;
+            # the ack-heavy response ratio stands in (synth.py uses the
+            # same approximation).
+            "opkt": (cols["ipkt"] * 0.8).astype(np.int64),
+            "obyt": (cols["ibyt"] * 0.3).astype(np.int64),
+        })
+    elif datatype == "dns":
+        names = np.asarray(cols["qnames"], dtype=object)
+        table = pd.DataFrame({
+            "frame_time": _times(date, cols["hour"]),
+            "frame_len": cols["frame_len"],
+            "ip_dst": u32_to_ips(cols["client_u32"]),
+            "dns_qry_name": names[cols["qname_codes"]],
+            "dns_qry_type": cols["qtype"],
+            "dns_qry_rcode": cols["rcode"],
+        })
+    elif datatype == "proxy":
+        uris = np.asarray(cols["uris"], dtype=object)
+        hosts = np.asarray(cols["hosts"], dtype=object)
+        agents = np.asarray(cols["agents"], dtype=object)
+        uri_rows = uris[cols["uri_codes"]]
+        # Columns outside the word recipe (method/content-type/bytes)
+        # get schema-plausible values derived from the session columns.
+        is_api = np.char.find(uri_rows.astype(str), "/api") >= 0
+        ctype = np.where(is_api, "application/json", "text/html")
+        times = _times(date, cols["hour"])
+        table = pd.DataFrame({
+            "p_date": np.full(n, date),
+            "p_time": [t.split(" ")[1] for t in times],
+            "clientip": u32_to_ips(cols["client_u32"]),
+            "host": hosts[cols["host_codes"]],
+            "reqmethod": np.where(is_api, "POST", "GET").astype(object),
+            "useragent": agents[cols["ua_codes"]],
+            "resconttype": ctype.astype(object),
+            "respcode": cols["respcode"].astype(np.int32),
+            "uripath": uri_rows,
+            "csbytes": (180 + 12 * np.char.str_len(
+                uri_rows.astype(str))).astype(np.int64),
+            "scbytes": np.exp(rng.normal(7, 1, n)).astype(np.int64),
+        })
+    else:
+        raise ValueError(f"unknown datatype {datatype!r}")
+    return _shuffle(table, n_bg, n, rng)
+
+
+def sessions_flow_day(n_events: int = 20000, n_hosts: int = 120,
+                      n_anomalies: int = 30, date: str = "2016-07-08",
+                      seed: int = 0):
+    cols = sessions_flow_day_arrays(n_events, n_hosts=n_hosts,
+                                    n_anomalies=n_anomalies, seed=seed)
+    return _day_frame("flow", cols, date,
+                      np.random.default_rng(seed ^ 0x5F))
+
+
+def sessions_dns_day(n_events: int = 20000, n_hosts: int = 120,
+                     n_anomalies: int = 30, date: str = "2016-07-08",
+                     seed: int = 0):
+    cols = sessions_dns_day_arrays(n_events, n_hosts=n_hosts,
+                                   n_anomalies=n_anomalies, seed=seed)
+    return _day_frame("dns", cols, date,
+                      np.random.default_rng(seed ^ 0x5F))
+
+
+def sessions_proxy_day(n_events: int = 20000, n_hosts: int = 120,
+                       n_anomalies: int = 30, date: str = "2016-07-08",
+                       seed: int = 0):
+    cols = sessions_proxy_day_arrays(n_events, n_hosts=n_hosts,
+                                     n_anomalies=n_anomalies, seed=seed)
+    return _day_frame("proxy", cols, date,
+                      np.random.default_rng(seed ^ 0x5F))
+
+
+SYNTH2 = {"flow": sessions_flow_day, "dns": sessions_dns_day,
+          "proxy": sessions_proxy_day}

@@ -62,3 +62,30 @@ def test_demo_end_to_end(tmp_path):
         assert summary["run"]["n_events"] == 800
     # demo is resumable: store already loaded, scoring re-runs cleanly
     assert run_demo(cfg, n_events=800) == 0
+
+
+@pytest.mark.slow
+def test_demo_on_sessions_generator(tmp_path):
+    """`onix demo --generator sessions`: the full demo (setup ->
+    store -> scoring -> OA artifacts) on the independent session/
+    state-machine telemetry."""
+    cfg = load_config(None, [
+        f"store.root={tmp_path}/store",
+        f"store.results_dir={tmp_path}/results",
+        f"store.feedback_dir={tmp_path}/feedback",
+        f"store.checkpoint_dir={tmp_path}/ck",
+        f"oa.data_dir={tmp_path}/oa",
+        "lda.n_sweeps=6", "lda.burn_in=2", "pipeline.max_results=200",
+    ])
+    assert run_demo(cfg, n_events=800, generator="sessions") == 0
+    for t in ("flow", "dns", "proxy"):
+        day = tmp_path / "oa" / t / DEMO_DATE.replace("-", "")
+        assert (day / "suspicious.csv").is_file()
+    with pytest.raises(ValueError, match="unknown generator"):
+        run_demo(cfg, generator="sess")
+    # A store pinned to one generator refuses another (silent stale
+    # scoring is the failure mode this guards).
+    with pytest.raises(ValueError, match="already holds a demo day"):
+        run_demo(cfg, n_events=800, generator="mixture")
+    # Same-generator re-run stays resumable.
+    assert run_demo(cfg, n_events=800, generator="sessions") == 0
