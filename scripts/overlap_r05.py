@@ -72,6 +72,9 @@ def _load(path: pathlib.Path) -> dict:
 
 def _write(path, cells, t0, partial):
     summary = summarize_cells(cells)
+    summary["passes_bar_all"] = (not partial) and all(
+        v.get("passes_bar_min") for v in summary.values()
+        if isinstance(v, dict))
     doc = {
         "metric": "top-1000 suspicious-connect overlap vs oracle, min "
                   "over seeds — SHARDED (multi-chip) engine, combined "
