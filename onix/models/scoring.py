@@ -654,3 +654,42 @@ def select_suspicious(scores: np.ndarray, tol: float,
         part = np.argpartition(scores[cand], max_results - 1)
         cand = cand[part[:max_results]]
     return cand[np.argsort(scores[cand], kind="stable")]
+
+
+def doc_rarity(theta: jax.Array, doc_weights: jax.Array) -> jax.Array:
+    """Per-DOCUMENT suspiciousness: expected log corpus-popularity of
+    the document's topics. Returns float32 [D], LOW = suspicious.
+
+    Event scoring ranks words by rarity, which fades exactly when an
+    attack is sustained: a campaign of hundreds of near-identical
+    events accumulates word count (and, with enough mass, its own
+    topic) until its events stop being individually rare — measured on
+    the independent session generator, where 300-event tunnel/exfil
+    campaigns score ~0 event recall while 15-event ones score 1.0
+    (docs/RECALL_r05_sessions*.json). The campaign's signature is at
+    the DOCUMENT level instead: its client concentrates token mass on
+    a topic almost no other document uses.
+
+        share_k = sum_d n_d * theta[d, k] / sum_d n_d   (corpus topic mass)
+        score_d = sum_k theta[d, k] * log(share_k)
+
+    A document riding globally-popular topics scores near the
+    corpus-entropy baseline; a document whose mixture sits on a
+    globally-rare topic scores far below it. One [D,K] contraction +
+    one [D,K]@[K] matvec — MXU change, host round-trip only for the
+    [D] result. Chained estimates ([C, D, K]) average the per-chain
+    scores (arithmetic: log-space values, same label-switching
+    robustness argument as score_events' geometric mean in p-space).
+    """
+    theta = jnp.asarray(theta)
+    w = jnp.asarray(doc_weights, jnp.float32)
+
+    def one(th):
+        th = th.astype(jnp.float32)
+        mass = w @ th                       # [K] token mass per topic
+        share = mass / jnp.maximum(mass.sum(), 1e-30)
+        return th @ jnp.log(jnp.maximum(share, 1e-30))
+
+    if theta.ndim == 2:
+        return one(theta)
+    return jnp.mean(jax.vmap(one)(theta), axis=0)

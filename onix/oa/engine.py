@@ -387,8 +387,17 @@ def run_oa(cfg: OnixConfig, date: str, datatype: str) -> int:
     enriched.to_csv(out / "suspicious.csv", index=False)
     (out / "suspicious.json").write_text(
         enriched.to_json(orient="records"))
-    (out / "summary.json").write_text(
-        json.dumps(_summary(enriched, datatype, date, manifest), indent=2))
+    summary = _summary(enriched, datatype, date, manifest)
+    clients_csv = res_csv.with_name(res_csv.stem + "_clients.csv")
+    if clients_csv.exists():
+        cdf = pd.read_csv(clients_csv)
+        summary["suspicious_clients"] = [
+            {"client": str(r.client),
+             "topic_rarity": float(r.topic_rarity),
+             "n_tokens": int(r.n_tokens)}
+            for r in cdf.head(20).itertuples()]
+        cdf.to_csv(out / "clients.csv", index=False)
+    (out / "summary.json").write_text(json.dumps(summary, indent=2))
     (out / "graph.json").write_text(json.dumps(_graph(enriched, datatype)))
     (out / "storyboard.json").write_text(
         json.dumps(_storyboard(enriched, datatype)))

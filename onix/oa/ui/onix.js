@@ -62,6 +62,45 @@ function svgEl(tag, attrs = {}) {
 }
 function fmtScore(s) { return Number(s).toExponential(2); }
 
+
+// Campaign suspects: document topic-rarity ranking (summary.json
+// suspicious_clients, written by the round-5 scoring run). Event-level
+// word rarity fades on sustained homogeneous campaigns; these are the
+// clients whose token mass rides topics almost nobody else uses.
+function renderClients(sum) {
+  const list = sum.suspicious_clients || [];
+  const panel = document.getElementById("clients-panel");
+  if (!panel) return;
+  if (!list.length) { panel.hidden = true; return; }
+  panel.hidden = false;
+  const tbl = el("table", { class: "mini" });
+  const head = el("tr");
+  ["#", "client", "topic rarity", "tokens"].forEach(
+    h => head.append(el("th", {}, h)));
+  tbl.append(head);
+  list.forEach((c, i) => {
+    const tr = el("tr", { class: "clickable" });
+    tr.append(el("td", {}, String(i + 1)),
+              el("td", {}, c.client),
+              el("td", {}, Number(c.topic_rarity).toFixed(3)),
+              el("td", {}, String(c.n_tokens)));
+    tr.addEventListener("click", () => {
+      // Results rows attribute each event to its achieving document
+      // ("ip" column) — the same id space as the client ranking. An
+      // ABSORBED campaign has no event-level rows by definition; say
+      // so instead of presenting a silently empty drill.
+      const mine = allRows.filter(r => String(r.ip) === c.client);
+      openDrill(mine.length
+        ? `client ${c.client}`
+        : `client ${c.client} — no event-level hits (campaign ` +
+          `absorbed into its own topic; evidence is the rarity ` +
+          `score + clients.csv)`, mine);
+    });
+    tbl.append(tr);
+  });
+  document.getElementById("clients").replaceChildren(tbl);
+}
+
 function renderTiles(sum) {
   const run = sum.run || {};
   const tiles = [
@@ -743,6 +782,7 @@ async function load() {
       openDrill(`hour ${String(hh).padStart(2, "0")}:00`, rows);
     });
   });
+  renderClients(sum);
   renderEventTimeline(rows);
   renderGraph(graph);
   renderStoryboard(story);

@@ -288,3 +288,36 @@ def event_scores(bundle: CorpusBundle, token_scores: np.ndarray,
     out = np.full(n_events, np.inf, np.float64)
     np.minimum.at(out, te, token_scores)
     return out
+
+
+def doc_rarity_scores(bundle: CorpusBundle, theta,
+                      weights: np.ndarray | None = None):
+    """Full per-document topic-rarity vector (scoring.doc_rarity), with
+    evidence-free documents (feedback-only or padding rows) masked to
+    +inf. Returns (scores [D], weights [D]); pass `weights` when the
+    caller already holds the per-doc token counts so the O(n_tokens)
+    bincount runs once per scoring run."""
+    import jax.numpy as jnp
+
+    from onix.models import scoring
+
+    corpus = bundle.corpus
+    if weights is None:
+        weights = np.bincount(corpus.doc_ids[:bundle.n_real_tokens],
+                              minlength=corpus.n_docs)
+    weights = np.asarray(weights, np.float32)
+    scores = np.asarray(scoring.doc_rarity(jnp.asarray(theta), weights))
+    return np.where(weights > 0, scores, np.inf), weights
+
+
+def select_suspicious_docs(bundle: CorpusBundle, theta,
+                           max_results: int = 100,
+                           weights: np.ndarray | None = None):
+    """Rank DOCUMENTS (clients/IPs) by topic rarity — the campaign
+    detector that complements per-event word rarity (scoring.doc_rarity
+    has the full rationale). Returns (doc_index ascending-suspicious,
+    scores) as numpy arrays, at most `max_results` rows."""
+    scores, _w = doc_rarity_scores(bundle, theta, weights)
+    order = np.argsort(scores, kind="stable")[:max_results]
+    order = order[np.isfinite(scores[order])]
+    return order, scores[order]

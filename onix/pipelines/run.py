@@ -261,6 +261,26 @@ def run_scoring(cfg: OnixConfig, engine: str = "gibbs",
     out_csv.parent.mkdir(parents=True, exist_ok=True)
     results.to_csv(out_csv, index=False)
 
+    # Campaign complement (round 5): per-event word rarity fades on
+    # sustained homogeneous campaigns (the repeated word stops being
+    # rare once its count grows); DOCUMENT topic rarity is the signal
+    # that survives (scoring.doc_rarity). Top clients ship beside the
+    # event results for the OA layer.
+    from onix.pipelines.corpus_build import select_suspicious_docs
+    tok_counts = np.bincount(
+        bundle.corpus.doc_ids[:bundle.n_real_tokens],
+        minlength=bundle.corpus.n_docs)
+    doc_idx, doc_scores = select_suspicious_docs(
+        bundle, fit["theta"], max_results=100, weights=tok_counts)
+    clients = pd.DataFrame({
+        "rank": np.arange(1, len(doc_idx) + 1),
+        "client": bundle.doc_keys[doc_idx],
+        "topic_rarity": doc_scores,
+        "n_tokens": tok_counts[doc_idx],
+    })
+    clients_csv = out_csv.with_name(out_csv.stem + "_clients.csv")
+    clients.to_csv(clients_csv, index=False)
+
     # Run manifest (SURVEY.md §5.5: config hash, data partition, seed;
     # §5.1: the judged events-scored/sec is a first-class number).
     manifest = {
@@ -273,6 +293,7 @@ def run_scoring(cfg: OnixConfig, engine: str = "gibbs",
         "n_tokens": int(bundle.corpus.n_tokens),
         "n_feedback_tokens": int(bundle.corpus.n_tokens - bundle.n_real_tokens),
         "n_results": int(len(results)),
+        "n_client_results": int(len(clients)),
         "wall_seconds": round(time.time() - t0, 3),
         "scoring_seconds": round(scoring_seconds, 4),
         "events_per_sec": round(events_per_sec, 1),

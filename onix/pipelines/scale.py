@@ -368,6 +368,10 @@ def _stream_score(bundle, fitted_edges, theta, phi_wk, *, n_events: int,
     from onix.models import scoring
 
     info = {} if info is None else info
+    # Direct callers (exp_flow_recall.py and any embedder predating the
+    # generator parameter) stream the default mixture synth.
+    if gen_arrays is None:
+        gen_arrays = SYNTH_ARRAYS
     theta_x, phi_x = extend_model_for_unseen(theta, phi_wk)
     d_x, v_x = theta_x.shape[-2], phi_x.shape[-2]
     chains = theta_x.shape[0] if theta_x.ndim == 3 else 1

@@ -85,6 +85,13 @@ def run_arm(datatype: str, n_events: int, n_anomalies: int, seed: int,
         top = select_suspicious_events(bundle, fit["theta"], fit["phi_wk"],
                                        n_events, tol=1.0,
                                        max_results=max(depths))
+        # Doc-level arm (round 5): the campaign detector. Where does
+        # each campaign's client land in the topic-rarity ranking?
+        from onix.pipelines.corpus_build import doc_rarity_scores
+        dsc, _w = doc_rarity_scores(bundle, fit["theta"])
+        drank = np.argsort(np.argsort(dsc))
+        ids = np.asarray(bundle.doc_u32_ids)
+        u32s = np.asarray(bundle.doc_u32_sorted)
         order = np.asarray(top.indices)
         order = order[order >= 0]
         slices = campaign_slices(datatype, n_anomalies)
@@ -92,7 +99,14 @@ def run_arm(datatype: str, n_events: int, n_anomalies: int, seed: int,
         out = {"n_vocab": int(corpus.n_vocab),
                "n_docs": int(corpus.n_docs),
                "wall_seconds": round(time.monotonic() - t0, 1),
-               "recall": {}}
+               "client_doc_ranks": {}, "recall": {}}
+        for name, (lo, hi) in slices.items():
+            ranks = []
+            for cu in np.unique(cols["client_u32"][ai[lo:hi]]):
+                pos = np.searchsorted(u32s, np.uint32(cu))
+                if pos < len(u32s) and u32s[pos] == cu:
+                    ranks.append(int(drank[ids[pos]]))
+            out["client_doc_ranks"][name] = sorted(ranks)
         for depth in depths:
             sel = set(order[:depth].tolist())
             by_c = {}

@@ -421,3 +421,38 @@ def test_ingest_volumes_reports_skip_reason(tmp_path):
     assert ing["available"] and ing["rows_total"] == 5
     assert ing["hourly"] is None
     assert ing["hourly_skipped"] == "no_timestamps"
+
+
+def test_oa_summary_includes_suspicious_clients(tmp_path):
+    """run_scoring ships <results>_clients.csv (document topic-rarity
+    ranking); run_oa folds the top rows into summary.json and copies
+    the table into the OA day dir."""
+    import json
+
+    from onix.config import load_config
+    from onix.oa.engine import oa_dir, run_oa
+    from onix.pipelines.run import run_scoring
+    from onix.pipelines.synth import synth_dns_day
+
+    cfg = load_config(None, [
+        f"store.root={tmp_path}/store",
+        f"store.results_dir={tmp_path}/results",
+        f"store.feedback_dir={tmp_path}/fb",
+        f"store.checkpoint_dir={tmp_path}/ck",
+        f"oa.data_dir={tmp_path}/oa",
+        "pipeline.datatype=dns", "pipeline.date=2016-07-08",
+        "lda.n_sweeps=6", "lda.burn_in=2", "pipeline.max_results=100",
+    ])
+    day, _ = synth_dns_day(n_events=4000, n_hosts=100, n_anomalies=12,
+                           seed=3)
+    assert run_scoring(cfg, table=day) == 0
+    clients = (tmp_path / "results" / "20160708" /
+               "dns_results_clients.csv")
+    assert clients.is_file()
+    assert run_oa(cfg, "2016-07-08", "dns") == 0
+    out = oa_dir(cfg, "dns", "2016-07-08")
+    summary = json.loads((out / "summary.json").read_text())
+    sc = summary["suspicious_clients"]
+    assert len(sc) > 0 and {"client", "topic_rarity", "n_tokens"} \
+        <= set(sc[0])
+    assert (out / "clients.csv").is_file()
