@@ -100,9 +100,13 @@ def run_arm(datatype: str, n_events: int, n_anomalies: int, seed: int,
                "n_docs": int(corpus.n_docs),
                "wall_seconds": round(time.monotonic() - t0, 1),
                "client_doc_ranks": {}, "recall": {}}
+        # Campaign actor column: dns/proxy key docs by client ip;
+        # flow's campaigns act from the SOURCE ip.
+        actor = cols["sip_u32"] if datatype == "flow" \
+            else cols["client_u32"]
         for name, (lo, hi) in slices.items():
             ranks = []
-            for cu in np.unique(cols["client_u32"][ai[lo:hi]]):
+            for cu in np.unique(actor[ai[lo:hi]]):
                 pos = np.searchsorted(u32s, np.uint32(cu))
                 if pos < len(u32s) and u32s[pos] == cu:
                     ranks.append(int(drank[ids[pos]]))
