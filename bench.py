@@ -209,6 +209,7 @@ def bench_scoring_uniform(jax, jnp, small=False, checkpoint=None):
     live_proxy = 20.0 * _numpy_scoring_rate(theta, phi_wk)
     return rate, {
         "n_events_per_pass": n_events,
+        "n_topics": k,
         "passes_in_one_program": reps,
         "wall_seconds": round(dt, 3),
         "selection": sel,
@@ -318,6 +319,46 @@ def bench_scoring_zipf(jax, jnp, n_docs, n_vocab, tag, small=False):
         "strategy": tag,
         "wall_seconds": round(dt, 3),
     }
+
+
+def _roofline_detail(detail: dict) -> dict | None:
+    """detail.roofline: achieved bytes/s + fraction-of-peak for the two
+    judged hot loops, from each component's modeled per-item traffic
+    (docs/PERF.md "Roofline accounting"). Byte models:
+
+    * scoring scan — per event: two table-row gathers (θ[d], φ[w]:
+      2·K·dtype bytes; the bf16 selection variants move 2-byte rows)
+      plus the f32 chunk-score write (4 B). Index reads ride along at
+      8 B/event. The gathered-operand padding traffic PERF.md measured
+      is already engineered out by `_subscan_scores`, so it is NOT in
+      the model — a fusion regression shows up as a falling fraction.
+    * Gibbs sweep — per token: n_dk[d] and n_wk[w] row read + scatter
+      write-back (4·K·4 B) plus the token stream (d, w, z: 12 B). The
+      sweep was measured scatter-bound on TPU (PERF.md), so row traffic
+      is the model.
+    """
+    from onix.utils.obs import device_peak_bytes_per_s, roofline
+
+    try:
+        peak, peak_src = device_peak_bytes_per_s()
+    except Exception as e:                      # noqa: BLE001
+        return {"error": f"peak probe failed: {e!r}"}
+    out = {"peak_bytes_per_s": (round(peak, 1) if peak else None),
+           "peak_source": peak_src}
+    su = detail.get("scoring_uniform")
+    if isinstance(su, dict) and "wall_seconds" in su:
+        k = su.get("n_topics", 20)
+        dtype_b = 2 if "bf16" in str(su.get("selection", "")) else 4
+        out["scoring_scan"] = roofline(
+            su["passes_in_one_program"] * su["n_events_per_pass"],
+            su["wall_seconds"], 2 * k * dtype_b + 4 + 8, peak)
+    gs = detail.get("gibbs_sweep")
+    if isinstance(gs, dict) and "wall_seconds" in gs:
+        k = gs.get("n_topics", 20)
+        out["gibbs_sweep"] = roofline(
+            gs["sweeps_in_one_program"] * gs["n_tokens"],
+            gs["wall_seconds"], 4 * k * 4 + 12, peak)
+    return out
 
 
 def _probe_backend(timeout_s: float = 75.0):
@@ -574,6 +615,13 @@ def _measure() -> None:
     run("scoring_zipf_dedup",
         lambda: bench_scoring_zipf(jax, jnp, 1_000_000, 2_048,
                                    "pair_dedup", small=fallback))
+    # Roofline accounting over whatever components completed — bytes/s
+    # and fraction-of-peak become tracked numbers (docs/PERF.md), so a
+    # throughput regression is a falling fraction, not a prose claim.
+    rl = _roofline_detail(detail)
+    if rl is not None:
+        detail["roofline"] = rl
+        save()
     if errors:
         detail["errors"] = errors
     if fallback:

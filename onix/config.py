@@ -43,7 +43,12 @@ class LDAConfig:
     svi_tau0: float = 64.0
     svi_kappa: float = 0.7
     svi_batch_size: int = 4096  # documents per SVI minibatch
-    svi_local_iters: int = 30   # local E-step fixed-point iterations
+    svi_local_iters: int = 30   # local E-step fixed-point iteration CAP
+    # E-step convergence stop (Hoffman's onlineldavb meanchange rule):
+    # iteration ends early once mean |Δgamma| over the batch drops under
+    # this. Converged batches stop in a handful of iterations instead of
+    # always paying the svi_local_iters cap; 0 disables (fixed count).
+    svi_meanchange_tol: float = 1e-3
     svi_max_epochs: int = 30    # batch-mode epoch cap (streaming: n/a)
     svi_epoch_tol: float = 1e-3  # stop when relative ll gain drops below
     checkpoint_every: int = 0   # sweeps between sampler checkpoints (0=off)
@@ -72,6 +77,8 @@ class LDAConfig:
             raise ValueError("svi_max_epochs must be >= 1")
         if self.svi_epoch_tol < 0:
             raise ValueError("svi_epoch_tol must be >= 0")
+        if self.svi_meanchange_tol < 0:
+            raise ValueError("svi_meanchange_tol must be >= 0")
         if self.checkpoint_every < 0:
             raise ValueError("checkpoint_every must be >= 0")
         if self.n_chains < 1:

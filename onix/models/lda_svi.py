@@ -39,18 +39,28 @@ class MiniBatch(NamedTuple):
     svi_step (no per-batch retrace). `doc_map[i]` recovers the original
     document (IP) id of local doc i (-1 for padding rows) — gamma rows
     are meaningless without it.
+
+    `mask` carries per-row token MULTIPLICITY, not just validity: the
+    deduped streaming path feeds unique (doc, word) pairs with their
+    counts as weights, and every E-step/λ-step contribution multiplies
+    by mask — so a weight-w row contributes exactly what w identical
+    rows would (same math, a fraction of the memory passes). Plain
+    callers get 1.0 per real token, 0.0 padding, as before.
     """
     doc_ids: jax.Array   # int32 [T] local-dense doc index per token
     word_ids: jax.Array  # int32 [T]
-    mask: jax.Array      # float32 [T] 1.0 for real tokens
+    mask: jax.Array      # float32 [T] token multiplicity; 0.0 padding
     doc_map: jax.Array   # int32 [Bd] local doc -> original doc id (-1 pad)
     n_docs: int          # Bd (padded) — static
 
 
 def make_minibatch(doc_ids: np.ndarray, word_ids: np.ndarray,
                    pad_to: int | None = None,
-                   pad_docs: int | None = None) -> MiniBatch:
-    """Densify document ids; pad tokens to `pad_to` and docs to `pad_docs`."""
+                   pad_docs: int | None = None,
+                   weights: np.ndarray | None = None) -> MiniBatch:
+    """Densify document ids; pad tokens to `pad_to` and docs to
+    `pad_docs`. `weights` (float32 [T]) sets per-row multiplicities for
+    the deduped-pair path; default 1.0 per row."""
     uniq, local = np.unique(np.asarray(doc_ids), return_inverse=True)
     t = len(local)
     pad_to = t if pad_to is None else pad_to
@@ -62,13 +72,16 @@ def make_minibatch(doc_ids: np.ndarray, word_ids: np.ndarray,
     rem = pad_to - t
     doc_map = np.full(n_docs, -1, np.int32)
     doc_map[: len(uniq)] = uniq
+    w = (np.ones(t, np.float32) if weights is None
+         else np.asarray(weights, np.float32))
+    if w.shape[0] != t:
+        raise ValueError("weights must match the token count")
     return MiniBatch(
         doc_ids=jnp.asarray(np.concatenate([local.astype(np.int32),
                                             np.zeros(rem, np.int32)])),
         word_ids=jnp.asarray(np.concatenate([np.asarray(word_ids, np.int32),
                                              np.zeros(rem, np.int32)])),
-        mask=jnp.asarray(np.concatenate([np.ones(t, np.float32),
-                                         np.zeros(rem, np.float32)])),
+        mask=jnp.asarray(np.concatenate([w, np.zeros(rem, np.float32)])),
         doc_map=jnp.asarray(doc_map),
         n_docs=int(n_docs),
     )
@@ -91,6 +104,7 @@ def svi_step(
     corpus_docs: jax.Array,  # D — total docs the stream represents; a
     #                          TRACED scalar so a streaming driver can
     #                          grow its running estimate without retracing
+    gamma0: jax.Array | None = None,   # [Bd,K] E-step warm start
     *,
     alpha: float,
     eta: float,
@@ -98,21 +112,53 @@ def svi_step(
     kappa: float,
     local_iters: int,
     batch_docs: int,         # static Bd for gamma shape
+    meanchange_tol: float = 0.0,
 ) -> tuple[SVIState, jax.Array]:
-    """One SVI update. Returns (new_state, gamma [Bd,K]) for scoring."""
+    """One SVI update. Returns (new_state, gamma [Bd,K]) for scoring.
+
+    The local E-step iterates to convergence (mean |Δgamma| under
+    `meanchange_tol` — Hoffman's onlineldavb stopping rule) with
+    `local_iters` as the hard cap; tol 0 keeps the fixed-count loop.
+    Token weights ride `batch.mask` (MiniBatch docstring), so deduped
+    (doc, word) pairs update gamma and lambda exactly as their
+    multiplicity of identical tokens would. `gamma0` warm-starts the
+    fixed point (a streaming driver passes each returning doc's LAST
+    gamma — recurring docs then converge in a few iterations instead
+    of re-walking from the prior); None keeps the cold start."""
     k = state.lam.shape[1]
     elog_beta = _e_log_dirichlet(state.lam, axis=0)      # [V,K]
     elog_beta_t = elog_beta[batch.word_ids]              # [T,K]
 
-    def local_iter(_, gamma):
+    def e_step(gamma):
         elog_theta = _e_log_dirichlet(gamma, axis=1)     # [Bd,K]
         logp = elog_theta[batch.doc_ids] + elog_beta_t   # [T,K]
         phi = jax.nn.softmax(logp, axis=-1) * batch.mask[:, None]
-        gamma = alpha + jnp.zeros_like(gamma).at[batch.doc_ids].add(phi)
-        return gamma
+        return alpha + jnp.zeros_like(gamma).at[batch.doc_ids].add(phi)
 
-    gamma0 = jnp.full((batch_docs, k), alpha + 1.0, jnp.float32)
-    gamma = jax.lax.fori_loop(0, local_iters, local_iter, gamma0)
+    if gamma0 is None:
+        gamma0 = jnp.full((batch_docs, k), alpha + 1.0, jnp.float32)
+    if meanchange_tol > 0.0:
+        def body(carry):
+            gamma, _, i = carry
+            g2 = e_step(gamma)
+            # Per-DOCUMENT convergence, as in Hoffman's rule: iterate
+            # until EVERY doc's mean |Δgamma| is under tol. A
+            # batch-global mean would let a majority of converged
+            # (warm-started, recurring) docs dilute away exactly the
+            # still-moving first-seen docs the rarity detector needs
+            # converged. Padding rows collapse to alpha after one
+            # iteration and stop contributing.
+            return g2, jnp.abs(g2 - gamma).mean(axis=1).max(), i + 1
+
+        def cond(carry):
+            _, delta, i = carry
+            return (i < local_iters) & (delta > meanchange_tol)
+
+        gamma, _, _ = jax.lax.while_loop(
+            cond, body, (gamma0, jnp.float32(jnp.inf), jnp.int32(0)))
+    else:
+        gamma = jax.lax.fori_loop(0, local_iters,
+                                  lambda _, g: e_step(g), gamma0)
 
     # Final responsibilities under converged gamma.
     elog_theta = _e_log_dirichlet(gamma, axis=1)
@@ -147,15 +193,17 @@ class SVILda:
             alpha=config.alpha, eta=config.eta,
             tau0=config.svi_tau0, kappa=config.svi_kappa,
             local_iters=config.svi_local_iters,
+            meanchange_tol=config.svi_meanchange_tol,
         ), static_argnames=("batch_docs",))
 
     def init(self) -> SVIState:
         return init_state(self.n_vocab, self.config.n_topics, self.config.seed)
 
     def update(self, state: SVIState, batch: MiniBatch,
-               corpus_docs: float | None = None):
+               corpus_docs: float | None = None, gamma0=None):
         """One SVI step. `corpus_docs` overrides the construction-time D —
         streaming callers pass their running distinct-doc estimate (traced,
-        so a growing value never retraces)."""
+        so a growing value never retraces). `gamma0` warm-starts the
+        E-step (svi_step docstring)."""
         d = float(self.corpus_docs if corpus_docs is None else corpus_docs)
-        return self._step(state, batch, d, batch_docs=batch.n_docs)
+        return self._step(state, batch, d, gamma0, batch_docs=batch.n_docs)

@@ -225,7 +225,13 @@ class GTIReputationClient(HTTPReputationClient):
         for a in answers:
             # One malformed answer must not poison the batch: skip it
             # (its indicator degrades to NONE downstream) and keep the
-            # valid verdicts.
+            # valid verdicts. The isinstance gate matters: a non-dict
+            # entry (e.g. a bare string) raises AttributeError on
+            # .get — which is NOT in the caught set — and would
+            # fail-open the WHOLE batch to NONE via the transport
+            # handler instead of degrading one answer.
+            if not isinstance(a, dict):
+                continue
             try:
                 rep = int(a.get("rep", 0))
                 url = str(a["url"])

@@ -38,6 +38,19 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+try:                        # newer jax exposes shard_map at top level
+    _shard_map = jax.shard_map
+except AttributeError:      # older (≤0.4.37): the experimental home
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+if hasattr(jax.lax, "pcast"):
+    _pcast = jax.lax.pcast
+else:
+    # Older jax has no varying-type system: every value inside
+    # shard_map is implicitly device-varying, so the cast is identity.
+    def _pcast(x, axis_name, *, to="varying"):
+        return x
+
 from onix.config import LDAConfig
 from onix.corpus import Corpus
 from onix.models import lda_gibbs
@@ -234,10 +247,10 @@ class ShardedGibbsLDA:
                     # device starts updating them locally — mark them
                     # per group; the psum fold below restores the
                     # replication the carry (and out_specs) demand.
-                    nwk_v = jax.lax.pcast(nwk_r, D, to="varying")
-                    ndk_v = (jax.lax.pcast(ndk_r, M, to="varying")
+                    nwk_v = _pcast(nwk_r, D, to="varying")
+                    ndk_v = (_pcast(ndk_r, M, to="varying")
                              if M else ndk_r)
-                    nk_v = jax.lax.pcast(nk_r, both, to="varying")
+                    nk_v = _pcast(nk_r, both, to="varying")
 
                     def one_chain(zc, ndkc, nwkc, nkc, keyc):
                         return _local_sweep(
@@ -267,7 +280,7 @@ class ShardedGibbsLDA:
                         nk_f, key_f[None, None])
 
             mp_spec = (M,) if M else ()
-            z, n_dk, n_wk, n_k, keys = jax.shard_map(
+            z, n_dk, n_wk, n_k, keys = _shard_map(
                 shard_fn, mesh=self.mesh,
                 in_specs=(P(D, *mp_spec), P(D), P(*mp_spec), P(),
                           P(D, *mp_spec), P(D, *mp_spec), P(D, *mp_spec),
@@ -291,9 +304,9 @@ class ShardedGibbsLDA:
             lda-c's likelihood.dat (SURVEY.md §5.4–5.5), without
             gathering θ or the corpus to the host."""
             def shard_fn(n_dk, n_wk, n_k, d, w, m):
-                n_k_v = jax.lax.pcast(n_k, both, to="varying")
+                n_k_v = _pcast(n_k, both, to="varying")
                 d0, w0, m0 = d[0, 0], w[0, 0], m[0, 0]
-                zero = jax.lax.pcast(jnp.float32(0), both, to="varying")
+                zero = _pcast(jnp.float32(0), both, to="varying")
 
                 def one_chain(ndkc, nwkc, nkc):
                     ndk = ndkc.astype(jnp.float32)
@@ -321,7 +334,7 @@ class ShardedGibbsLDA:
                 return jax.lax.psum(s, both), jax.lax.psum(t, both)
 
             mp_spec = (M,) if M else ()
-            s, t = jax.shard_map(
+            s, t = _shard_map(
                 shard_fn, mesh=self.mesh,
                 in_specs=(P(D), P(*mp_spec), P(),
                           P(D, *mp_spec), P(D, *mp_spec), P(D, *mp_spec)),

@@ -1,6 +1,6 @@
-"""On-device word creation + id mapping for the streaming flow path.
+"""On-device word creation + id mapping — the DEFAULT hot path.
 
-The 1B-event artifact's dominant pipeline stage is host-side word
+The 1B-event artifact's dominant pipeline stage was host-side word
 creation + trained-id mapping (`stream_words_map`, 48% of the round-3
 pipeline wall) — and this host exposes ONE CPU core, so the numpy path
 cannot be parallelized sideways. The TPU-first answer is to move the
@@ -10,6 +10,22 @@ device (~25 B/event) and ONE fused program does binning → word packing
 the winners ever come back. This renders SURVEY.md §2.1 #5's word
 creation (reference FlowWordCreation, a Spark executor map) as device
 compute on the VPU instead of a host preprocessing stage.
+
+As of round 6 this path is the DEFAULT for all three datatypes in both
+the scale runner's streaming stage and the SVI streaming scorer
+(`ONIX_HOST_WORDS=1` — or the legacy `ONIX_DEVICE_WORDS=0` — pins the
+host reference builders, kept as the cross-check arm the parity tests
+compare winners against). Two supporting pieces live here too:
+
+* **Double-buffered chunk staging** (`stage_*_cols` / STAGE_FNS):
+  `jax.device_put` returns with the H2D copy in flight, so the scale
+  runner stages chunk i+1's columns while chunk i's fused scan occupies
+  the compute units — transfer overlaps compute instead of serializing
+  with it.
+* **Hashed-vocabulary streaming buckets** (`*_stream_buckets`): the SVI
+  stream has no trained vocabulary, so the fused program ends in
+  splitmix64 bucketing (32-bit-limb arithmetic, bit-identical to the
+  host hash) instead of a vocab lookup.
 
 Why a compact key: the host path packs words into 43-bit int64 keys
 (words.FLOW_SPEC). JAX runs x64-disabled, so the device path re-encodes
@@ -38,6 +54,19 @@ import numpy as np
 from onix.models import scoring
 from onix.pipelines.words import (FLOW_SPEC, _PCLASS_HH, _PROTO_UNK,
                                   N_BINS_DEFAULT)
+
+def host_words_forced() -> bool:
+    """True when the env pins the HOST word builders. Device-resident
+    word creation is the default hot path in the scale and streaming
+    pipelines; `ONIX_HOST_WORDS=1` (or the legacy spelling
+    `ONIX_DEVICE_WORDS=0`) selects the host reference implementation —
+    kept as the cross-check arm the device-vs-host parity tests and
+    artifacts compare against."""
+    import os
+
+    return (os.environ.get("ONIX_HOST_WORDS") == "1"
+            or os.environ.get("ONIX_DEVICE_WORDS") == "0")
+
 
 # Compact-key layout (int32), LSB-first: pbin | bbin | hbin | proto |
 # pclass. Shifts must match between build() (host) and _pack() (device).
@@ -90,13 +119,11 @@ def build_flow_tables(bundle, edges: dict,
              | fields["pbin"]).astype(np.int64)
     assert key_c.max(initial=0) < 2 ** 31, "compact key overflows int32"
     order = np.argsort(key_c, kind="stable")
-    # Caller proto id -> compact code (same remap rule as
-    # flow_words_from_arrays: absent from the fitted table -> UNK).
-    names = np.asarray(proto_classes, dtype=object)
-    pos = np.searchsorted(table, names)
-    pos_c = np.clip(pos, 0, max(len(table) - 1, 0))
-    remap = np.where(len(table) and table[pos_c] == names,
-                     pos_c, _COMPACT_UNK).astype(np.int32)
+    # Caller proto id -> compact code (the shared remap rule: absent
+    # from the fitted table -> UNK).
+    from onix.pipelines.words import proto_remap_codes
+    remap = proto_remap_codes(table, proto_classes,
+                              _COMPACT_UNK).astype(np.int32)
     return FlowDeviceTables(
         word_key_c=jnp.asarray(key_c[order].astype(np.int32)),
         word_ids=jnp.asarray(
@@ -263,19 +290,31 @@ def _pad_pow2(a: np.ndarray) -> np.ndarray:
     return np.pad(a, (0, size - a.shape[0]))
 
 
-def dns_partial_keys(qnames: np.ndarray, edges: dict) -> np.ndarray:
-    """Per-UNIQUE compact partials (ebin|slbin|nlabels|tld at their
-    shifts) from the fitted edges — host side, O(uniques)."""
+def _dns_unique_bins(qnames: np.ndarray, edges: dict) -> dict:
+    """Per-UNIQUE qname word fields under the fitted edges — the ONE
+    string-feature pipeline shared by the trained-vocab compact
+    partials and the streaming full-spec partials (a drifted copy
+    would silently break host/device word identity)."""
     from onix.utils.features import digitize, qname_features
 
     qf = qname_features(qnames)
-    slbin = digitize(qf["sub_len"], edges["sub_len"]).astype(np.int64)
-    ebin = digitize(qf["sub_entropy"].astype(np.float64),
-                    edges["sub_entropy"]).astype(np.int64)
-    return (ebin << _DNS_EBIN_SHIFT
-            | slbin << _DNS_SLBIN_SHIFT
-            | qf["n_labels"] << _DNS_NLABELS_SHIFT
-            | qf["tld_ok"] << _DNS_TLD_SHIFT).astype(np.int32)
+    return {
+        "slbin": digitize(qf["sub_len"], edges["sub_len"]).astype(np.int64),
+        "ebin": digitize(qf["sub_entropy"].astype(np.float64),
+                         edges["sub_entropy"]).astype(np.int64),
+        "nlabels": qf["n_labels"],
+        "tld": qf["tld_ok"],
+    }
+
+
+def dns_partial_keys(qnames: np.ndarray, edges: dict) -> np.ndarray:
+    """Per-UNIQUE compact partials (ebin|slbin|nlabels|tld at their
+    shifts) from the fitted edges — host side, O(uniques)."""
+    b = _dns_unique_bins(qnames, edges)
+    return (b["ebin"] << _DNS_EBIN_SHIFT
+            | b["slbin"] << _DNS_SLBIN_SHIFT
+            | b["nlabels"] << _DNS_NLABELS_SHIFT
+            | b["tld"] << _DNS_TLD_SHIFT).astype(np.int32)
 
 
 @functools.partial(jax.jit, static_argnames=("v_x", "unseen_w", "unseen_d",
@@ -307,22 +346,94 @@ def _dns_stream_scan(tables: DnsDeviceTables, table_flat: jax.Array,
         merge_buffer=128)
 
 
+# ---------------------------------------------------------------------------
+# Chunk staging (double-buffered ingestion).
+#
+# `jax.device_put` returns immediately with the H2D copy in flight, so a
+# scale runner can stage chunk i+1's columns WHILE chunk i's fused scan
+# occupies the compute units — the transfer overlaps compute instead of
+# serializing with it (scale.py's double-buffered stream loop). Each
+# stage_* helper does the per-chunk HOST work too (dtype casts; for
+# dns/proxy the per-UNIQUE string partials), so once a staged dict
+# exists the stream_bottom_k call is pure device dispatch. Staged dicts
+# are marked with "_staged" and pass through the stream_bottom_k entry
+# points untouched; raw numpy column dicts still work (staged on the
+# spot) so existing callers and tests see one API.
+# ---------------------------------------------------------------------------
+
+
+def _put(a) -> jax.Array:
+    return jax.device_put(a)
+
+
+def stage_flow_cols(cols: dict) -> dict:
+    """Cast + async-transfer one flow chunk's raw columns (~25 B/event)."""
+    return {
+        "_staged": True,
+        "sip_u32": _put(np.asarray(cols["sip_u32"], np.uint32)),
+        "dip_u32": _put(np.asarray(cols["dip_u32"], np.uint32)),
+        "sport": _put(np.asarray(cols["sport"], np.int32)),
+        "dport": _put(np.asarray(cols["dport"], np.int32)),
+        "proto_id": _put(np.asarray(cols["proto_id"], np.int32)),
+        "hour": _put(np.asarray(cols["hour"], np.float32)),
+        "ibyt": _put(np.asarray(cols["ibyt"], np.float32)),
+        "ipkt": _put(np.asarray(cols["ipkt"], np.float32)),
+        "proto_classes": list(cols["proto_classes"]),
+    }
+
+
+def stage_dns_cols(cols: dict, edges: dict) -> dict:
+    """Host string features per UNIQUE qname, then async-transfer."""
+    return {
+        "_staged": True,
+        "partial_u": _put(_pad_pow2(dns_partial_keys(cols["qnames"],
+                                                     edges))),
+        "client_u32": _put(np.asarray(cols["client_u32"], np.uint32)),
+        "qname_codes": _put(np.asarray(cols["qname_codes"], np.int32)),
+        "qtype": _put(np.asarray(cols["qtype"], np.int32)),
+        "rcode": _put(np.asarray(cols["rcode"], np.int32)),
+        "frame_len": _put(np.asarray(cols["frame_len"], np.float32)),
+        "hour": _put(np.asarray(cols["hour"], np.float32)),
+    }
+
+
+def stage_proxy_cols(cols: dict, edges: dict) -> dict:
+    """Host string features per UNIQUE uri/host/agent, then transfer."""
+    uri_p, host_p, ua_p = proxy_partial_keys(
+        cols["uris"], cols["hosts"], cols["agents"], edges)
+    return {
+        "_staged": True,
+        "uri_p": _put(_pad_pow2(uri_p)),
+        "host_p": _put(_pad_pow2(host_p)),
+        "ua_p": _put(_pad_pow2(ua_p)),
+        "client_u32": _put(np.asarray(cols["client_u32"], np.uint32)),
+        "uri_codes": _put(np.asarray(cols["uri_codes"], np.int32)),
+        "host_codes": _put(np.asarray(cols["host_codes"], np.int32)),
+        "ua_codes": _put(np.asarray(cols["ua_codes"], np.int32)),
+        "respcode": _put(np.asarray(cols["respcode"], np.int32)),
+        "hour": _put(np.asarray(cols["hour"], np.float32)),
+    }
+
+
+STAGE_FNS = {"flow": lambda cols, edges: stage_flow_cols(cols),
+             "dns": stage_dns_cols,
+             "proxy": stage_proxy_cols}
+
+
 def dns_stream_bottom_k(tables: DnsDeviceTables, table_flat: jax.Array,
                         cols: dict, edges: dict, *, v_x: int, unseen_w: int,
                         unseen_d: int, tol: float, max_results: int,
                         chunk: int = 1 << 21) -> scoring.TopK:
     """Fused words→map→score→select for one streamed DNS chunk: string
     features run per unique name on the host, everything per-event on
-    the device."""
-    partial_u = jnp.asarray(_pad_pow2(dns_partial_keys(cols["qnames"], edges)))
+    the device. `cols` may be raw numpy columns or a stage_dns_cols
+    dict (double-buffered callers stage the next chunk early)."""
+    if not cols.get("_staged"):
+        cols = stage_dns_cols(cols, edges)
     return _dns_stream_scan(
-        tables, table_flat, partial_u,
-        jnp.asarray(cols["client_u32"]),
-        jnp.asarray(np.asarray(cols["qname_codes"], np.int32)),
-        jnp.asarray(np.asarray(cols["qtype"], np.int32)),
-        jnp.asarray(np.asarray(cols["rcode"], np.int32)),
-        jnp.asarray(np.asarray(cols["frame_len"], np.float32)),
-        jnp.asarray(np.asarray(cols["hour"], np.float32)),
+        tables, table_flat, cols["partial_u"], cols["client_u32"],
+        cols["qname_codes"], cols["qtype"], cols["rcode"],
+        cols["frame_len"], cols["hour"],
         v_x=v_x, unseen_w=unseen_w, unseen_d=unseen_d, tol=tol,
         max_results=max_results, chunk=chunk)
 
@@ -423,20 +534,284 @@ def proxy_stream_bottom_k(tables: ProxyDeviceTables, table_flat: jax.Array,
                           unseen_w: int, unseen_d: int, tol: float,
                           max_results: int,
                           chunk: int = 1 << 21) -> scoring.TopK:
-    """Fused words→map→score→select for one streamed proxy chunk."""
-    uri_p, host_p, ua_p = proxy_partial_keys(
-        cols["uris"], cols["hosts"], cols["agents"], edges)
+    """Fused words→map→score→select for one streamed proxy chunk.
+    `cols` may be raw numpy columns or a stage_proxy_cols dict."""
+    if not cols.get("_staged"):
+        cols = stage_proxy_cols(cols, edges)
     return _proxy_stream_scan(
-        tables, table_flat, jnp.asarray(_pad_pow2(uri_p)),
-        jnp.asarray(_pad_pow2(host_p)), jnp.asarray(_pad_pow2(ua_p)),
-        jnp.asarray(cols["client_u32"]),
-        jnp.asarray(np.asarray(cols["uri_codes"], np.int32)),
-        jnp.asarray(np.asarray(cols["host_codes"], np.int32)),
-        jnp.asarray(np.asarray(cols["ua_codes"], np.int32)),
-        jnp.asarray(np.asarray(cols["respcode"], np.int32)),
-        jnp.asarray(np.asarray(cols["hour"], np.float32)),
+        tables, table_flat, cols["uri_p"], cols["host_p"], cols["ua_p"],
+        cols["client_u32"], cols["uri_codes"], cols["host_codes"],
+        cols["ua_codes"], cols["respcode"], cols["hour"],
         v_x=v_x, unseen_w=unseen_w, unseen_d=unseen_d, tol=tol,
         max_results=max_results, chunk=chunk)
+
+
+# ---------------------------------------------------------------------------
+# Hashed-vocabulary streaming path (onix/pipelines/streaming.py).
+#
+# The SVI stream has no trained vocabulary to look keys up in — words
+# hash into a fixed bucket space (streaming.py `_bucket_of_keys`:
+# splitmix64 over the packed int64 `word_key`, mod n_buckets). The
+# device rendering below computes the SAME buckets on-chip: binning →
+# full-spec int64 key packing (as two uint32 limbs — x64 stays
+# disabled) → splitmix64 in 32-bit limb arithmetic → low-bits mod for
+# power-of-two bucket counts. Bucket identity is therefore preserved
+# EXACTLY against the host path given identical bin indices; the one
+# divergence source is the f32-vs-f64 bin-edge comparison documented in
+# the module docstring (~1e-7/event). Per-UNIQUE string features
+# (dns/proxy) stay host-side, pre-packed into int64 partial keys whose
+# uint32 halves the device gathers through the dictionary codes.
+# ---------------------------------------------------------------------------
+
+_SM64_C1 = 0x9E3779B97F4A7C15
+_SM64_C2 = 0xBF58476D1CE4E5B9
+_SM64_C3 = 0x94D049BB133111EB
+
+
+def _u32(x: int) -> "jnp.ndarray":
+    return jnp.uint32(x & 0xFFFFFFFF)
+
+
+def _shr64(hi, lo, s: int):
+    """(hi, lo) >> s for static 0 < s < 32."""
+    return hi >> s, (lo >> s) | (hi << (32 - s))
+
+
+def _mul64(ah, al, b: int):
+    """Low 64 bits of (ah, al) * constant b, in uint32 limbs (16-bit
+    partial products for the 32x32→64 low half; upper cross terms wrap
+    into hi, exactly like uint64 multiplication)."""
+    bh, bl = _u32(b >> 32), _u32(b)
+    a0 = al & _u32(0xFFFF)
+    a1 = al >> 16
+    b0 = bl & _u32(0xFFFF)
+    b1 = bl >> 16
+    p00 = a0 * b0
+    p01 = a0 * b1
+    p10 = a1 * b0
+    mid = (p01 & _u32(0xFFFF)) + (p10 & _u32(0xFFFF)) + (p00 >> 16)
+    lo = (p00 & _u32(0xFFFF)) | ((mid & _u32(0xFFFF)) << 16)
+    hi = (a1 * b1 + (p01 >> 16) + (p10 >> 16) + (mid >> 16)
+          + al * bh + ah * bl)
+    return hi, lo
+
+
+def _splitmix64_bucket(hi, lo, salt: int, n_buckets: int):
+    """splitmix64(key ^ salt) % n_buckets on (hi, lo) uint32 limbs —
+    bit-identical to streaming._bucket_of_keys for power-of-two
+    n_buckets (the mod is the low bits of the finalized value)."""
+    hi = hi ^ _u32(salt >> 32)
+    lo = lo ^ _u32(salt)
+    lo2 = lo + _u32(_SM64_C1)
+    hi = hi + _u32(_SM64_C1 >> 32) + (lo2 < lo).astype(jnp.uint32)
+    lo = lo2
+    sh, sl = _shr64(hi, lo, 30)
+    hi, lo = hi ^ sh, lo ^ sl
+    hi, lo = _mul64(hi, lo, _SM64_C2)
+    sh, sl = _shr64(hi, lo, 27)
+    hi, lo = hi ^ sh, lo ^ sl
+    hi, lo = _mul64(hi, lo, _SM64_C3)
+    sh, sl = _shr64(hi, lo, 31)
+    hi, lo = hi ^ sh, lo ^ sl
+    return (lo & _u32(n_buckets - 1)).astype(jnp.int32)
+
+
+def _pack64(spec, vals: dict):
+    """Device twin of WordSpec.pack: field values → packed int64 key as
+    (hi, lo) uint32 limbs. Shifts/masks are Python ints (static), so
+    each field contributes one or two OR terms — no 64-bit ops."""
+    hi = lo = None
+    shift = 0
+    for name, bits in spec.fields:
+        v = vals[name].astype(jnp.uint32) & _u32((1 << bits) - 1)
+        parts_lo = []
+        parts_hi = []
+        if shift < 32:
+            parts_lo.append(v << shift if shift else v)
+            if shift + bits > 32:
+                parts_hi.append(v >> (32 - shift))
+        else:
+            parts_hi.append(v << (shift - 32) if shift > 32 else v)
+        for p in parts_lo:
+            lo = p if lo is None else lo | p
+        for p in parts_hi:
+            hi = p if hi is None else hi | p
+        shift += bits
+    zero = jnp.zeros_like(lo if lo is not None else hi)
+    return (zero if hi is None else hi), (zero if lo is None else lo)
+
+
+def _partial_halves(partial: np.ndarray):
+    """Host int64 partial keys → (hi, lo) uint32 arrays, pow2-padded."""
+    p = _pad_pow2(np.asarray(partial, np.int64))
+    return (p >> 32).astype(np.uint32), (p & 0xFFFFFFFF).astype(np.uint32)
+
+
+class FlowStreamTables(NamedTuple):
+    hour_edges: jax.Array     # f32 — frozen fitted edges (f32 caveat)
+    byt_edges: jax.Array
+    pkt_edges: jax.Array
+    proto_remap: jax.Array    # int32 [n_caller_protos] -> fitted id / UNK
+
+
+def build_flow_stream_tables(edges: dict,
+                             proto_classes: list[str]) -> FlowStreamTables:
+    """Frozen-edge tables for the hashed streaming path. The proto
+    remap keys on the CALLER's per-batch proto order (same rule as
+    flow_words_from_arrays: absent from the fitted table -> UNK), so it
+    is rebuilt per batch — O(#protos), trivially cheap."""
+    from onix.pipelines.words import _PROTO_UNK, proto_remap_codes
+
+    remap = proto_remap_codes(edges["proto_classes"], proto_classes,
+                              _PROTO_UNK).astype(np.int32)
+    return FlowStreamTables(
+        hour_edges=_edges1d(edges, "hour"),
+        byt_edges=_edges1d(edges, "log_ibyt"),
+        pkt_edges=_edges1d(edges, "log_ipkt"),
+        proto_remap=jnp.asarray(remap))
+
+
+@functools.partial(jax.jit, static_argnames=("salt", "n_buckets"))
+def flow_stream_buckets(t: FlowStreamTables, sport, dport, proto, hour,
+                        byt, pkt, *, salt: int,
+                        n_buckets: int) -> jax.Array:
+    """Per-event word bucket ids [n] for one flow minibatch — binning,
+    FLOW_SPEC packing, and splitmix64 bucketing in one program. Both
+    tokens of a flow event (src-doc, dst-doc) carry the same word, so
+    one bucket per event covers the [src|dst] token layout."""
+    sport = sport.astype(jnp.int32)
+    dport = dport.astype(jnp.int32)
+    s_low = sport <= 1024
+    d_low = dport <= 1024
+    pclass = jnp.where(
+        s_low & d_low, jnp.minimum(sport, dport),
+        jnp.where(s_low, sport,
+                  jnp.where(d_low, dport, jnp.int32(_PCLASS_HH))))
+    hi, lo = _pack64(FLOW_SPEC, {
+        "pbin": jnp.searchsorted(t.pkt_edges, jnp.log1p(pkt),
+                                 side="right").astype(jnp.uint32),
+        "bbin": jnp.searchsorted(t.byt_edges, jnp.log1p(byt),
+                                 side="right").astype(jnp.uint32),
+        "hbin": jnp.searchsorted(t.hour_edges, hour,
+                                 side="right").astype(jnp.uint32),
+        "pclass": pclass.astype(jnp.uint32),
+        "proto": t.proto_remap[proto.astype(jnp.int32)].astype(jnp.uint32),
+    })
+    return _splitmix64_bucket(hi, lo, salt, n_buckets)
+
+
+class DnsStreamTables(NamedTuple):
+    hour_edges: jax.Array
+    flen_edges: jax.Array
+    partial_hi: jax.Array     # uint32 [U] per-unique-qname key partials
+    partial_lo: jax.Array
+
+
+def build_dns_stream_tables(edges: dict, qnames: np.ndarray) -> DnsStreamTables:
+    """Frozen edges + per-UNIQUE qname partial keys (tld, nlabels,
+    ebin, slbin at their DNS_SPEC shifts) — host string work is
+    O(uniques), as in the trained-vocab dns path."""
+    from onix.pipelines.words import DNS_SPEC
+
+    b = _dns_unique_bins(qnames, edges)
+    sh = DNS_SPEC.shifts()
+    bits = dict(DNS_SPEC.fields)
+    partial = np.zeros(len(qnames), np.int64)
+    for name in ("tld", "nlabels", "ebin", "slbin"):
+        # Same bit masking as WordSpec.pack, same shifts by definition.
+        partial |= (b[name] & ((1 << bits[name]) - 1)) << sh[name]
+    hi, lo = _partial_halves(partial)
+    return DnsStreamTables(
+        hour_edges=_edges1d(edges, "hour"),
+        flen_edges=_edges1d(edges, "frame_len"),
+        partial_hi=jnp.asarray(hi), partial_lo=jnp.asarray(lo))
+
+
+@functools.partial(jax.jit, static_argnames=("salt", "n_buckets"))
+def dns_stream_buckets(t: DnsStreamTables, codes, qtype, rcode, flen,
+                       hour, *, salt: int, n_buckets: int) -> jax.Array:
+    from onix.pipelines.words import DNS_SPEC
+
+    hi, lo = _pack64(DNS_SPEC, {
+        "tld": jnp.zeros_like(codes).astype(jnp.uint32),
+        "rcode": rcode.astype(jnp.uint32),
+        "qtype": qtype.astype(jnp.uint32),
+        "nlabels": jnp.zeros_like(codes).astype(jnp.uint32),
+        "ebin": jnp.zeros_like(codes).astype(jnp.uint32),
+        "slbin": jnp.zeros_like(codes).astype(jnp.uint32),
+        "hbin": jnp.searchsorted(t.hour_edges, hour,
+                                 side="right").astype(jnp.uint32),
+        "flbin": jnp.searchsorted(t.flen_edges, flen,
+                                  side="right").astype(jnp.uint32),
+    })
+    c = codes.astype(jnp.int32)
+    hi = hi | t.partial_hi[c]
+    lo = lo | t.partial_lo[c]
+    return _splitmix64_bucket(hi, lo, salt, n_buckets)
+
+
+class ProxyStreamTables(NamedTuple):
+    hour_edges: jax.Array
+    uri_hi: jax.Array         # uint32 [Uu] per-unique-URI partials
+    uri_lo: jax.Array
+    host_hi: jax.Array        # uint32 [Uh]
+    host_lo: jax.Array
+    ua_hi: jax.Array          # uint32 [Ua]
+    ua_lo: jax.Array
+
+
+def build_proxy_stream_tables(edges: dict, uris: np.ndarray,
+                              hosts: np.ndarray,
+                              agents: np.ndarray) -> ProxyStreamTables:
+    from onix.pipelines.words import (_IP_RE, _UA_RARE, _categorical,
+                                      PROXY_SPEC)
+    from onix.utils.features import digitize, entropy_array
+
+    shift = PROXY_SPEC.shifts()
+    uri_len = np.fromiter((len(str(u)) for u in uris), np.float64,
+                          len(uris))
+    ulbin = digitize(uri_len, edges["uri_len"]).astype(np.int64)
+    uebin = digitize(entropy_array(uris).astype(np.float64),
+                     edges["uri_entropy"]).astype(np.int64)
+    uri_p = ((uebin & 63) << shift["uebin"]
+             | (ulbin & 63) << shift["ulbin"])
+    host_p = (np.fromiter((int(bool(_IP_RE.match(str(h)))) for h in hosts),
+                          np.int64, len(hosts)) << shift["hostip"])
+    ua = _categorical(np.asarray(agents, dtype=object), "ua_common", edges,
+                      _UA_RARE)
+    ua_p = (ua & 1023) << shift["ua"]
+    uh, ul = _partial_halves(uri_p)
+    hh, hl = _partial_halves(host_p)
+    ah, al = _partial_halves(ua_p)
+    return ProxyStreamTables(
+        hour_edges=_edges1d(edges, "hour"),
+        uri_hi=jnp.asarray(uh), uri_lo=jnp.asarray(ul),
+        host_hi=jnp.asarray(hh), host_lo=jnp.asarray(hl),
+        ua_hi=jnp.asarray(ah), ua_lo=jnp.asarray(al))
+
+
+@functools.partial(jax.jit, static_argnames=("salt", "n_buckets"))
+def proxy_stream_buckets(t: ProxyStreamTables, uri_c, host_c, ua_c,
+                         respcode, hour, *, salt: int,
+                         n_buckets: int) -> jax.Array:
+    from onix.pipelines.words import PROXY_SPEC
+
+    rc = respcode.astype(jnp.int32)
+    hi, lo = _pack64(PROXY_SPEC, {
+        "hbin": jnp.searchsorted(t.hour_edges, hour,
+                                 side="right").astype(jnp.uint32),
+        "uebin": jnp.zeros_like(rc).astype(jnp.uint32),
+        "ulbin": jnp.zeros_like(rc).astype(jnp.uint32),
+        "hostip": jnp.zeros_like(rc).astype(jnp.uint32),
+        "ua": jnp.zeros_like(rc).astype(jnp.uint32),
+        "cclass": (rc // 100).astype(jnp.uint32),
+    })
+    u = uri_c.astype(jnp.int32)
+    h = host_c.astype(jnp.int32)
+    a = ua_c.astype(jnp.int32)
+    hi = hi | t.uri_hi[u] | t.host_hi[h] | t.ua_hi[a]
+    lo = lo | t.uri_lo[u] | t.host_lo[h] | t.ua_lo[a]
+    return _splitmix64_bucket(hi, lo, salt, n_buckets)
 
 
 def flow_stream_bottom_k(
@@ -455,16 +830,13 @@ def flow_stream_bottom_k(
     entirely on device: eight raw columns go up, `max_results` winners
     come back. Selection runs through the shared exact scan
     (scoring._scan_bottom_k), so tie rules, padding semantics, and the
-    two-phase merge match every other selection entry point."""
+    two-phase merge match every other selection entry point. `cols`
+    may be raw numpy columns or a stage_flow_cols dict."""
+    if not cols.get("_staged"):
+        cols = stage_flow_cols(cols)
     return _flow_stream_scan(
         tables, table_flat,
-        jnp.asarray(cols["sip_u32"]),
-        jnp.asarray(cols["dip_u32"]),
-        jnp.asarray(np.asarray(cols["sport"], np.int32)),
-        jnp.asarray(np.asarray(cols["dport"], np.int32)),
-        jnp.asarray(np.asarray(cols["proto_id"], np.int32)),
-        jnp.asarray(np.asarray(cols["hour"], np.float32)),
-        jnp.asarray(np.asarray(cols["ibyt"], np.float32)),
-        jnp.asarray(np.asarray(cols["ipkt"], np.float32)),
+        cols["sip_u32"], cols["dip_u32"], cols["sport"], cols["dport"],
+        cols["proto_id"], cols["hour"], cols["ibyt"], cols["ipkt"],
         v_x=v_x, unseen_w=unseen_w, unseen_d=unseen_d, tol=tol,
         max_results=max_results, chunk=chunk)

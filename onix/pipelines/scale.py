@@ -28,6 +28,7 @@ import time
 import numpy as np
 
 from onix.config import LDAConfig
+from onix.pipelines.device_words import host_words_forced
 from onix.pipelines.corpus_build import build_corpus, select_suspicious_events
 from onix.pipelines.synth import SYNTH_ARRAYS
 from onix.pipelines.words import (dns_words_from_arrays,
@@ -112,7 +113,7 @@ def run_scale(n_events: int, n_hosts: int | None = None,
             "n_sweeps": n_sweeps, "n_topics": n_topics, "seed": seed,
             "datatype": datatype, "n_chains": n_chains,
             "max_results": max_results, "generator": generator,
-            "device_words": os.environ.get("ONIX_DEVICE_WORDS", "0"),
+            "words_mode": "host" if host_words_forced() else "device",
         })
         meta = ckpt.load("meta")
         if meta is not None:
@@ -390,15 +391,16 @@ def _stream_score(bundle, fitted_edges, theta, phi_wk, *, n_events: int,
 
     unseen_w = v_x - 1
     unseen_d = d_x - 1
-    # On-device word creation: the raw numeric/dictionary columns ship
-    # to the chip and ONE fused program does binning→packing→trained-id
+    # On-device word creation — the DEFAULT hot path for all three
+    # datatypes: the raw numeric/dictionary columns ship to the chip
+    # and ONE fused program does binning→packing→trained-id
     # lookup→score→bottom-k — stream_words_map collapses into
     # stream_score (string features stay host-side per UNIQUE value for
-    # dns/proxy). Opt-in (ONIX_DEVICE_WORDS=1) because the host is the
-    # reference implementation; device_words.py documents the f32
-    # bin-edge caveat and the compact-key range gates (a trained vocab
-    # outside the ranges raises at table build → host path).
-    device_words = os.environ.get("ONIX_DEVICE_WORDS", "0") == "1"
+    # dns/proxy). The host builders remain behind ONIX_HOST_WORDS=1 as
+    # the cross-check arm; device_words.py documents the f32 bin-edge
+    # caveat and the compact-key range gates (a trained vocab outside
+    # the ranges raises at table build → host path, announced).
+    device_words = not host_words_forced()
     # Flow tables are built lazily from the FIRST streamed chunk, whose
     # cols["proto_classes"] is the caller proto-id order the device
     # remap must key on (the fitted table is sorted — a different
@@ -435,9 +437,6 @@ def _stream_score(bundle, fitted_edges, theta, phi_wk, *, n_events: int,
     # stream_words_map is the real pipeline work (word creation +
     # trained-id mapping) and joins the pipeline-only rate.
     walls["stream_synth"] = 0.0
-    # setdefault: the dns/proxy device-table build above already
-    # accumulated its re-encode time here.
-    walls.setdefault("stream_words_map", 0.0)
     walls["stream_score"] = 0.0
     offset = 0
     c = 0
@@ -470,78 +469,146 @@ def _stream_score(bundle, fitted_edges, theta, phi_wk, *, n_events: int,
         if save_meta is not None:
             save_meta()
 
+    if device_words:
+        from onix.pipelines import device_words as dw
+
+    def _synth_chunk(ci: int, mi: int) -> dict:
+        t0 = time.monotonic()
+        cc = gen_arrays[datatype](mi, n_hosts=n_hosts,
+                                  n_anomalies=anomalies_per_chunk,
+                                  seed=seed + 1000 * ci)
+        walls["stream_synth"] += time.monotonic() - t0
+        return cc
+
+    def _stage_cols(cc: dict):
+        """START one synthesized chunk's host→device transfer
+        (device_put returns with the copy in flight — device_words
+        staging block comment). Raises ValueError when the trained
+        bundle cannot ride the compact keys (flow table build gates)."""
+        nonlocal dev_tables
+        t0 = time.monotonic()
+        if dev_tables is None:      # flow: keyed on the caller proto order
+            dev_tables = dw.build_flow_tables(
+                bundle, fitted_edges, list(cc["proto_classes"]))
+        staged = dw.STAGE_FNS[datatype](cc, fitted_edges)
+        walls["stream_words_map"] += time.monotonic() - t0
+        return staged
+
+    def _stage_chunk(ci: int, mi: int):
+        """Synthesize chunk ci and stage it. Returns (staged cols,
+        planted event ids); the planted merge is deferred until the
+        chunk actually processes so a resume never inherits plants
+        from a chunk that was only ever prefetched."""
+        cc = _synth_chunk(ci, mi)
+        return _stage_cols(cc), set((cc["anomaly_idx"] + ci * chunk_events)
+                                    .tolist())
+
+    def _host_idx(cols: dict) -> np.ndarray:
+        """Host mapping: the reference word builders + searchsorted id
+        maps into the TRAINED id spaces; unknowns go to the UNSEEN
+        rows. No per-chunk unique sort: at 2x10^8 tokens/chunk the old
+        unique-then-map path spent most of the 1B run's wall in these
+        sorts (docs/SCALE_1B_r02.json)."""
+        t0 = time.monotonic()
+        wt = _words_from_cols(datatype, cols, edges=fitted_edges)
+        wid = bundle.word_ids_packed(wt.word_key, fill=unseen_w)
+        did = bundle.doc_ids_u32(wt.ip_u32, fill=unseen_d)
+        out = did * np.int32(v_x) + wid
+        walls["stream_words_map"] += time.monotonic() - t0
+        return out
+
+    def _fused_bottom_k(staged):
+        if datatype == "flow":
+            return dw.flow_stream_bottom_k(
+                dev_tables, table, staged, v_x=v_x, unseen_w=unseen_w,
+                unseen_d=unseen_d, tol=1.0, max_results=max_results)
+        if datatype == "dns":
+            return dw.dns_stream_bottom_k(
+                dev_tables, table, staged, fitted_edges, v_x=v_x,
+                unseen_w=unseen_w, unseen_d=unseen_d, tol=1.0,
+                max_results=max_results)
+        return dw.proxy_stream_bottom_k(
+            dev_tables, table, staged, fitted_edges, v_x=v_x,
+            unseen_w=unseen_w, unseen_d=unseen_d, tol=1.0,
+            max_results=max_results)
+
+    prefetched = None      # (chunk index, staged cols, planted ids)
     while offset < n_events:
         m = min(chunk_events, n_events - offset)
-        t = time.monotonic()
+        top = None         # set by the fused device arm only
         if c == 0:
             # Chunk 0 is the training window — its corpus is already
             # mapped; reuse the integer ids directly.
             # int32 throughout: the extended table is capped at 2^27
             # elements, so every flat index fits with room to spare —
             # int64 temporaries would double the chunk's memory.
+            t = time.monotonic()
             d_ids = bundle.corpus.doc_ids[:bundle.n_real_tokens]
             w_ids = bundle.corpus.word_ids[:bundle.n_real_tokens]
             idx = (d_ids.astype(np.int32) * np.int32(v_x)
                    + w_ids.astype(np.int32))
+            walls["stream_words_map"] += time.monotonic() - t
+        elif device_words:
+            # Double-buffered device path: the raw columns ARE the
+            # input — words+map+score+select run as one fused program
+            # inside stream_score; stream_words_map holds only the
+            # once-per-run O(V+D) table re-encode plus per-chunk
+            # staging casts. While THIS chunk's scan occupies the
+            # device, the NEXT chunk is synthesized and its transfer
+            # started, so H2D copy overlaps compute instead of
+            # serializing with it.
+            staged = None
+            if prefetched is not None and prefetched[0] == c:
+                staged, planted_c = prefetched[1], prefetched[2]
+            else:                      # first streamed chunk / resume
+                cc = _synth_chunk(c, m)
+                planted_c = set((cc["anomaly_idx"] + c * chunk_events)
+                                .tolist())
+                try:
+                    staged = _stage_cols(cc)
+                except ValueError as e:
+                    # Same degrade rule as the dns/proxy upfront table
+                    # build: a trained vocabulary outside the compact-
+                    # key ranges rides the host path for the rest of
+                    # the run, announced — the default path degrades,
+                    # it does not crash mid-stream.
+                    print(f"device words unavailable ({e}); "
+                          "using the host path")
+                    device_words = False
+                    info["words_mode"] = "host"
+                    idx = _host_idx(cc)
+                del cc
+            prefetched = None
+            planted.update(planted_c)
+            if staged is not None:
+                t = time.monotonic()
+                top = _fused_bottom_k(staged)     # async dispatch
+                walls["stream_score"] += time.monotonic() - t
+                del staged
+                if offset + m < n_events:
+                    prefetched = (c + 1, *_stage_chunk(
+                        c + 1, min(chunk_events, n_events - offset - m)))
+                idx = None
         else:
-            cols = gen_arrays[datatype](
-                m, n_hosts=n_hosts, n_anomalies=anomalies_per_chunk,
-                seed=seed + 1000 * c)
+            # Host cross-check arm (ONIX_HOST_WORDS=1): the reference
+            # word builders + searchsorted id maps.
+            cols = _synth_chunk(c, m)
             planted.update((cols["anomaly_idx"] + offset).tolist())
-            walls["stream_synth"] += time.monotonic() - t
-            t = time.monotonic()
-            if device_words:
-                # Device words path: the raw columns ARE the input —
-                # words+map+score+select run as one program inside
-                # stream_score; stream_words_map holds only the
-                # once-per-run O(V+D) table re-encode.
-                from onix.pipelines import device_words as dw
-                if dev_tables is None:
-                    dev_tables = dw.build_flow_tables(
-                        bundle, fitted_edges,
-                        list(cols["proto_classes"]))
-            else:
-                wt = _words_from_cols(datatype, cols, edges=fitted_edges)
-                # Map packed keys / IPs into the TRAINED id spaces with
-                # one searchsorted per column against the bundle's tiny
-                # sorted tables; unknowns go to the UNSEEN rows. No
-                # per-chunk unique sort: at 2x10^8 tokens/chunk the old
-                # unique-then-map path spent most of the 1B run's wall
-                # in these sorts (docs/SCALE_1B_r02.json).
-                wid = bundle.word_ids_packed(wt.word_key, fill=unseen_w)
-                did = bundle.doc_ids_u32(wt.ip_u32, fill=unseen_d)
-                idx = did * np.int32(v_x) + wid
-                del wt, wid, did, cols
-        walls["stream_words_map"] += time.monotonic() - t
+            idx = _host_idx(cols)
+            del cols
 
         t = time.monotonic()
-        if c > 0 and device_words:
-            if datatype == "flow":
-                top = dw.flow_stream_bottom_k(
-                    dev_tables, table, cols, v_x=v_x, unseen_w=unseen_w,
-                    unseen_d=unseen_d, tol=1.0, max_results=max_results)
-            elif datatype == "dns":
-                top = dw.dns_stream_bottom_k(
-                    dev_tables, table, cols, fitted_edges, v_x=v_x,
-                    unseen_w=unseen_w, unseen_d=unseen_d, tol=1.0,
-                    max_results=max_results)
-            else:
-                top = dw.proxy_stream_bottom_k(
-                    dev_tables, table, cols, fitted_edges, v_x=v_x,
-                    unseen_w=unseen_w, unseen_d=unseen_d, tol=1.0,
-                    max_results=max_results)
-            del cols
-        elif datatype == "flow":   # [src|dst] halves: fused pair-min path
-            top = scoring.table_pair_bottom_k_fast(
-                table, jnp.asarray(idx[:m]), jnp.asarray(idx[m:]), table_b,
-                tol=1.0, max_results=max_results)
+        if top is None:
+            if datatype == "flow":  # [src|dst] halves: fused pair-min path
+                top = scoring.table_pair_bottom_k_fast(
+                    table, jnp.asarray(idx[:m]), jnp.asarray(idx[m:]),
+                    table_b, tol=1.0, max_results=max_results)
+            else:                   # one client-IP token per event
+                top = scoring.table_bottom_k_fast(
+                    table, jnp.asarray(idx), table_b,
+                    tol=1.0, max_results=max_results)
             idx = None
-        else:                    # one client-IP token per event
-            top = scoring.table_bottom_k_fast(
-                table, jnp.asarray(idx), table_b,
-                tol=1.0, max_results=max_results)
-            idx = None
-        ti = np.asarray(top.indices)
+        ti = np.asarray(top.indices)       # blocks on the fused scan
         ts = np.asarray(top.scores)
         keep = ti >= 0
         all_idx.append(ti[keep] + offset)

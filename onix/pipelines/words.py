@@ -65,6 +65,16 @@ class WordSpec:
         assert shift < 63, "word key overflows int64"
         return out
 
+    def shifts(self) -> dict[str, int]:
+        """Field name -> LSB shift, derived from the layout — the one
+        source of truth pack/unpack and the device packers share."""
+        out = {}
+        at = 0
+        for name, bits in self.fields:
+            out[name] = at
+            at += bits
+        return out
+
     def unpack(self, keys: np.ndarray) -> dict[str, np.ndarray]:
         keys = np.asarray(keys, np.int64)
         out = {}
@@ -226,6 +236,21 @@ def _factorize(values: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
     return codes.astype(np.int64), np.asarray(uniques, dtype=object)
 
 
+def proto_remap_codes(fitted_table, caller_names, unk_code: int) -> np.ndarray:
+    """Caller proto-id order -> fitted-table codes; names absent from
+    the fitted table (apply mode with new protocols) get `unk_code`,
+    never a silent wrong class. ONE implementation shared by the host
+    builder and both device paths (trained-vocab compact tables and the
+    streaming hash tables) — the cross-check parity tests rely on these
+    never diverging."""
+    table = np.asarray(fitted_table, dtype=object)
+    names = np.asarray(caller_names, dtype=object)
+    pos = np.searchsorted(table, names)
+    pos_c = np.clip(pos, 0, max(len(table) - 1, 0))
+    return np.where(len(table) and table[pos_c] == names,
+                    pos_c, unk_code).astype(np.int64)
+
+
 def _categorical(values: np.ndarray, name: str, edges: dict,
                  unk_code: int) -> np.ndarray:
     """Map strings to ids via a fitted sorted table; unseen -> unk_code."""
@@ -278,16 +303,10 @@ def flow_words_from_arrays(
     (days with IPv6/non-canonical addresses, IP_TAG encoding)."""
     edges = dict(edges) if edges else {}
     edges.setdefault("proto_classes", sorted(proto_classes))
-    # proto_id refers to caller order; remap to the sorted fitted table,
-    # sending names absent from the fitted table (apply mode with new
-    # protocols) to the UNK code — same contract as the string path's
-    # _categorical, never a silent wrong class.
-    table = np.asarray(edges["proto_classes"], dtype=object)
-    names = np.asarray(proto_classes, dtype=object)
-    pos = np.searchsorted(table, names)
-    pos_c = np.clip(pos, 0, max(len(table) - 1, 0))
-    remap = np.where(len(table) and table[pos_c] == names,
-                     pos_c, _PROTO_UNK).astype(np.int64)
+    # proto_id refers to caller order; remap to the sorted fitted table
+    # (same contract as the string path's _categorical).
+    remap = proto_remap_codes(edges["proto_classes"], proto_classes,
+                              _PROTO_UNK)
     u64 = sip_u64 is not None
     if u64 == (sip_u32 is not None):
         raise ValueError("need exactly one of sip_u32/dip_u32 or "

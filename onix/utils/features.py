@@ -118,8 +118,11 @@ def quantile_edges(values: np.ndarray, n_bins: int,
     """
     if n_bins < 1:
         raise ValueError("n_bins must be >= 1")
-    qs = np.concatenate([np.linspace(0.0, 1.0, n_bins + 1)[1:-1],
-                         np.asarray(tail_qs, np.float64)])
+    # Sorted: above ~100 bins the interior quantiles pass the 0.99/0.999
+    # tail cut points, and unsorted qs return unsorted edges — searchsorted
+    # (digitize) then silently misbins everything past the inversion.
+    qs = np.sort(np.concatenate([np.linspace(0.0, 1.0, n_bins + 1)[1:-1],
+                                 np.asarray(tail_qs, np.float64)]))
     values = _edge_sample(values)
     if values.size == 0:
         return np.zeros(len(qs), dtype=np.float64)

@@ -123,6 +123,11 @@ def main() -> int:
         "n_events_total": n_total,
         "device": str(jax.devices()[0]),
         "events_per_second_pipeline_only": round(n_total / pipe_wall, 1),
+        # Which word path each batch rode: "device" = fused on-device
+        # binning+packing+bucketing with the deduped weighted E-step
+        # (the default), "host" = the reference builders
+        # (ONIX_HOST_WORDS=1 forces it — the cross-check arm).
+        "words_mode_batches": dict(scorer.words_mode_batches),
         "pipeline_stage_walls_seconds": {
             k: round(v, 2) for k, v in scorer.stage_walls.items()},
         "walls_seconds": {"synthesize": round(synth_wall, 2),

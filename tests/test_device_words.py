@@ -224,3 +224,134 @@ def test_scale_runner_device_words_dns_proxy(tmp_path, datatype,
             == res["1"]["planted_in_bottom_k"])
     assert (res["0"]["selected_score_range"]
             == res["1"]["selected_score_range"])
+
+
+def test_host_words_env_spellings(tmp_path, monkeypatch):
+    """Device words are the DEFAULT; ONIX_HOST_WORDS=1 (and the legacy
+    ONIX_DEVICE_WORDS=0) pin the host cross-check arm."""
+    from onix.pipelines import scale
+
+    monkeypatch.delenv("ONIX_DEVICE_WORDS", raising=False)
+    monkeypatch.delenv("ONIX_HOST_WORDS", raising=False)
+    m = scale.run_scale(20_000, train_events=10_000, n_sweeps=6, seed=5)
+    assert m["words_mode"] == "device"
+    monkeypatch.setenv("ONIX_HOST_WORDS", "1")
+    m = scale.run_scale(20_000, train_events=10_000, n_sweeps=6, seed=5)
+    assert m["words_mode"] == "host"
+
+
+def test_staged_cols_match_raw_cols_path():
+    """Double-buffered staging (stage_flow_cols + device_put in flight)
+    must select exactly the winners the raw-numpy-cols call does."""
+    cols, wt, bundle = _trained(n=8_000, n_hosts=150)
+    rng = np.random.default_rng(4)
+    v = bundle.corpus.n_vocab
+    d = bundle.corpus.n_docs
+    v_x, unseen_w, unseen_d = v + 1, v, d
+    table = jnp.asarray(rng.random((d + 1) * v_x).astype(np.float32))
+    tables = dw.build_flow_tables(bundle, wt.edges,
+                                  list(cols["proto_classes"]))
+    cols2 = SYNTH_ARRAYS["flow"](6_000, n_hosts=150, n_anomalies=10,
+                                 seed=31)
+    raw = dw.flow_stream_bottom_k(
+        tables, table, cols2, v_x=v_x, unseen_w=unseen_w,
+        unseen_d=unseen_d, tol=1.0, max_results=100)
+    staged = dw.flow_stream_bottom_k(
+        tables, table, dw.stage_flow_cols(cols2), v_x=v_x,
+        unseen_w=unseen_w, unseen_d=unseen_d, tol=1.0, max_results=100)
+    np.testing.assert_array_equal(np.asarray(staged.indices),
+                                  np.asarray(raw.indices))
+    np.testing.assert_array_equal(np.asarray(staged.scores),
+                                  np.asarray(raw.scores))
+
+
+def test_device_splitmix64_matches_host_hash():
+    """The 32-bit-limb splitmix64 (streaming bucket path) is
+    bit-identical to streaming._bucket_of_keys on the full int64 key
+    range every word spec can emit."""
+    import functools
+
+    import jax
+
+    from onix.pipelines.streaming import _bucket_of_keys, _datatype_salt
+
+    rng = np.random.default_rng(0)
+    keys = rng.integers(0, 1 << 52, 50_000).astype(np.int64)
+    for dt in ("flow", "dns", "proxy"):
+        salt = _datatype_salt(dt)
+        for nb in (1 << 12, 1 << 15):
+            want = _bucket_of_keys(keys, salt, nb)
+            got = np.asarray(jax.jit(functools.partial(
+                dw._splitmix64_bucket, salt=salt, n_buckets=nb))(
+                jnp.asarray((keys >> 32).astype(np.uint32)),
+                jnp.asarray((keys & 0xFFFFFFFF).astype(np.uint32))))
+            np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.parametrize("datatype", ["flow", "dns", "proxy"])
+def test_stream_bucket_program_matches_host(datatype):
+    """The fused streaming bucket program (binning → full-spec key →
+    splitmix64) agrees with the host words+hash path per token, up to
+    the documented f32 bin-edge caveat (<=1e-4 of tokens here)."""
+    from onix.pipelines import columnar
+    from onix.pipelines.streaming import _bucket_of_keys, _datatype_salt
+    from onix.pipelines.synth import SYNTH
+
+    nb = 1 << 13
+    day, _ = SYNTH[datatype](n_events=15_000, n_hosts=200,
+                             n_anomalies=15, seed=3)
+    cols = columnar.FRAME_COLS[datatype](day)
+    wt = columnar.words_from_cols(datatype, cols, edges=None)
+    edges = wt.edges
+    wt2 = columnar.words_from_cols(datatype, cols, edges=edges)
+    salt = _datatype_salt(datatype)
+    want = _bucket_of_keys(wt2.word_key, salt, nb)
+    if datatype == "flow":
+        t = dw.build_flow_stream_tables(edges, list(cols["proto_classes"]))
+        got = np.asarray(dw.flow_stream_buckets(
+            t, jnp.asarray(np.asarray(cols["sport"], np.int32)),
+            jnp.asarray(np.asarray(cols["dport"], np.int32)),
+            jnp.asarray(np.asarray(cols["proto_id"], np.int32)),
+            jnp.asarray(np.asarray(cols["hour"], np.float32)),
+            jnp.asarray(np.asarray(cols["ibyt"], np.float32)),
+            jnp.asarray(np.asarray(cols["ipkt"], np.float32)),
+            salt=salt, n_buckets=nb))
+        got = np.concatenate([got, got])      # [src|dst] token layout
+    elif datatype == "dns":
+        t = dw.build_dns_stream_tables(edges, cols["qnames"])
+        got = np.asarray(dw.dns_stream_buckets(
+            t, jnp.asarray(np.asarray(cols["qname_codes"], np.int32)),
+            jnp.asarray(np.asarray(cols["qtype"], np.int32)),
+            jnp.asarray(np.asarray(cols["rcode"], np.int32)),
+            jnp.asarray(np.asarray(cols["frame_len"], np.float32)),
+            jnp.asarray(np.asarray(cols["hour"], np.float32)),
+            salt=salt, n_buckets=nb))
+    else:
+        t = dw.build_proxy_stream_tables(edges, cols["uris"],
+                                         cols["hosts"], cols["agents"])
+        got = np.asarray(dw.proxy_stream_buckets(
+            t, jnp.asarray(np.asarray(cols["uri_codes"], np.int32)),
+            jnp.asarray(np.asarray(cols["host_codes"], np.int32)),
+            jnp.asarray(np.asarray(cols["ua_codes"], np.int32)),
+            jnp.asarray(np.asarray(cols["respcode"], np.int32)),
+            jnp.asarray(np.asarray(cols["hour"], np.float32)),
+            salt=salt, n_buckets=nb))
+    mismatches = int((got != want).sum())
+    assert mismatches <= max(2, len(want) // 10_000), mismatches
+
+
+def test_scale_flow_table_build_failure_degrades_to_host(monkeypatch):
+    """A trained flow vocabulary the compact keys cannot carry must
+    degrade the (default) device path to the host arm mid-run —
+    announced, never a crash — mirroring the dns/proxy upfront gate."""
+    from onix.pipelines import device_words, scale
+
+    def boom(*a, **kw):
+        raise ValueError("synthetic compact-key overflow")
+
+    monkeypatch.delenv("ONIX_HOST_WORDS", raising=False)
+    monkeypatch.delenv("ONIX_DEVICE_WORDS", raising=False)
+    monkeypatch.setattr(device_words, "build_flow_tables", boom)
+    m = scale.run_scale(20_000, train_events=10_000, n_sweeps=6, seed=5)
+    assert m["words_mode"] == "host"
+    assert m["planted_in_bottom_k"] > 0

@@ -1,7 +1,10 @@
 import math
 
 import numpy as np
-from hypothesis import given, strategies as st
+import pytest
+
+hypothesis = pytest.importorskip("hypothesis")
+from hypothesis import given, strategies as st  # noqa: E402
 
 from onix.utils import (digitize, entropy_array, quantile_edges,
                         shannon_entropy, subdomain_split)

@@ -9,8 +9,11 @@ domain decomposition being a partition of the input name.
 import math
 
 import numpy as np
-from hypothesis import given, settings
-from hypothesis import strategies as st
+import pytest
+
+hypothesis = pytest.importorskip("hypothesis")
+from hypothesis import given, settings  # noqa: E402
+from hypothesis import strategies as st  # noqa: E402
 
 from onix.oa.components import cidr_to_range, ip_to_u32
 from onix.utils.features import (digitize, entropy_array, quantile_edges,

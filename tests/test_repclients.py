@@ -253,3 +253,28 @@ def test_gti_malformed_answer_does_not_poison_batch():
     got = c.check(["a.com", "evil.com"])
     assert got["evil.com"] == "HIGH"      # valid verdict survives
     assert got["a.com"] == "NONE"         # malformed degrades alone
+
+
+def test_gti_non_dict_answer_does_not_fail_open_batch():
+    """Wire-level: a non-dict entry in `answers` (a bare string, a
+    number) used to raise AttributeError on .get — outside the caught
+    set — and fail-open the WHOLE batch to NONE. It must degrade
+    alone; verdicts around it survive."""
+    import json as _json
+
+    from onix.oa.repclients import GTIReputationClient
+
+    def transport(url, payload, timeout, headers):
+        return 200, _json.dumps({"answers": [
+            "garbage-string",
+            {"url": "evil.com", "rep": 99},
+            17,
+            None,
+            {"url": "fine.com", "rep": 1}]}).encode()
+
+    c = GTIReputationClient("https://gti.example", transport=transport)
+    got = c.check(["evil.com", "fine.com", "missing.com"])
+    assert got["evil.com"] == "HIGH"     # would be NONE if batch failed open
+    assert got["fine.com"] == "NONE"
+    assert got["missing.com"] == "NONE"
+    assert c.stats["failures"] == 0      # degraded answers, not a failure

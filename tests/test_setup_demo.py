@@ -89,3 +89,22 @@ def test_demo_on_sessions_generator(tmp_path):
         run_demo(cfg, n_events=800, generator="mixture")
     # Same-generator re-run stays resumable.
     assert run_demo(cfg, n_events=800, generator="sessions") == 0
+
+
+def test_premarker_demo_store_stamps_mixture(tmp_path):
+    """A store holding a demo day from before the generator marker
+    existed must be stamped `mixture` (the only generator that era
+    had) — NOT whatever --generator the next run passes. A sessions
+    re-run over such a store must refuse, not adopt."""
+    from onix.pipelines.synth import SYNTH
+    from onix.store import Store
+
+    cfg = load_config(None, [o for o in _overrides(tmp_path) if o != "-s"])
+    run_setup(cfg)
+    table, _ = SYNTH["flow"](n_events=200, date=DEMO_DATE, seed=7)
+    Store(cfg.store.root).write("flow", DEMO_DATE, table)
+    marker = pathlib.Path(cfg.store.root) / ".demo_generator"
+    assert not marker.exists()          # the pre-marker era
+    with pytest.raises(ValueError, match="mixture"):
+        run_demo(cfg, n_events=200, generator="sessions")
+    assert marker.read_text().strip() == "mixture"

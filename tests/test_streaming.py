@@ -324,3 +324,64 @@ def test_streaming_checkpoint_restore_after_eviction(tmp_path):
                               seed=13)
     np.testing.assert_allclose(b.process(table).scores, r_all[3],
                                rtol=1e-5)
+
+
+def test_streaming_device_mode_default_and_host_escape(monkeypatch):
+    """After the first (edge-fitting) batch, columnar minibatches ride
+    the fused device word path by default; ONIX_HOST_WORDS=1 pins every
+    batch to the host reference arm. Scores from the two arms agree in
+    rank where it matters (same alert tail)."""
+    table, _ = synth_flow_day(n_events=3000, n_hosts=80, n_anomalies=10,
+                              seed=21)
+    chunks = [table.iloc[i:i + 1000].reset_index(drop=True)
+              for i in range(0, 3000, 1000)]
+
+    monkeypatch.delenv("ONIX_HOST_WORDS", raising=False)
+    dev = StreamingScorer(_cfg(), "flow", n_buckets=1 << 12)
+    dev_scores = np.concatenate([dev.process(c).scores for c in chunks])
+    assert dev.words_mode_batches == {"device": 2, "host": 1}
+
+    monkeypatch.setenv("ONIX_HOST_WORDS", "1")
+    host = StreamingScorer(_cfg(), "flow", n_buckets=1 << 12)
+    host_scores = np.concatenate([host.process(c).scores for c in chunks])
+    assert host.words_mode_batches == {"device": 0, "host": 3}
+
+    # Same words, same buckets (up to the documented f32 edge caveat),
+    # different E-step schedule (dedup + warm start vs the reference
+    # fixed count) — the suspicious tails must still agree strongly.
+    k = 300
+    a = set(np.argsort(dev_scores)[:k].tolist())
+    b = set(np.argsort(host_scores)[:k].tolist())
+    assert len(a & b) >= 0.8 * k
+
+
+def test_streaming_device_mode_non_pow2_buckets_falls_back():
+    """A non-power-of-two bucket count cannot use the device low-bits
+    mod — every batch stays on the host path, results stay sane."""
+    table, _ = synth_flow_day(n_events=1200, n_hosts=50, n_anomalies=5,
+                              seed=9)
+    sc = StreamingScorer(_cfg(), "flow", n_buckets=3000)
+    for _ in range(2):
+        res = sc.process(table)
+    assert sc.words_mode_batches["device"] == 0
+    assert np.isfinite(res.scores).all()
+
+
+def test_streaming_device_buckets_compile_once_per_size_class():
+    """Irregular minibatch sizes must NOT retrace the fused bucket
+    program per batch — per-event columns are pow2-padded, so a stream
+    of varied batch lengths reuses one compiled program per size class
+    (through the TPU tunnel a retrace costs 5-30 s)."""
+    from onix.pipelines import device_words as dw
+
+    sc = StreamingScorer(_cfg(), "flow", n_buckets=1 << 12)
+    table, _ = synth_flow_day(n_events=700, n_hosts=50, n_anomalies=4,
+                              seed=2)
+    before = dw.flow_stream_buckets._cache_size()
+    # Varied sizes, all within one pow2 size class (<= 256 floor pads
+    # n<=256; 130/190/251 all pad to 256).
+    for n in (130, 190, 251, 163):
+        sc.process(table.iloc[:n].reset_index(drop=True))
+    added = dw.flow_stream_buckets._cache_size() - before
+    assert sc.words_mode_batches["device"] == 3   # batch 1 fits edges
+    assert added <= 1, f"{added} compiles for one size class"

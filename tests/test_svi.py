@@ -44,3 +44,69 @@ def test_gamma_shapes():
     assert gamma.shape == (2, 3)
     assert int(state2.step) == 1
     assert np.all(np.isfinite(np.asarray(state2.lam)))
+
+
+def test_weighted_dedup_batch_matches_repeated_tokens():
+    """The deduped streaming minibatch (unique (doc, word) pairs with
+    counts as mask weights) must drive the SAME update as the repeated
+    tokens it stands for — same lambda, same gamma (up to scatter-order
+    float noise)."""
+    rng = np.random.default_rng(0)
+    d = rng.integers(0, 12, 400).astype(np.int32)
+    w = rng.integers(0, 50, 400).astype(np.int32)
+    cfg = LDAConfig(n_topics=4, svi_meanchange_tol=0.0, seed=1)
+    model = SVILda(cfg, n_vocab=50, corpus_docs=100)
+    s0 = model.init()
+
+    rep = make_minibatch(d, w, pad_to=512)
+    s_rep, g_rep = model.update(s0, rep)
+
+    key = d.astype(np.int64) * 50 + w
+    uniq, cnt = np.unique(key, return_counts=True)
+    du = (uniq // 50).astype(np.int32)
+    wu = (uniq % 50).astype(np.int32)
+    ded = make_minibatch(du, wu, pad_to=512,
+                         weights=cnt.astype(np.float32))
+    s_ded, g_ded = model.update(s0, ded)
+
+    assert len(uniq) < 400            # the dedup actually deduped
+    np.testing.assert_allclose(np.asarray(s_ded.lam),
+                               np.asarray(s_rep.lam), rtol=2e-4)
+    np.testing.assert_allclose(np.asarray(g_ded), np.asarray(g_rep),
+                               rtol=2e-4)
+
+
+def test_meanchange_stop_matches_converged_fixed_count():
+    """The convergence stop may only end the E-step EARLY on a batch
+    that has already converged — its gamma must match the full
+    fixed-count iteration within the stopping tolerance."""
+    rng = np.random.default_rng(3)
+    d = rng.integers(0, 8, 300).astype(np.int32)
+    w = rng.integers(0, 40, 300).astype(np.int32)
+    batch = make_minibatch(d, w, pad_to=512)
+    full = SVILda(LDAConfig(n_topics=4, svi_meanchange_tol=0.0,
+                            svi_local_iters=60, seed=1), 40, 100)
+    stop = SVILda(LDAConfig(n_topics=4, svi_meanchange_tol=1e-4,
+                            svi_local_iters=60, seed=1), 40, 100)
+    _, g_full = full.update(full.init(), batch)
+    _, g_stop = stop.update(stop.init(), batch)
+    np.testing.assert_allclose(np.asarray(g_stop), np.asarray(g_full),
+                               atol=5e-3, rtol=1e-3)
+
+
+def test_warm_start_gamma_converges_to_same_fixed_point():
+    """A warm-started E-step (returning docs' prior gamma) lands on the
+    same converged gamma as the cold start — the warm start is a speed
+    lever, not a model change."""
+    rng = np.random.default_rng(7)
+    d = rng.integers(0, 8, 300).astype(np.int32)
+    w = rng.integers(0, 40, 300).astype(np.int32)
+    batch = make_minibatch(d, w, pad_to=512)
+    model = SVILda(LDAConfig(n_topics=4, svi_meanchange_tol=1e-5,
+                             svi_local_iters=200, seed=1), 40, 100)
+    s0 = model.init()
+    _, g_cold = model.update(s0, batch)
+    g0 = np.asarray(g_cold) * 0.9 + 0.2      # a perturbed prior state
+    _, g_warm = model.update(s0, batch, gamma0=g0)
+    np.testing.assert_allclose(np.asarray(g_warm), np.asarray(g_cold),
+                               atol=5e-3, rtol=1e-2)

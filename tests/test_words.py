@@ -291,3 +291,21 @@ def test_synth_arrays_generators_scale_shape(datatype):
     assert cols[code_key].max() < len(cols[uniq_key])
     assert cols["client_u32"].shape == (50_000,)
     assert cols["anomaly_idx"].tolist() == list(range(50_000 - 25, 50_000))
+
+
+def test_quantile_edges_sorted_at_high_bin_count():
+    """Regression: above ~100 bins the interior quantiles pass the
+    0.99/0.999 tail cut points; unsorted concatenation returned
+    unsorted edges and the host digitize path silently misbinned
+    (bin indices non-monotone in the value)."""
+    from onix.utils.features import digitize, tail_quantile_edges
+
+    rng = np.random.default_rng(0)
+    v = rng.exponential(50.0, 50_000)
+    edges = tail_quantile_edges(v, 128)
+    assert (np.diff(edges) >= 0).all(), "edges must come back sorted"
+    x = np.sort(rng.exponential(50.0, 1_000))
+    bins = digitize(x, edges)
+    assert (np.diff(bins) >= 0).all(), "bin index must be monotone in value"
+    # Tail cut points actually isolate the out-of-support magnitudes.
+    assert digitize(np.array([v.max() * 100]), edges)[0] == len(edges)
