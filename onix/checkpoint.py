@@ -102,12 +102,21 @@ _SAMPLING_FIELDS = ("n_topics", "alpha", "eta", "burn_in", "block_size",
 
 
 def fingerprint(config, n_docs: int, n_vocab: int, n_tokens: int,
-                extra: dict | None = None) -> str:
+                extra: dict | None = None,
+                superstep: int | None = None) -> str:
     """Identity of a resumable run: sampling-relevant hyperparams +
     corpus shape. A checkpoint from a different config/corpus must never
     be resumed into — shape-compatible mismatches (same D,V, different
     seed) are caught here; checkpoints live in a per-fingerprint subdir
-    so runs with different identities never interfere."""
+    so runs with different identities never interfere.
+
+    `superstep` is the RESOLVED fused-superstep size of the writing
+    engine (not the raw config field, whose 0 means "auto"): the fused
+    carry holds accumulator state and checkpoints land only at superstep
+    boundaries, so resuming a run under a different S is refused here
+    rather than producing a subtly different ll cadence/artifact. The
+    parameter joining the payload is itself a layout bump — every
+    pre-superstep checkpoint is refused, never misread."""
     full = dataclasses.asdict(config)
     payload = {
         "lda": {k: full[k] for k in _SAMPLING_FIELDS},
@@ -115,6 +124,8 @@ def fingerprint(config, n_docs: int, n_vocab: int, n_tokens: int,
         "n_tokens": int(n_tokens),
         **(extra or {}),
     }
+    if superstep is not None:
+        payload["superstep"] = int(superstep)
     import hashlib
     return hashlib.sha256(
         json.dumps(payload, sort_keys=True).encode()).hexdigest()[:16]

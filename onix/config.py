@@ -63,6 +63,21 @@ class LDAConfig:
     # vocabularies like DNS ~0.01-0.02 of judged overlap at dp=8) for
     # one more K x Vc collective per sweep — cheap on ICI.
     sync_splits: int = 1
+    # Gibbs fit superstep: sweeps chained inside ONE jitted program per
+    # dispatch (docs/PERF.md "the gibbs_fit vs sweep-microbench gap" —
+    # each dispatch costs ~70 ms RTT through the device tunnel, and the
+    # old loop paid it per sweep plus separate likelihood programs).
+    # The burn-in accumulate fold and the boundary log-likelihood run
+    # on device inside the superstep; results are bit-identical to the
+    # sweep-at-a-time loop for every superstep size (tested). 0 = auto
+    # (lda_gibbs.SUPERSTEP_DEFAULT = 10, the old loop's ll cadence when
+    # checkpointing is off). ll_history entries land at SEGMENT ends,
+    # and segments also break at checkpoint boundaries — with
+    # checkpointing on, entries land every min(superstep,
+    # checkpoint_every)-ish sweeps: denser than the cap, never sparser.
+    # Part of the checkpoint fingerprint: resuming under a different
+    # superstep is refused, not silently different.
+    superstep: int = 0
 
     def validate(self) -> None:
         if self.n_topics < 2:
@@ -85,6 +100,8 @@ class LDAConfig:
             raise ValueError("n_chains must be >= 1")
         if self.sync_splits < 1:
             raise ValueError("sync_splits must be >= 1")
+        if self.superstep < 0:
+            raise ValueError("superstep must be >= 0 (0 = auto)")
 
 
 @dataclass

@@ -149,6 +149,28 @@ _NWK_MATMUL_MAX_V = 4096
 # form never allocated — an OOM regression, not a speedup. Forcing
 # nwk_matmul=True bypasses the bound for experiments.
 _NWK_MATMUL_MAX_ELEMS = 1 << 27
+# Collision-density crossover per backend: the auto gate engages the
+# matmul form only when the n_wk scatter is collision-DENSE, measured
+# as density = block_size / V (expected colliding row-updates per vocab
+# row per block), instead of the old backend-only rule ("any V <= 4096
+# on an accelerator"). The decision table lives in docs/PERF.md ("the
+# gibbs_fit vs sweep-microbench gap"), fed by scripts/exp_fit_gap.py
+# (raw_nwk_scatter vs raw_nwk_matmul on the real corpus shape; a tiny
+# CPU smoke of the same harness runs in tier-1 so it cannot rot):
+#   * cpu — NO entry: the matmul form measured ~4x SLOWER than the
+#     scatter at the densest judged shape (V=289, B=2^17, density ~450;
+#     PERF.md r7 rows). B*V*K host MACs never beat a cache-resident
+#     scatter here, so CPU stays on the scatter at every density.
+#   * tpu — engage at density >= 32: the V=4096/B=2^16 microbench
+#     (density 16) measured the scatter as acceptable (35-37 Mtok/s,
+#     PERF.md "the exponential race"), so the crossover sits strictly
+#     above it; judged product vocabularies (V~500, B=2^17, density
+#     ~260) engage exactly as the old gate did. The TPU scatter-vs-
+#     matmul rows of exp_fit_gap.py stay queued behind the tunnel —
+#     when they land, this threshold moves to the measured crossover.
+# Unmeasured accelerators (gpu) get no entry and keep the scatter —
+# the same "measured platforms only" policy as scoring's bf16 gate.
+_NWK_MATMUL_MIN_DENSITY = {"tpu": 32.0}
 
 
 def make_block_step(*, alpha: float, eta: float, n_vocab: int,
@@ -168,7 +190,9 @@ def make_block_step(*, alpha: float, eta: float, n_vocab: int,
     v_eta = n_vocab * eta
     # Sampler form is picked once at trace time; it is a platform
     # property, not runtime state, so the traced program is static.
-    use_gumbel = jax.default_backend() not in ("cpu",)
+    backend = jax.default_backend()
+    use_gumbel = backend not in ("cpu",)
+    min_density = _NWK_MATMUL_MIN_DENSITY.get(backend)
     if nwk_matmul is None:
         import os
         env = os.environ.get("ONIX_NWK_MATMUL")
@@ -221,9 +245,12 @@ def make_block_step(*, alpha: float, eta: float, n_vocab: int,
         delta = _one_hot(z_new, k_topics) - oh_old  # int32-exact update
         n_dk = n_dk.at[d].add(delta)
         # n_wk shape is static under trace, so the delta form resolves
-        # to ONE compiled path (module comment at _NWK_MATMUL_MAX_V).
+        # to ONE compiled path. The auto gate is the measured collision-
+        # density crossover (module comment at _NWK_MATMUL_MIN_DENSITY),
+        # bounded by the exactness/memory caps above it.
         use_matmul = (nwk_matmul if nwk_matmul is not None
-                      else (use_gumbel
+                      else (min_density is not None
+                            and w.shape[0] >= min_density * n_wk.shape[0]
                             and n_wk.shape[0] <= _NWK_MATMUL_MAX_V
                             # Exactness bound: every output of the f32
                             # accumulation is a sum of B {-1,0,1} terms,
@@ -263,9 +290,15 @@ def sweep(
     alpha: float,
     eta: float,
     n_vocab: int,
-    accumulate: bool,
+    accumulate,
 ) -> GibbsState:
-    """One full Gibbs sweep over all token blocks (jit-friendly)."""
+    """One full Gibbs sweep over all token blocks (jit-friendly).
+
+    `accumulate` may be a Python bool OR a traced 0-d array — the fused
+    superstep derives it from the sweep counter on device. Both forms
+    produce bit-identical updates: the accumulate fold is `acc + a * n`
+    with a in {0.0, 1.0} and n >= 0, so a=0 adds an exact +0.0 whether
+    or not XLA can constant-fold it away."""
     k_topics = state.n_dk.shape[1]
     block_step = make_block_step(alpha=alpha, eta=eta, n_vocab=n_vocab,
                                  k_topics=k_topics)
@@ -275,13 +308,126 @@ def sweep(
         (state.n_dk, state.n_wk, state.n_k, state.key),
         (doc_blocks, word_blocks, mask_blocks, state.z),
     )
-    do_acc = jnp.float32(accumulate)
+    do_acc = jnp.asarray(accumulate, jnp.float32)
     return GibbsState(
         z=z, n_dk=n_dk, n_wk=n_wk, n_k=n_k, key=key,
         acc_ndk=state.acc_ndk + do_acc * n_dk.astype(jnp.float32),
         acc_nwk=state.acc_nwk + do_acc * n_wk.astype(jnp.float32),
-        n_acc=state.n_acc + jnp.int32(accumulate),
+        n_acc=state.n_acc + jnp.asarray(accumulate, jnp.int32),
     )
+
+
+# Auto superstep size (config.lda.superstep == 0): 10 sweeps per fused
+# program reproduces the old fit loop's every-10-sweeps ll cadence
+# (exactly, when checkpointing is off; checkpoint boundaries further
+# split segments, making the cadence denser, never sparser) while
+# amortizing the per-dispatch RTT 10x (docs/PERF.md measured ~65-70
+# ms/dispatch through the device tunnel).
+SUPERSTEP_DEFAULT = 10
+
+
+def superstep(
+    state: GibbsState,
+    doc_blocks: jax.Array,
+    word_blocks: jax.Array,
+    mask_blocks: jax.Array,
+    *,
+    alpha: float,
+    eta: float,
+    n_vocab: int,
+    burn_in: int,
+    start_sweep,
+    n_steps: int,
+) -> GibbsState:
+    """Chain `n_steps` full sweeps inside ONE lax.scan — one dispatch,
+    one compiled program per distinct n_steps (static), any start sweep
+    (traced). The burn-in accumulate phase is folded into the scan
+    carry: sweep start_sweep + i accumulates iff it is past burn_in,
+    decided on device, so the posterior-mean sums never leave the chip
+    between sweeps. Bit-identical to n_steps sequential sweep()
+    dispatches under the same key stream (tests/test_gibbs.py)."""
+    start_sweep = jnp.asarray(start_sweep, jnp.int32)
+
+    def one(st, i):
+        return sweep(st, doc_blocks, word_blocks, mask_blocks,
+                     alpha=alpha, eta=eta, n_vocab=n_vocab,
+                     accumulate=start_sweep + i >= burn_in), None
+
+    state, _ = jax.lax.scan(one, state,
+                            jnp.arange(n_steps, dtype=jnp.int32))
+    return state
+
+
+def run_fit_segments(state, start: int, segments, *, superstep_fn,
+                     initial_ll_fn, checkpoint_every: int, checkpoint_dir,
+                     save_fn, fault_sweep: int | None, notify):
+    """Drive the fused-superstep fit loop — ONE implementation shared by
+    GibbsLDA and ShardedGibbsLDA so segment/ll/checkpoint/fault
+    semantics can never diverge between the engines.
+
+    Per segment: one superstep dispatch (the first also evaluates the
+    pre-sweep ll on device — no standalone warm-up dispatch), an
+    ll_history entry at the boundary, then checkpoint save, fault
+    raise, and callback in that order (the order the pre-superstep
+    loops used). `superstep_fn(state, start_sweep, n_steps,
+    with_initial_ll)` returns (state, ll) or (state, ll0, ll);
+    `initial_ll_fn(state)` serves the no-segments case (resume landed
+    at/after n_sweeps); `save_fn(state, sweep)` persists a checkpoint;
+    `notify(sweep, state, ll)` adapts each engine's public callback
+    signature. Returns (state, ll_history)."""
+    from onix import checkpoint as ckpt
+
+    ll_history: list[tuple[int, float]] = []
+    if not segments:
+        # Nothing left to sweep: the pre-sweep ll point still belongs
+        # in the history.
+        ll_history.append((start - 1, float(initial_ll_fn(state))))
+    for i, (seg_start, seg_len) in enumerate(segments):
+        if i == 0:
+            state, ll0, ll = superstep_fn(state, seg_start, seg_len, True)
+            ll_history.append((seg_start - 1, float(ll0)))
+        else:
+            state, ll = superstep_fn(state, seg_start, seg_len, False)
+        s = seg_start + seg_len - 1
+        ll_history.append((s, float(ll)))
+        if (checkpoint_dir is not None and checkpoint_every > 0
+                and (s + 1) % checkpoint_every == 0):
+            save_fn(state, s)
+        if fault_sweep is not None and s == fault_sweep:
+            raise ckpt.SimulatedPreemption(
+                f"fault injected after sweep {s} "
+                f"(checkpoint_dir={checkpoint_dir})")
+        if notify is not None:
+            notify(s, state, ll_history[-1][1])
+    return state, ll_history
+
+
+def plan_segments(start: int, n_sweeps: int, superstep_size: int, *,
+                  checkpoint_every: int = 0,
+                  fault_sweep: int | None = None,
+                  per_sweep: bool = False) -> list[tuple[int, int]]:
+    """Split sweeps [start, n_sweeps) into fused superstep segments.
+
+    Every segment ends exactly at a host-interaction boundary — a
+    checkpoint sweep ((s+1) % checkpoint_every == 0), the fault-
+    injection sweep, the final sweep — or at the superstep cap, so a
+    checkpoint can never be demanded mid-superstep and every resume
+    point is an exact sweep boundary. `per_sweep` collapses segments to
+    length 1 (a per-sweep callback is registered). Returns a list of
+    (segment_start, segment_length)."""
+    cap = 1 if per_sweep else max(1, int(superstep_size))
+    segs: list[tuple[int, int]] = []
+    s = start
+    while s < n_sweeps:
+        end = min(s + cap, n_sweeps)
+        if checkpoint_every and checkpoint_every > 0:
+            next_ckpt = s + checkpoint_every - (s % checkpoint_every)
+            end = min(end, next_ckpt)
+        if fault_sweep is not None and s <= fault_sweep < end - 1:
+            end = fault_sweep + 1
+        segs.append((s, end - s))
+        s = end
+    return segs
 
 
 def posterior_estimates(
@@ -334,6 +480,9 @@ class GibbsLDA:
         chains = config.n_chains
         base_sweep = functools.partial(
             sweep, alpha=config.alpha, eta=config.eta, n_vocab=n_vocab)
+        base_super = functools.partial(
+            superstep, alpha=config.alpha, eta=config.eta,
+            n_vocab=n_vocab, burn_in=config.burn_in)
         base_est = functools.partial(
             posterior_estimates, alpha=config.alpha, eta=config.eta)
         if chains == 1:
@@ -341,6 +490,29 @@ class GibbsLDA:
                                   static_argnames=("accumulate",))
             self._estimates = jax.jit(base_est)
             self._ll = jax.jit(log_likelihood)
+
+            # The fit loop's unit of dispatch: n_steps sweeps chained in
+            # one program, with the boundary log-likelihood fused in —
+            # the ll gathers run on device right behind the last sweep
+            # instead of costing two more dispatches (docs/PERF.md "the
+            # gibbs_fit vs sweep-microbench gap", hypotheses A/D).
+            # `with_initial_ll` additionally evaluates ll on the
+            # INCOMING state (fit's pre-sweep ll_history point), so the
+            # whole first segment — initial ll, S sweeps, boundary ll —
+            # is ONE dispatch; measured worth ~14% of the CPU fit wall
+            # (the standalone ll's sync + dispatch-boundary allocator
+            # churn, not its compute).
+            def superstep_ll(state, d, w, m, start, n_steps,
+                             with_initial_ll=False):
+                ll0 = None
+                if with_initial_ll:
+                    theta0, phi0 = base_est(state)
+                    ll0 = log_likelihood(theta0, phi0, d, w, m)
+                st = base_super(state, d, w, m, start_sweep=start,
+                                n_steps=n_steps)
+                theta, phi = base_est(st)
+                ll = log_likelihood(theta, phi, d, w, m)
+                return ((st, ll0, ll) if with_initial_ll else (st, ll))
         else:
             # vmap over the chain axis of the state; token blocks are
             # shared (broadcast). theta/phi keep a leading chain axis —
@@ -358,6 +530,23 @@ class GibbsLDA:
             self._estimates = jax.jit(jax.vmap(base_est))
             self._ll = jax.jit(ll_chains)
 
+            def superstep_ll(state, d, w, m, start, n_steps,
+                             with_initial_ll=False):
+                ll0 = None
+                if with_initial_ll:
+                    theta0, phi0 = jax.vmap(base_est)(state)
+                    ll0 = jax.vmap(lambda t, p: log_likelihood(
+                        t, p, d, w, m))(theta0, phi0).mean()
+                st = jax.vmap(lambda s: base_super(
+                    s, d, w, m, start_sweep=start, n_steps=n_steps))(state)
+                theta, phi = jax.vmap(base_est)(st)
+                ll = jax.vmap(lambda t, p: log_likelihood(
+                    t, p, d, w, m))(theta, phi).mean()
+                return ((st, ll0, ll) if with_initial_ll else (st, ll))
+
+        self._superstep = jax.jit(
+            superstep_ll, static_argnames=("n_steps", "with_initial_ll"))
+
     def prepare(self, corpus: Corpus, shuffle: bool = True):
         if shuffle:
             corpus = corpus.shuffled(self.config.seed)
@@ -373,11 +562,24 @@ class GibbsLDA:
     def fit(self, corpus: Corpus, n_sweeps: int | None = None,
             callback=None, checkpoint_dir=None, resume: bool = True,
             fault_inject_sweep: int | None = None) -> dict:
-        """Run the sweep loop; optionally checkpoint every
-        `config.checkpoint_every` sweeps into `checkpoint_dir` and resume
-        from the newest matching checkpoint there (SURVEY.md §5.3-5.4:
-        resume-on-preemption). Resumed runs are bit-identical to
-        uninterrupted ones — the sweep is a pure function of the state.
+        """Run the fit loop as fused supersteps: sweeps are chained S at
+        a time inside one jitted program (`superstep`), with the burn-in
+        accumulate fold and the boundary log-likelihood on device — one
+        dispatch and one host sync per S sweeps instead of per sweep
+        (docs/PERF.md "the gibbs_fit vs sweep-microbench gap"). Segment
+        boundaries land exactly on checkpoint/fault/final sweeps
+        (`plan_segments`), and a per-sweep `callback` collapses segments
+        to single sweeps, so host-visible behavior at every boundary is
+        unchanged; the chained loop is bit-identical to sweep-at-a-time
+        (tested).
+
+        Optionally checkpoint every `config.checkpoint_every` sweeps
+        into `checkpoint_dir` and resume from the newest matching
+        checkpoint there (SURVEY.md §5.3-5.4: resume-on-preemption).
+        Resumed runs are bit-identical to uninterrupted ones — the sweep
+        is a pure function of the state, and the superstep size is part
+        of the checkpoint fingerprint so a resume under a different S is
+        refused rather than producing a different ll cadence.
 
         `fault_inject_sweep` (or env ONIX_FAULT_SWEEP) simulates a
         preemption by raising SimulatedPreemption right after completing
@@ -393,9 +595,10 @@ class GibbsLDA:
 
         cfg = self.config
         n_sweeps = cfg.n_sweeps if n_sweeps is None else n_sweeps
+        S = cfg.superstep or SUPERSTEP_DEFAULT
         docs, words, mask = self.prepare(corpus)
         fp = ckpt.fingerprint(cfg, self.n_docs, self.n_vocab,
-                              corpus.n_tokens)
+                              corpus.n_tokens, superstep=S)
         # Per-fingerprint subdir: checkpoints of runs with a different
         # identity can neither be adopted nor pruned by this run.
         if checkpoint_dir is not None:
@@ -417,28 +620,28 @@ class GibbsLDA:
                 state = init_chains(docs, words, mask, self.n_docs,
                                     self.n_vocab, cfg.n_topics, cfg.seed,
                                     cfg.n_chains)
-        theta0, phi0 = self._estimates(state)
-        ll_history = [(start - 1,
-                       float(self._ll(theta0, phi0, docs, words, mask)))]
-        for s in range(start, n_sweeps):
-            state = self._sweep(state, docs, words, mask,
-                                accumulate=s >= cfg.burn_in)
-            if (checkpoint_dir is not None and cfg.checkpoint_every > 0
-                    and (s + 1) % cfg.checkpoint_every == 0):
-                ckpt.save(checkpoint_dir, s,
-                          {k: np.asarray(v)
-                           for k, v in state._asdict().items()},
-                          {"fingerprint": fp, "engine": "gibbs"})
-            if fault_inject_sweep is not None and s == fault_inject_sweep:
-                raise ckpt.SimulatedPreemption(
-                    f"fault injected after sweep {s} "
-                    f"(checkpoint_dir={checkpoint_dir})")
-            if callback is not None or s == n_sweeps - 1 or s % 10 == 9:
-                theta, phi_wk = self._estimates(state)
-                ll = float(self._ll(theta, phi_wk, docs, words, mask))
-                ll_history.append((s, ll))
-                if callback is not None:
-                    callback(s, state, ll)
+        segments = plan_segments(
+            start, n_sweeps, S,
+            checkpoint_every=(cfg.checkpoint_every
+                              if checkpoint_dir is not None else 0),
+            fault_sweep=fault_inject_sweep,
+            per_sweep=callback is not None)
+        state, ll_history = run_fit_segments(
+            state, start, segments,
+            superstep_fn=lambda st, s0, n, init: self._superstep(
+                st, docs, words, mask, s0, n_steps=n,
+                with_initial_ll=init),
+            initial_ll_fn=lambda st: self._ll(*self._estimates(st),
+                                              docs, words, mask),
+            checkpoint_every=cfg.checkpoint_every,
+            checkpoint_dir=checkpoint_dir,
+            save_fn=lambda st, s: ckpt.save(
+                checkpoint_dir, s,
+                {k: np.asarray(v) for k, v in st._asdict().items()},
+                {"fingerprint": fp, "engine": "gibbs"}),
+            fault_sweep=fault_inject_sweep,
+            notify=(None if callback is None
+                    else lambda s, st, ll: callback(s, st, ll)))
         theta, phi_wk = self._estimates(state)
         return {
             "state": state,

@@ -215,6 +215,98 @@ class ShardedGibbsLDA:
         both = D + ((M,) if M else ())
 
         S = max(1, int(config.sync_splits))
+        burn = config.burn_in
+
+        def _group_sweep(z_g, n_dk_l, n_wk_l, n_k_l, key_c,
+                         d_g, w_g, m_g):
+            """ONE full sweep of this device's tokens: scan the S sync
+            groups, psum-folding count deltas after each (S=1 is the
+            reference's MPI cadence). Shapes are shard-LOCAL with the
+            leading shard axes already dropped; z_g is the grouped
+            layout [S, C, nb/S, B]. Shared by the per-sweep program and
+            the fused superstep so the math can never diverge."""
+            def group_step(carry, xs):
+                ndk_r, nwk_r, nk_r, key_c = carry
+                dg, wg, mg, zg = xs
+                # Replicated bases become device-varying once each
+                # device starts updating them locally — mark them
+                # per group; the psum fold below restores the
+                # replication the carry (and out_specs) demand.
+                nwk_v = _pcast(nwk_r, D, to="varying")
+                ndk_v = (_pcast(ndk_r, M, to="varying")
+                         if M else ndk_r)
+                nk_v = _pcast(nk_r, both, to="varying")
+
+                def one_chain(zc, ndkc, nwkc, nkc, keyc):
+                    return _local_sweep(
+                        zc, ndkc, nwkc, nkc, keyc, dg, wg, mg,
+                        alpha=config.alpha, eta=config.eta,
+                        n_vocab=n_vocab, k_topics=k)
+
+                z_new, ndk_new, nwk_new, nk_new, key_new = \
+                    jax.vmap(one_chain)(zg, ndk_v, nwk_v, nk_v, key_c)
+                # The MPI_Reduce+Bcast of the reference, as psums:
+                # chunk deltas over the data axes (ICI, then DCN),
+                # doc-topic deltas over mp, topic totals over both.
+                # All chains' deltas ride ONE collective (leading C
+                # axis reduces elementwise).
+                d_wk = jax.lax.psum(nwk_new - nwk_v, D)
+                d_dk = (jax.lax.psum(ndk_new - ndk_v, M)
+                        if M else ndk_new - ndk_v)
+                d_k = jax.lax.psum(nk_new - nk_v, both)
+                return (ndk_r + d_dk, nwk_r + d_wk, nk_r + d_k,
+                        key_new), z_new
+
+            (ndk_f, nwk_f, nk_f, key_f), z_out = jax.lax.scan(
+                group_step, (n_dk_l, n_wk_l, n_k_l, key_c),
+                (d_g, w_g, m_g, z_g))
+            return z_out, ndk_f, nwk_f, nk_f, key_f
+
+        def _grouped(d, w, m, z):
+            """Shard-local token blocks + z in sync-group layout."""
+            C = z.shape[2]
+            nb, B = d.shape[2], d.shape[3]
+            assert nb % S == 0, (
+                f"block count {nb} not divisible by "
+                f"sync_splits={S}: the corpus was laid out without "
+                "this engine's prepare() (shard_corpus needs "
+                "n_groups=sync_splits)")
+            return (d[0, 0].reshape(S, nb // S, B),
+                    w[0, 0].reshape(S, nb // S, B),
+                    m[0, 0].reshape(S, nb // S, B),
+                    z[0, 0].reshape(C, S, nb // S, B).swapaxes(0, 1),
+                    C, nb, B)
+
+        def _chain_ll_local(ndk_f, nwk_f, nk_v, d0, w0, m0, zero):
+            """Per-chain (sum log p, token sum) over this shard's tokens
+            from explicit local counts — the predictive-ll math shared
+            by the standalone ll program, the superstep boundary ll, and
+            the dp=1 fast path (which passes plain f32 zeros)."""
+            def one_chain(ndkc, nwkc, nkc):
+                ndk = ndkc.astype(jnp.float32)
+                theta = ((ndk + config.alpha)
+                         / (ndk.sum(-1, keepdims=True)
+                            + k * config.alpha))
+                nwk = nwkc.astype(jnp.float32)
+                phi = ((nwk + config.eta)
+                       / (nkc.astype(jnp.float32)
+                          + n_vocab * config.eta))
+
+                def block(carry, xs):
+                    sm, t = carry
+                    db, wb, mb = xs
+                    p = jnp.sum(theta[db] * phi[wb], axis=-1)
+                    p = jnp.maximum(p, 1e-30)
+                    return (sm + jnp.sum(mb * jnp.log(p)),
+                            t + jnp.sum(mb)), None
+
+                (sm, t), _ = jax.lax.scan(block, (zero, zero),
+                                          (d0, w0, m0))
+                return sm, t
+
+            return jax.vmap(one_chain)(ndk_f, nwk_f, nk_v)
+
+        mp_spec = (M,) if M else ()
 
         def sweep_fn(state: ShardedGibbsState, docs, words, mask,
                      accumulate: bool) -> ShardedGibbsState:
@@ -227,59 +319,14 @@ class ShardedGibbsLDA:
                 # group sweeps against counts at most 1/S of a sweep
                 # stale, psums its deltas, and folds them in before the
                 # next group — S=1 is the reference's MPI cadence.
-                C = z.shape[2]
-                nb, B = d.shape[2], d.shape[3]
-                assert nb % S == 0, (
-                    f"block count {nb} not divisible by "
-                    f"sync_splits={S}: the corpus was laid out without "
-                    "this engine's prepare() (shard_corpus needs "
-                    "n_groups=sync_splits)")
-                d_g = d[0, 0].reshape(S, nb // S, B)
-                w_g = w[0, 0].reshape(S, nb // S, B)
-                m_g = m[0, 0].reshape(S, nb // S, B)
-                z_g = (z[0, 0].reshape(C, S, nb // S, B)
-                       .swapaxes(0, 1))
-
-                def group_step(carry, xs):
-                    ndk_r, nwk_r, nk_r, key_c = carry
-                    dg, wg, mg, zg = xs
-                    # Replicated bases become device-varying once each
-                    # device starts updating them locally — mark them
-                    # per group; the psum fold below restores the
-                    # replication the carry (and out_specs) demand.
-                    nwk_v = _pcast(nwk_r, D, to="varying")
-                    ndk_v = (_pcast(ndk_r, M, to="varying")
-                             if M else ndk_r)
-                    nk_v = _pcast(nk_r, both, to="varying")
-
-                    def one_chain(zc, ndkc, nwkc, nkc, keyc):
-                        return _local_sweep(
-                            zc, ndkc, nwkc, nkc, keyc, dg, wg, mg,
-                            alpha=config.alpha, eta=config.eta,
-                            n_vocab=n_vocab, k_topics=k)
-
-                    z_new, ndk_new, nwk_new, nk_new, key_new = \
-                        jax.vmap(one_chain)(zg, ndk_v, nwk_v, nk_v, key_c)
-                    # The MPI_Reduce+Bcast of the reference, as psums:
-                    # chunk deltas over the data axes (ICI, then DCN),
-                    # doc-topic deltas over mp, topic totals over both.
-                    # All chains' deltas ride ONE collective (leading C
-                    # axis reduces elementwise).
-                    d_wk = jax.lax.psum(nwk_new - nwk_v, D)
-                    d_dk = (jax.lax.psum(ndk_new - ndk_v, M)
-                            if M else ndk_new - ndk_v)
-                    d_k = jax.lax.psum(nk_new - nk_v, both)
-                    return (ndk_r + d_dk, nwk_r + d_wk, nk_r + d_k,
-                            key_new), z_new
-
-                (ndk_f, nwk_f, nk_f, key_f), z_out = jax.lax.scan(
-                    group_step, (n_dk[0], n_wk[0], n_k, keys[0, 0]),
-                    (d_g, w_g, m_g, z_g))
+                d_g, w_g, m_g, z_g, C, nb, B = _grouped(d, w, m, z)
+                z_out, ndk_f, nwk_f, nk_f, key_f = _group_sweep(
+                    z_g, n_dk[0], n_wk[0], n_k, keys[0, 0],
+                    d_g, w_g, m_g)
                 z_full = z_out.swapaxes(0, 1).reshape(C, nb, B)
                 return (z_full[None, None], ndk_f[None], nwk_f[None],
                         nk_f, key_f[None, None])
 
-            mp_spec = (M,) if M else ()
             z, n_dk, n_wk, n_k, keys = _shard_map(
                 shard_fn, mesh=self.mesh,
                 in_specs=(P(D, *mp_spec), P(D), P(*mp_spec), P(),
@@ -297,43 +344,157 @@ class ShardedGibbsLDA:
                 n_acc=state.n_acc + jnp.int32(accumulate),
             )
 
+        def superstep_fn(state: ShardedGibbsState, docs, words, mask,
+                         start, n_steps: int, with_initial_ll=False):
+            """`n_steps` fused sweeps + the boundary predictive ll in
+            ONE program with ONE shard_map: the sweep chain runs as a
+            lax.scan INSIDE the shard region, the burn-in accumulate
+            fold rides the scan carry (sweep start+i accumulates iff
+            past burn_in, decided on device), and the final counts feed
+            the psum-reduced ll before anything returns to the host —
+            one dispatch and one sync per superstep instead of per
+            sweep (docs/PERF.md "the gibbs_fit vs sweep-microbench
+            gap"). `with_initial_ll` also evaluates ll on the INCOMING
+            counts (fit's pre-sweep history point) inside the same
+            program. Bit-identical to n_steps sweep_fn dispatches."""
+            def shard_fn(z, n_dk, n_wk, n_k, keys, accd, accw, nacc,
+                         d, w, m, start_s):
+                d_g, w_g, m_g, z_g, C, nb, B = _grouped(d, w, m, z)
+                zero = _pcast(jnp.float32(0), both, to="varying")
+                d0, w0, m0 = d[0, 0], w[0, 0], m[0, 0]
+                if with_initial_ll:
+                    nk0_v = _pcast(n_k, both, to="varying")
+                    sm0, t0 = _chain_ll_local(n_dk[0], n_wk[0], nk0_v,
+                                              d0, w0, m0, zero)
+                    sm0 = jax.lax.psum(sm0, both)
+                    t0 = jax.lax.psum(t0, both)
+
+                def one_sweep(carry, i):
+                    zg, ndk_r, nwk_r, nk_r, key_c, ad, aw, na = carry
+                    zg, ndk_r, nwk_r, nk_r, key_c = _group_sweep(
+                        zg, ndk_r, nwk_r, nk_r, key_c, d_g, w_g, m_g)
+                    do = start_s + i >= burn
+                    do_f = do.astype(jnp.float32)
+                    ad = ad + do_f * ndk_r.astype(jnp.float32)
+                    aw = aw + do_f * nwk_r.astype(jnp.float32)
+                    na = na + do.astype(jnp.int32)
+                    return (zg, ndk_r, nwk_r, nk_r, key_c,
+                            ad, aw, na), None
+
+                carry0 = (z_g, n_dk[0], n_wk[0], n_k, keys[0, 0],
+                          accd[0], accw[0], nacc)
+                (z_g2, ndk_f, nwk_f, nk_f, key_f, ad, aw, na), _ = \
+                    jax.lax.scan(one_sweep, carry0,
+                                 jnp.arange(n_steps, dtype=jnp.int32))
+                nk_v = _pcast(nk_f, both, to="varying")
+                sm, t = _chain_ll_local(ndk_f, nwk_f, nk_v,
+                                        d0, w0, m0, zero)
+                sm, t = jax.lax.psum(sm, both), jax.lax.psum(t, both)
+                z_full = z_g2.swapaxes(0, 1).reshape(C, nb, B)
+                outs = (z_full[None, None], ndk_f[None], nwk_f[None],
+                        nk_f, key_f[None, None], ad[None], aw[None],
+                        na, sm, t)
+                return outs + ((sm0, t0) if with_initial_ll else ())
+
+            out_specs = (P(D, *mp_spec), P(D), P(*mp_spec), P(),
+                         P(D, *mp_spec), P(D), P(*mp_spec), P(),
+                         P(), P())
+            if with_initial_ll:
+                out_specs = out_specs + (P(), P())
+            outs = _shard_map(
+                shard_fn, mesh=self.mesh,
+                in_specs=(P(D, *mp_spec), P(D), P(*mp_spec), P(),
+                          P(D, *mp_spec), P(D), P(*mp_spec), P(),
+                          P(D, *mp_spec), P(D, *mp_spec),
+                          P(D, *mp_spec), P()),
+                out_specs=out_specs,
+            )(state.z, state.n_dk, state.n_wk, state.n_k, state.keys,
+              state.acc_ndk, state.acc_nwk, state.n_acc,
+              docs, words, mask, jnp.asarray(start, jnp.int32))
+            z, n_dk, n_wk, n_k, keys, accd, accw, nacc, sm, t = outs[:10]
+            new_state = ShardedGibbsState(
+                z=z, n_dk=n_dk, n_wk=n_wk, n_k=n_k, keys=keys,
+                acc_ndk=accd, acc_nwk=accw, n_acc=nacc)
+            # Per-chain corpus mean ll, averaged over chains (the same
+            # series ll_fn exposes).
+            ll = (sm / jnp.maximum(t, 1.0)).mean()
+            if with_initial_ll:
+                sm0, t0 = outs[10:]
+                return new_state, (sm0 / jnp.maximum(t0, 1.0)).mean(), ll
+            return new_state, ll
+
+        def superstep_dp1_fn(state: ShardedGibbsState, docs, words, mask,
+                             start, n_steps: int, with_initial_ll=False):
+            """dp=1/mp=1 fast path: the identical superstep math with NO
+            shard_map/psum wrapping — at one device every psum is an
+            identity on integer deltas, so the collective wrapper buys
+            nothing and costs real time (docs/PERF.md r7). Bit-identical
+            to the shard_map path (asserted in
+            tests/test_sharded_gibbs.py), including under
+            sync_splits > 1, whose grouping is pure staleness
+            bookkeeping when there is nothing to be stale against."""
+            start_s = jnp.asarray(start, jnp.int32)
+            d0, w0, m0 = docs[0, 0], words[0, 0], mask[0, 0]
+            ll0 = None
+            if with_initial_ll:
+                sm0, t0 = _chain_ll_local(state.n_dk[0], state.n_wk[0],
+                                          state.n_k, d0, w0, m0,
+                                          jnp.float32(0))
+                ll0 = (sm0 / jnp.maximum(t0, 1.0)).mean()
+            block_step = lda_gibbs.make_block_step(
+                alpha=config.alpha, eta=config.eta, n_vocab=n_vocab,
+                k_topics=k)
+
+            def one_sweep(carry, i):
+                z, ndk, nwk, nk, keys, ad, aw, na = carry
+
+                def one_chain(zc, ndkc, nwkc, nkc, keyc):
+                    (ndkc, nwkc, nkc, keyc), zc = jax.lax.scan(
+                        block_step, (ndkc, nwkc, nkc, keyc),
+                        (d0, w0, m0, zc))
+                    return zc, ndkc, nwkc, nkc, keyc
+
+                z, ndk, nwk, nk, keys = jax.vmap(one_chain)(
+                    z, ndk, nwk, nk, keys)
+                do = start_s + i >= burn
+                do_f = do.astype(jnp.float32)
+                ad = ad + do_f * ndk.astype(jnp.float32)
+                aw = aw + do_f * nwk.astype(jnp.float32)
+                na = na + do.astype(jnp.int32)
+                return (z, ndk, nwk, nk, keys, ad, aw, na), None
+
+            carry0 = (state.z[0, 0], state.n_dk[0], state.n_wk[0],
+                      state.n_k, state.keys[0, 0],
+                      state.acc_ndk[0], state.acc_nwk[0], state.n_acc)
+            (z, ndk, nwk, nk, keys, ad, aw, na), _ = jax.lax.scan(
+                one_sweep, carry0, jnp.arange(n_steps, dtype=jnp.int32))
+            sm, t = _chain_ll_local(ndk, nwk, nk, d0, w0, m0,
+                                    jnp.float32(0))
+            new_state = ShardedGibbsState(
+                z=z[None, None], n_dk=ndk[None], n_wk=nwk[None], n_k=nk,
+                keys=keys[None, None], acc_ndk=ad[None],
+                acc_nwk=aw[None], n_acc=na)
+            ll = (sm / jnp.maximum(t, 1.0)).mean()
+            if with_initial_ll:
+                return new_state, ll0, ll
+            return new_state, ll
+
         def ll_fn(state: ShardedGibbsState, docs, words, mask):
             """Predictive mean log-likelihood from the CURRENT counts,
             computed where the data lives: per-shard token sums, then a
             psum — the convergence series the reference reads from
             lda-c's likelihood.dat (SURVEY.md §5.4–5.5), without
-            gathering θ or the corpus to the host."""
+            gathering θ or the corpus to the host. The fit loop now
+            evaluates ll inside the superstep program (superstep_fn);
+            this standalone form serves the initial (pre-sweep) point
+            and external callers."""
             def shard_fn(n_dk, n_wk, n_k, d, w, m):
                 n_k_v = _pcast(n_k, both, to="varying")
-                d0, w0, m0 = d[0, 0], w[0, 0], m[0, 0]
                 zero = _pcast(jnp.float32(0), both, to="varying")
-
-                def one_chain(ndkc, nwkc, nkc):
-                    ndk = ndkc.astype(jnp.float32)
-                    theta = ((ndk + config.alpha)
-                             / (ndk.sum(-1, keepdims=True)
-                                + k * config.alpha))
-                    nwk = nwkc.astype(jnp.float32)
-                    phi = ((nwk + config.eta)
-                           / (nkc.astype(jnp.float32)
-                              + n_vocab * config.eta))
-
-                    def block(carry, xs):
-                        s, t = carry
-                        db, wb, mb = xs
-                        p = jnp.sum(theta[db] * phi[wb], axis=-1)
-                        p = jnp.maximum(p, 1e-30)
-                        s = s + jnp.sum(mb * jnp.log(p))
-                        return (s, t + jnp.sum(mb)), None
-
-                    (s, t), _ = jax.lax.scan(
-                        block, (zero, zero), (d0, w0, m0))
-                    return s, t
-
-                s, t = jax.vmap(one_chain)(n_dk[0], n_wk[0], n_k_v)
+                s, t = _chain_ll_local(n_dk[0], n_wk[0], n_k_v,
+                                       d[0, 0], w[0, 0], m[0, 0], zero)
                 return jax.lax.psum(s, both), jax.lax.psum(t, both)
 
-            mp_spec = (M,) if M else ()
             s, t = _shard_map(
                 shard_fn, mesh=self.mesh,
                 in_specs=(P(D), P(*mp_spec), P(),
@@ -347,6 +508,22 @@ class ShardedGibbsLDA:
         self._sweep = jax.jit(sweep_fn, static_argnames=("accumulate",),
                               donate_argnums=(0,))
         self._ll = jax.jit(ll_fn)
+        # dp=1 fast path: engaged when the mesh has exactly one device
+        # (scale.py's single-chip configuration and every CPU run of the
+        # judged pipelines); ONIX_DP1_FAST=0 pins the shard_map form —
+        # the cross-check arm the equality tests compare against.
+        import os
+        self.dp1_fast = (self.n_data == 1 and self.n_mp == 1
+                         and os.environ.get("ONIX_DP1_FAST") != "0")
+        self._superstep = jax.jit(
+            superstep_dp1_fn if self.dp1_fast else superstep_fn,
+            static_argnames=("n_steps", "with_initial_ll"),
+            donate_argnums=(0,))
+        # The shard_map superstep stays constructible regardless, for
+        # the fast-path equality tests and the pre-PR bench arm (no
+        # donation: test callers reuse their input states).
+        self._superstep_shardmap = jax.jit(
+            superstep_fn, static_argnames=("n_steps", "with_initial_ll"))
         self._mp_axis = M
 
     # -- sharding specs ----------------------------------------------------
@@ -434,28 +611,54 @@ class ShardedGibbsLDA:
     # -- fit --------------------------------------------------------------
 
     def fit(self, corpus: Corpus, n_sweeps: int | None = None,
-            callback=None, checkpoint_dir=None, resume: bool = True) -> dict:
-        """Sharded sweep loop with optional checkpoint/resume — the
-        recovery story the reference's MPI job lacks (SURVEY.md §5.3: "an
-        MPI rank failure kills the LDA job"); mandatory for preemptible
-        TPU capacity. Mesh shape is part of the checkpoint fingerprint:
-        a state sharded dp=8 must not resume on a dp=4 mesh."""
+            callback=None, checkpoint_dir=None, resume: bool = True,
+            fault_inject_sweep: int | None = None) -> dict:
+        """Sharded fit loop as fused supersteps, with optional
+        checkpoint/resume — the recovery story the reference's MPI job
+        lacks (SURVEY.md §5.3: "an MPI rank failure kills the LDA job");
+        mandatory for preemptible TPU capacity.
+
+        Sweeps run S at a time inside one jitted program (one shard_map,
+        or the dp=1 fast path) with the burn-in accumulate fold and the
+        boundary ll on device; segment boundaries land exactly on
+        checkpoint/fault/final sweeps (lda_gibbs.plan_segments), so a
+        checkpoint is never demanded mid-superstep and every resume
+        point is an exact sweep boundary. Mesh shape AND superstep size
+        are part of the checkpoint fingerprint: a state sharded dp=8
+        must not resume on a dp=4 mesh, and a run fused at a different S
+        is refused rather than resumed into a different ll cadence.
+
+        `fault_inject_sweep` (or env ONIX_FAULT_SWEEP) raises
+        SimulatedPreemption right after completing that sweep — the
+        same §5.3 fault hook GibbsLDA has, so scale runs on the sharded
+        engine can exercise their resume path too."""
+        import os
+
         from onix import checkpoint as ckpt
+        from onix.models.lda_gibbs import SUPERSTEP_DEFAULT, plan_segments
+
+        if fault_inject_sweep is None:
+            env = os.environ.get("ONIX_FAULT_SWEEP")
+            fault_inject_sweep = int(env) if env else None
 
         cfg = self.config
         n_sweeps = cfg.n_sweeps if n_sweeps is None else n_sweeps
+        S_step = cfg.superstep or SUPERSTEP_DEFAULT
         sc = self.prepare(corpus)
         docs, words, mask = self.device_corpus(sc)
-        # layout=3: the chained state layout (chain axis C behind the
-        # shard axes on every array) — bumping it rejects checkpoints
-        # written by the earlier layouts instead of crashing on restore.
-        # n_chains is part of the config hash now that this engine
-        # reads it.
+        # layout=4: the fused-superstep layout — the jitted carry holds
+        # the accumulator state, checkpoints land only at superstep
+        # boundaries, and the superstep size joins the identity
+        # (checkpoint.fingerprint's superstep arg). layout=3 was the
+        # chained state layout (chain axis C behind the shard axes);
+        # bumping rejects earlier layouts instead of crashing on
+        # restore. n_chains is part of the config hash.
         fp = ckpt.fingerprint(cfg,
                               sc.doc_map.shape[0] * sc.n_docs_local,
                               sc.n_vocab, corpus.n_tokens,
                               extra={"mesh": list(self.mesh.shape.values()),
-                                     "layout": 3})
+                                     "layout": 4},
+                              superstep=S_step)
         if checkpoint_dir is not None:
             import pathlib
             checkpoint_dir = pathlib.Path(checkpoint_dir) / fp
@@ -468,22 +671,28 @@ class ShardedGibbsLDA:
                 start = saved.sweep + 1
         if state is None:
             state = self.init_state(sc)
-        ll_history = [(start - 1,
-                       float(self._ll(state, docs, words, mask)))]
-        for s in range(start, n_sweeps):
-            state = self._sweep(state, docs, words, mask,
-                                accumulate=s >= cfg.burn_in)
-            if (checkpoint_dir is not None and cfg.checkpoint_every > 0
-                    and (s + 1) % cfg.checkpoint_every == 0):
-                ckpt.save(checkpoint_dir, s,
-                          {k: np.asarray(v)
-                           for k, v in state._asdict().items()},
-                          {"fingerprint": fp, "engine": "sharded_gibbs"})
-            if s == n_sweeps - 1 or s % 10 == 9:
-                ll_history.append(
-                    (s, float(self._ll(state, docs, words, mask))))
-            if callback is not None:
-                callback(s, state)
+        from onix.models.lda_gibbs import run_fit_segments
+        segments = plan_segments(
+            start, n_sweeps, S_step,
+            checkpoint_every=(cfg.checkpoint_every
+                              if checkpoint_dir is not None else 0),
+            fault_sweep=fault_inject_sweep,
+            per_sweep=callback is not None)
+        state, ll_history = run_fit_segments(
+            state, start, segments,
+            superstep_fn=lambda st, s0, n, init: self._superstep(
+                st, docs, words, mask, s0, n_steps=n,
+                with_initial_ll=init),
+            initial_ll_fn=lambda st: self._ll(st, docs, words, mask),
+            checkpoint_every=cfg.checkpoint_every,
+            checkpoint_dir=checkpoint_dir,
+            save_fn=lambda st, s: ckpt.save(
+                checkpoint_dir, s,
+                {k: np.asarray(v) for k, v in st._asdict().items()},
+                {"fingerprint": fp, "engine": "sharded_gibbs"}),
+            fault_sweep=fault_inject_sweep,
+            notify=(None if callback is None
+                    else lambda s, st, ll: callback(s, st)))
         theta, phi_wk = self.estimates(state, sc, corpus.n_docs)
         return {"state": state, "sharded_corpus": sc,
                 "theta": theta, "phi_wk": phi_wk,

@@ -283,10 +283,17 @@ def run_scoring(cfg: OnixConfig, engine: str = "gibbs",
 
     # Run manifest (SURVEY.md §5.5: config hash, data partition, seed;
     # §5.1: the judged events-scored/sec is a first-class number).
+    from onix.models.lda_gibbs import SUPERSTEP_DEFAULT
     manifest = {
         "datatype": datatype, "date": date, "engine": engine,
         "config_hash": cfg.config_hash,
         "seed": cfg.lda.seed,
+        # Fit-loop structure (r7): Gibbs engines chain sweeps S at a
+        # time in one fused program; ll_history entries land at those
+        # superstep boundaries (plus the pre-sweep point). SVI ignores
+        # it.
+        "lda_superstep": (cfg.lda.superstep or SUPERSTEP_DEFAULT
+                          if engine in ("gibbs", "sharded") else None),
         "n_events": int(n_events),
         "n_docs": int(bundle.corpus.n_docs),
         "n_vocab": int(bundle.corpus.n_vocab),

@@ -150,6 +150,8 @@ def run_scale(n_events: int, n_hosts: int | None = None,
 
     t = time.monotonic()
     n_dev = len(jax.devices())
+    from onix.models.lda_gibbs import SUPERSTEP_DEFAULT
+
     # n_chains > 1: the judged restart-ensemble estimator on the
     # multi-chip engine (chain axis vmapped per device; the streaming
     # score path geometric-merges the chains in score_table) — the
@@ -159,7 +161,18 @@ def run_scale(n_events: int, n_hosts: int | None = None,
                     burn_in=max(1, n_sweeps // 2),
                     # 2^17 measured fastest on v5e (36.8M tokens/s vs
                     # 33.8M at 2^16, 26.5M at 2^18).
-                    block_size=1 << 17, seed=seed, n_chains=n_chains)
+                    block_size=1 << 17, seed=seed, n_chains=n_chains,
+                    # Sweep-granular resume INSIDE the fit stage: with a
+                    # resume_dir, checkpoint at every superstep boundary
+                    # (the fit loop's natural host-sync points) so a
+                    # tunnel window that dies mid-fit resumes at the
+                    # last completed superstep instead of repaying the
+                    # whole fit — the single longest atomic device
+                    # stage of the ~51-min 1B runs.
+                    checkpoint_every=(SUPERSTEP_DEFAULT
+                                      if resume_dir is not None else 0))
+    fit_ckpt_dir = (pathlib.Path(resume_dir) / "fit_ckpt"
+                    if resume_dir is not None else None)
     mesh = make_mesh(dp=n_dev, mp=1)
     model = ShardedGibbsLDA(cfg, corpus.n_vocab, mesh=mesh)
     saved_model = ckpt.load("model") if ckpt is not None else None
@@ -171,7 +184,7 @@ def run_scale(n_events: int, n_hosts: int | None = None,
         phi_wk = saved_model["phi_wk"]
         walls["gibbs_fit"] = float(saved_model["wall"])
     else:
-        fit = model.fit(corpus)
+        fit = model.fit(corpus, checkpoint_dir=fit_ckpt_dir)
         theta, phi_wk = fit["theta"], fit["phi_wk"]  # host np: synced
         walls["gibbs_fit"] = time.monotonic() - t
         if ckpt is not None:
@@ -250,6 +263,11 @@ def run_scale(n_events: int, n_hosts: int | None = None,
         "n_topics": n_topics,
         "n_sweeps": n_sweeps,
         "n_chains": n_chains,
+        # Fit-loop structure (r7): sweeps per fused dispatch, and
+        # whether the dp=1 shard_map bypass was engaged — the two knobs
+        # behind the gibbs_fit wall this manifest reports.
+        "lda_superstep": cfg.superstep or SUPERSTEP_DEFAULT,
+        "dp1_fast_path": bool(getattr(model, "dp1_fast", False)),
         "devices": [str(d) for d in jax.devices()],
         "mesh": dict(mesh.shape),
         "walls_seconds": {k: round(v, 2) for k, v in walls.items()},

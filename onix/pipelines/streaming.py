@@ -258,8 +258,13 @@ class StreamingScorer:
         # Cumulative per-stage walls (seconds) — the r03 streaming rate
         # was 300x under the batch scan with the host path unprofiled
         # (VERDICT r03 weak #6); every artifact now carries the split.
+        # prefetch_overlap/prefetch_wait account the one-deep conversion
+        # prefetch (ColumnPrefetcher): overlap = frame→columns seconds
+        # that ran hidden under the previous batch's step, wait = the
+        # residual the consumer still blocked on.
         self.stage_walls = {"words": 0.0, "ids": 0.0, "minibatch": 0.0,
-                            "svi_update": 0.0, "score": 0.0, "emit": 0.0}
+                            "svi_update": 0.0, "score": 0.0, "emit": 0.0,
+                            "prefetch_overlap": 0.0, "prefetch_wait": 0.0}
         # Which word path each batch rode (device fused vs host
         # reference) — artifacts report it next to the stage walls.
         self.words_mode_batches = {"device": 0, "host": 0}
@@ -401,7 +406,25 @@ class StreamingScorer:
 
     # -- the streaming step -----------------------------------------------
 
-    def _words(self, table: pd.DataFrame):
+    def convert_columns(self, table: pd.DataFrame) -> dict | None:
+        """frame → numeric columns, or None for frames the converter
+        rejects (malformed columns — those ride the string word path).
+
+        Pure host work on an immutable frame with NO scorer state read
+        or written (the columnar converters don't need the bin edges),
+        so it is safe to run on a prefetch thread while the previous
+        batch's device step occupies the main thread — ColumnPrefetcher
+        does exactly that and `process(table, cols=...)` consumes the
+        result without re-converting."""
+        from onix.pipelines import columnar
+
+        conv = columnar.FRAME_COLS[self.datatype]
+        try:
+            return conv(table)
+        except (ValueError, KeyError):
+            return None
+
+    def _words(self, table: pd.DataFrame, cols: dict | None = None):
         """One minibatch → WordTable, columnar-first.
 
         The frame converters do the per-UNIQUE-value string work and the
@@ -415,29 +438,28 @@ class StreamingScorer:
         way (both paths emit the same packed word_key)."""
         from onix.pipelines import columnar
 
-        conv = columnar.FRAME_COLS[self.datatype]
-        try:
-            cols = conv(table)
-        except (ValueError, KeyError):
+        if cols is None:
+            cols = self.convert_columns(table)
+        if cols is None:
             return self.word_fn(table, edges=self.edges)
         return columnar.words_from_cols(self.datatype, cols,
                                         edges=self.edges)
 
-    def _device_words(self, table: pd.DataFrame):
+    def _device_words(self, table: pd.DataFrame,
+                      cols: dict | None = None):
         """Fused device word path for one minibatch: columnar convert
-        (host, per-unique string work) → ONE jitted program for binning
-        + key packing + splitmix64 bucketing. Returns (bucket ids [T],
-        ip_u32 [T], event_idx [T]) in the host token layout, or None
-        when the batch must ride the host path (docstring list)."""
+        (host, per-unique string work — prefetchable, see
+        convert_columns) → ONE jitted program for binning + key packing
+        + splitmix64 bucketing. Returns (bucket ids [T], ip_u32 [T],
+        event_idx [T]) in the host token layout, or None when the batch
+        must ride the host path (docstring list)."""
         import jax.numpy as jnp
 
-        from onix.pipelines import columnar
         from onix.pipelines import device_words as dw
 
-        conv = columnar.FRAME_COLS[self.datatype]
-        try:
-            cols = conv(table)
-        except (ValueError, KeyError):
+        if cols is None:
+            cols = self.convert_columns(table)
+        if cols is None:
             return None
         if "ip_table" in cols:      # IPv6/non-canonical: string doc keys
             return None
@@ -496,17 +518,24 @@ class StreamingScorer:
                 and self.n_buckets & (self.n_buckets - 1) == 0
                 and not host_words_forced())
 
-    def process(self, table: pd.DataFrame) -> BatchResult:
-        """Word-create, model-update, and score one minibatch."""
+    def process(self, table: pd.DataFrame,
+                cols: dict | None = None) -> BatchResult:
+        """Word-create, model-update, and score one minibatch.
+
+        `cols` takes a pre-converted column dict from convert_columns
+        (the ColumnPrefetcher hands it over) so the ~30%-of-batch-wall
+        frame→columns host conversion (docs/PERF.md r6) that already ran
+        under the previous batch's device step is not paid again."""
         n_events = len(table)
         if n_events == 0:
             return BatchResult(np.empty(0), table.iloc[0:0].copy(), 0, 0,
                                int(self.state.step))
         t_stage = time.perf_counter
         t0 = t_stage()
-        dev = self._device_words(table) if self._device_eligible() else None
+        dev = (self._device_words(table, cols)
+               if self._device_eligible() else None)
         if dev is None:
-            words = self._words(table)
+            words = self._words(table, cols)
             if self.edges is None:
                 self.edges = words.edges   # frozen from the first batch on
         self.words_mode_batches["host" if dev is None else "device"] += 1
@@ -656,10 +685,67 @@ class StreamingScorer:
                            step=int(self.state.step))
 
 
+class ColumnPrefetcher:
+    """One-deep prefetch of the frame→columns host conversion.
+
+    The steady-state streaming batch spends ~30% of its wall in the
+    frame→columns conversion (docs/PERF.md r6) — pure host string/array
+    work that needs no scorer state — while the SVI/scoring step holds
+    the device. This iterator runs the NEXT batch's conversion (and,
+    when the source items are callables, its decode too) on a single
+    worker thread while the caller processes the current one, mirroring
+    the double-buffered `device_put` chunk staging in scale.py's
+    _stream_score. One-deep by design: peak memory stays at two frames.
+
+    `items` yields either DataFrames or zero-arg callables returning
+    DataFrames (the callable form moves file decode into the worker).
+    Yields (table, cols) pairs for `scorer.process(table, cols=cols)`;
+    cols is None for frames the converter rejects (the host word path
+    picks those up exactly as before). Overlap accounting lands in
+    scorer.stage_walls: "prefetch_overlap" is conversion wall hidden
+    under the previous batch, "prefetch_wait" the residual blocked on.
+    """
+
+    def __init__(self, scorer: StreamingScorer, items):
+        self.scorer = scorer
+        self.items = items
+
+    def __iter__(self):
+        import concurrent.futures as cf
+
+        def produce(item):
+            table = item() if callable(item) else item
+            t0 = time.perf_counter()
+            cols = self.scorer.convert_columns(table)
+            return table, cols, time.perf_counter() - t0
+
+        with cf.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="onix-prefetch") as pool:
+            fut = None
+            for item in self.items:
+                nxt = pool.submit(produce, item)
+                if fut is not None:
+                    yield self._resolve(fut)
+                fut = nxt
+            if fut is not None:
+                yield self._resolve(fut)
+
+    def _resolve(self, fut):
+        t0 = time.perf_counter()
+        table, cols, conv_wall = fut.result()
+        wait = time.perf_counter() - t0
+        walls = self.scorer.stage_walls
+        walls["prefetch_wait"] += wait
+        walls["prefetch_overlap"] += max(conv_wall - wait, 0.0)
+        return table, cols
+
+
 def run_stream(cfg: OnixConfig, datatype: str, paths: list[str],
                n_buckets: int = 1 << 15, epochs: int = 1) -> int:
     """CLI driver: each raw telemetry file is one minibatch — decode,
     update the model, score, append alerts to a per-day streaming CSV.
+    Decode + frame→columns conversion of batch i+1 overlap batch i's
+    model step via the one-deep ColumnPrefetcher.
 
     `epochs > 1` replays the file list (useful to burn in a model before
     leaving it running on live data)."""
@@ -681,31 +767,42 @@ def run_stream(cfg: OnixConfig, datatype: str, paths: list[str],
     done = scorer._batch_no
     if done:
         print(f"stream resume: skipping {done} already-processed batches")
-    batch_idx = 0
-    for epoch in range(epochs):
-        for p in paths:
-            batch_idx += 1
-            if batch_idx <= done:
-                continue
-            table = decode(datatype, p,
-                           apply_sampling=cfg.ingest.apply_sampling)
-            res = scorer.process(table)
-            total_events += res.n_events
-            if epoch == epochs - 1 and len(res.alerts):
-                # Alerts land in per-day files keyed like batch results.
-                from onix.ingest.run import _day_of
-                for date, rows in res.alerts.groupby(
-                        _day_of(datatype, res.alerts)):
-                    out = results_path(cfg.store.results_dir, datatype,
-                                       str(date))
-                    out = out.with_name(f"{datatype}_streaming.csv")
-                    out.parent.mkdir(parents=True, exist_ok=True)
-                    rows.to_csv(out, mode="a", index=False,
-                                header=not out.exists())
-                    total_alerts += len(rows)
-            print(f"[epoch {epoch}] {p}: {res.n_events} events, "
-                  f"{len(res.alerts)} alerts, {res.n_new_docs} new docs, "
-                  f"svi step {res.step}")
+
+    def batches():
+        """(epoch, path, decode-thunk) for every batch left to process;
+        the thunk runs on the prefetch worker, so file decode rides
+        under the previous batch's step too."""
+        batch_idx = 0
+        for epoch in range(epochs):
+            for p in paths:
+                batch_idx += 1
+                if batch_idx <= done:
+                    continue
+                yield (epoch, p,
+                       lambda p=p: decode(
+                           datatype, p,
+                           apply_sampling=cfg.ingest.apply_sampling))
+
+    todo = list(batches())
+    prefetched = ColumnPrefetcher(scorer, (thunk for _, _, thunk in todo))
+    for (epoch, p, _), (table, cols) in zip(todo, prefetched):
+        res = scorer.process(table, cols=cols)
+        total_events += res.n_events
+        if epoch == epochs - 1 and len(res.alerts):
+            # Alerts land in per-day files keyed like batch results.
+            from onix.ingest.run import _day_of
+            for date, rows in res.alerts.groupby(
+                    _day_of(datatype, res.alerts)):
+                out = results_path(cfg.store.results_dir, datatype,
+                                   str(date))
+                out = out.with_name(f"{datatype}_streaming.csv")
+                out.parent.mkdir(parents=True, exist_ok=True)
+                rows.to_csv(out, mode="a", index=False,
+                            header=not out.exists())
+                total_alerts += len(rows)
+        print(f"[epoch {epoch}] {p}: {res.n_events} events, "
+              f"{len(res.alerts)} alerts, {res.n_new_docs} new docs, "
+              f"svi step {res.step}")
     print(f"stream done: {total_events} events, {total_alerts} alerts, "
           f"{len(scorer.pad_shapes)} compiled shapes")
     return 0

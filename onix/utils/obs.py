@@ -164,6 +164,17 @@ def device_peak_bytes_per_s() -> tuple[float | None, str]:
     return None, f"unknown device kind {kind!r}"
 
 
+def gibbs_sweep_bytes_per_token(k_topics: int) -> float:
+    """Modeled memory traffic per sampled token (docs/PERF.md roofline):
+    n_dk[d] and n_wk[w] row read + scatter write-back (4·K·4 B) plus the
+    token stream (d, w, z: 12 B). Shared by bench.py's gibbs_sweep AND
+    gibbs_fit_effective roofline entries — the fit loop samples the same
+    tokens through the same sweep kernel, so a widening gap between the
+    two fractions is fit-loop overhead (dispatch, ll evals, wrapping),
+    which is exactly the number the superstep work tracks."""
+    return 4 * k_topics * 4 + 12
+
+
 def roofline(n_items: int, wall_s: float, bytes_per_item: float,
              peak_bytes_per_s: float | None) -> dict:
     """One component's roofline entry: achieved bytes/s from the

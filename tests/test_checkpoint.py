@@ -103,6 +103,48 @@ def test_fingerprint_mismatch_starts_fresh(tmp_path):
     _assert_states_equal(clean["state"], other["state"])
 
 
+def test_superstep_mismatch_refuses_resume(tmp_path):
+    """A checkpoint written under superstep size S must be REFUSED by a
+    run fused at a different S (same sampler identity, different ll
+    cadence/artifact): the resolved size is part of the fingerprint, so
+    the mismatched run starts in its own per-fingerprint subdir instead
+    of silently adopting foreign progress."""
+    corpus = _corpus()
+    cfg_s2 = _cfg(n_sweeps=6, checkpoint_every=2, superstep=2)
+    cfg_s3 = _cfg(n_sweeps=6, checkpoint_every=2, superstep=3)
+    # Direct fingerprint refusal (the mechanism under test).
+    assert (ckpt.fingerprint(cfg_s2, 60, 80, 100, superstep=2)
+            != ckpt.fingerprint(cfg_s2, 60, 80, 100, superstep=3))
+
+    GibbsLDA(cfg_s2, corpus.n_docs, corpus.n_vocab).fit(
+        corpus, checkpoint_dir=tmp_path)
+    dirs_after_s2 = {p.name for p in tmp_path.iterdir() if p.is_dir()}
+    GibbsLDA(cfg_s3, corpus.n_docs, corpus.n_vocab).fit(
+        corpus, checkpoint_dir=tmp_path)
+    dirs_after_s3 = {p.name for p in tmp_path.iterdir() if p.is_dir()}
+    # The S=3 run created a NEW fingerprint subdir (no adoption), and
+    # the S=2 run's checkpoints are untouched.
+    assert len(dirs_after_s3) == len(dirs_after_s2) + 1
+    assert dirs_after_s2 <= dirs_after_s3
+
+
+def test_sharded_fault_inject_resumes(tmp_path, eight_devices):
+    """ONIX_FAULT_SWEEP-style fault injection on the SHARDED engine
+    (added with the superstep loop): the segment ends exactly at the
+    fault sweep, the checkpoint written there resumes bit-identically."""
+    corpus = _corpus(seed=5)
+    cfg = _cfg(n_sweeps=10, burn_in=5, checkpoint_every=4)
+    mesh = make_mesh(dp=2, mp=1)
+    ref = ShardedGibbsLDA(cfg, corpus.n_vocab, mesh=mesh).fit(corpus)
+
+    with pytest.raises(ckpt.SimulatedPreemption):
+        ShardedGibbsLDA(cfg, corpus.n_vocab, mesh=mesh).fit(
+            corpus, checkpoint_dir=tmp_path, fault_inject_sweep=7)
+    resumed = ShardedGibbsLDA(cfg, corpus.n_vocab, mesh=mesh).fit(
+        corpus, checkpoint_dir=tmp_path)
+    _assert_states_equal(ref["state"], resumed["state"])
+
+
 def test_sharded_resume_is_bit_identical(tmp_path, eight_devices):
     corpus = _corpus(seed=4)
     cfg = _cfg()

@@ -1,0 +1,33 @@
+"""Tier-1 smoke of the fit-gap isolation harness (scripts/exp_fit_gap.py).
+
+The harness is the decision table behind the n_wk matmul gate and the
+superstep adoption (docs/PERF.md "the gibbs_fit vs sweep-microbench
+gap"), but its full shapes only run inside TPU tunnel windows — which
+can be weeks apart. This tiny-shape invocation (n_docs≈200, V≈64-scale)
+runs in the fast suite so the harness cannot rot in between: every arm
+must execute, emit its rate, and the superstep arm must stay
+bit-identical to the per-sweep loop (asserted inside the script).
+"""
+
+import json
+
+
+def test_exp_fit_gap_tiny_shape_runs_all_arms(tmp_path):
+    from scripts.exp_fit_gap import main
+
+    out_path = tmp_path / "fitgap.json"
+    rc = main(["4000", "--hosts", "200", "--sweeps", "2",
+               "--block", "512", "--out", str(out_path)])
+    assert rc == 0
+    doc = json.loads(out_path.read_text())
+    # Tiny shape, as specified: ~200 docs, small product vocabulary.
+    assert doc["n_docs"] == 200
+    assert doc["n_vocab"] < 1024
+    # Every isolation arm produced a number (the rot this smoke
+    # prevents is an arm silently breaking between TPU windows).
+    for arm in ("sharded_dp1_fast", "sharded_dp1_shardmap",
+                "plain_single", "all_accumulate", "no_accumulate",
+                "per_sweep_loop", "superstep_loop", "raw_sweeps_no_fit",
+                "raw_nwk_scatter", "raw_nwk_matmul"):
+        assert doc[arm]["wall_s"] >= 0.0, arm
+    assert doc["nwk_collision_density"] > 0

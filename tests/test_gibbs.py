@@ -120,6 +120,67 @@ def test_multi_chain_deterministic():
     np.testing.assert_allclose(r1["phi_wk"], r2["phi_wk"], rtol=1e-6)
 
 
+@pytest.mark.parametrize("n_chains", [1, 2])
+def test_superstep_bit_identical_to_sequential_sweeps(n_chains):
+    """The S-sweep fused superstep (one program, accumulate fold and ll
+    on device) vs S sequential single-sweep dispatches: same key stream
+    → same z sequence, same counts, same posterior-mean accumulators —
+    including across the burn-in boundary, which the superstep decides
+    from the traced sweep counter instead of a static flag."""
+    from onix.models.lda_gibbs import init_chains, init_state
+
+    corpus, _, _ = synthetic_lda_corpus(40, 50, 3, mean_doc_len=25, seed=3)
+    cfg = LDAConfig(n_topics=3, n_sweeps=6, burn_in=3, block_size=256,
+                    seed=5, n_chains=n_chains)
+    model = GibbsLDA(cfg, corpus.n_docs, corpus.n_vocab)
+    docs, words, mask = model.prepare(corpus)
+
+    def fresh():
+        if n_chains == 1:
+            return init_state(docs, words, mask, corpus.n_docs,
+                              corpus.n_vocab, cfg.n_topics, cfg.seed)
+        return init_chains(docs, words, mask, corpus.n_docs,
+                           corpus.n_vocab, cfg.n_topics, cfg.seed,
+                           n_chains)
+
+    seq = fresh()
+    for s in range(cfg.n_sweeps):
+        seq = model._sweep(seq, docs, words, mask,
+                           accumulate=s >= cfg.burn_in)
+
+    fused, ll = model._superstep(fresh(), docs, words, mask, 0,
+                                 n_steps=cfg.n_sweeps)
+    for name in seq._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(seq, name)),
+            np.asarray(getattr(fused, name)),
+            err_msg=f"{name} diverged between fused and sequential")
+    assert np.isfinite(float(ll))
+
+    # Segmentation independence: two supersteps of 3 land on the same
+    # state as one of 6 (resume boundaries can fall anywhere).
+    half, _ = model._superstep(fresh(), docs, words, mask, 0, n_steps=3)
+    half, _ = model._superstep(half, docs, words, mask, 3, n_steps=3)
+    for name in seq._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(seq, name)),
+            np.asarray(getattr(half, name)),
+            err_msg=f"{name} diverged across superstep segmentation")
+
+
+def test_fit_ll_history_lands_on_superstep_boundaries():
+    """ll_history semantics survive the fused loop: the pre-sweep point,
+    then one entry per superstep boundary, final sweep always last —
+    the auto size (10) reproduces the old every-10-sweeps cadence."""
+    corpus, _, _ = synthetic_lda_corpus(30, 40, 3, mean_doc_len=20, seed=1)
+    cfg = LDAConfig(n_topics=3, n_sweeps=12, burn_in=6, block_size=256,
+                    seed=2, superstep=4)
+    fit = GibbsLDA(cfg, corpus.n_docs, corpus.n_vocab).fit(corpus)
+    sweeps = [s for s, _ in fit["ll_history"]]
+    assert sweeps == [-1, 3, 7, 11]
+    assert all(np.isfinite(ll) for _, ll in fit["ll_history"])
+
+
 def test_nwk_matmul_form_bit_identical():
     """The MXU one-hot-matmul n_wk delta must equal the scatter form
     bit for bit over full sweeps (it is exact integer math in f32 —

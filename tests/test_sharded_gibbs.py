@@ -263,6 +263,86 @@ def test_multislice_checkpoint_resume(eight_devices, corpus_and_truth,
     np.testing.assert_allclose(ref["phi_wk"], resumed["phi_wk"], rtol=1e-5)
 
 
+# ---------------------------------------------------------------------------
+# fused supersteps + the dp=1 fast path (r7: close the gibbs_fit gap)
+# ---------------------------------------------------------------------------
+
+
+def _states_equal(a, b, context):
+    for name in a._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a, name)), np.asarray(getattr(b, name)),
+            err_msg=f"{name} diverged ({context})")
+
+
+@pytest.mark.parametrize("dp", [1, 2])
+def test_sharded_superstep_bit_identical_to_sequential(eight_devices,
+                                                       corpus_and_truth,
+                                                       dp):
+    """S fused sweeps in ONE program (sweep scan inside the shard
+    region, accumulate fold in the carry, boundary ll fused) vs S
+    sequential _sweep dispatches — same key stream, same z sequence,
+    same counts/accumulators, at dp=1 and dp=2."""
+    corpus, _, _ = corpus_and_truth
+    cfg = _cfg(n_sweeps=6, burn_in=3)
+    mesh = make_mesh(dp=dp, mp=1, devices=jax.devices()[:dp])
+    model = ShardedGibbsLDA(cfg, corpus.n_vocab, mesh=mesh)
+    sc = model.prepare(corpus)
+    docs, words, mask = model.device_corpus(sc)
+
+    seq = model.init_state(sc)
+    for s in range(cfg.n_sweeps):
+        seq = model._sweep(seq, docs, words, mask,
+                           accumulate=s >= cfg.burn_in)
+
+    # _superstep_shardmap is undonated, so the input state is reusable;
+    # at dp=1 the engine's default _superstep is the fast path and gets
+    # its own equality test below.
+    fused, ll = model._superstep_shardmap(model.init_state(sc), docs,
+                                          words, mask, 0,
+                                          n_steps=cfg.n_sweeps)
+    _states_equal(seq, fused, f"fused vs sequential, dp={dp}")
+    assert np.isfinite(float(ll))
+
+    # Segmentation independence: 3+3 lands on the same state as 6.
+    half, _ = model._superstep_shardmap(model.init_state(sc), docs,
+                                        words, mask, 0, n_steps=3)
+    half, _ = model._superstep_shardmap(half, docs, words, mask, 3,
+                                        n_steps=3)
+    _states_equal(seq, half, f"superstep segmentation, dp={dp}")
+
+
+def test_dp1_fast_path_matches_shard_map(eight_devices, corpus_and_truth):
+    """The dp=1 fast path (no shard_map/psum wrapping) must be
+    bit-identical to the shard_map form — same z, counts, accumulators,
+    and the same boundary ll — including with chains and sync_splits
+    engaged (both are pure bookkeeping at one device)."""
+    corpus, _, _ = corpus_and_truth
+    cfg = _cfg(n_sweeps=5, burn_in=2, n_chains=2, sync_splits=2)
+    mesh = make_mesh(dp=1, mp=1, devices=jax.devices()[:1])
+    model = ShardedGibbsLDA(cfg, corpus.n_vocab, mesh=mesh)
+    assert model.dp1_fast        # default on a one-device mesh
+    sc = model.prepare(corpus)
+    docs, words, mask = model.device_corpus(sc)
+
+    fast, ll_fast = model._superstep(model.init_state(sc), docs, words,
+                                     mask, 0, n_steps=cfg.n_sweeps)
+    wrapped, ll_map = model._superstep_shardmap(
+        model.init_state(sc), docs, words, mask, 0, n_steps=cfg.n_sweeps)
+    _states_equal(wrapped, fast, "dp=1 fast path vs shard_map")
+    np.testing.assert_allclose(float(ll_fast), float(ll_map), rtol=1e-6)
+
+
+def test_dp1_fast_env_escape(eight_devices, corpus_and_truth, monkeypatch):
+    """ONIX_DP1_FAST=0 pins the shard_map form (the cross-check arm)."""
+    corpus, _, _ = corpus_and_truth
+    monkeypatch.setenv("ONIX_DP1_FAST", "0")
+    model = ShardedGibbsLDA(_cfg(), corpus.n_vocab,
+                            mesh=make_mesh(dp=1, mp=1,
+                                           devices=jax.devices()[:1]))
+    assert not model.dp1_fast
+
+
 @pytest.mark.parametrize("splits", [2, 4])
 def test_sync_splits_count_invariants(eight_devices, corpus_and_truth,
                                       splits):
