@@ -278,6 +278,82 @@ def bench_gibbs_sweep(jax, jnp, small=False, n_vocab=4_096):
     }
 
 
+def bench_gibbs_sweep_pallas(jax, jnp, small=False, n_vocab=512):
+    """gibbs_sweep_pallas: the Pallas fused sample+count block step
+    (onix/models/pallas_gibbs.py) vs the scatter reference, raw chained
+    sweeps at the judged product-vocabulary shape — the collision-dense
+    regime where docs/PERF.md measured the n_wk scatter as the sweep's
+    ceiling. Bit-identity of the two arms is asserted every run (same
+    key stream → same z and counts), so the pallas rate can never
+    silently come from a different sampler.
+
+    Off-TPU the kernel runs its interpret-mode emulation (plain XLA
+    lowering of the kernel code): the reported rate is a correctness/
+    regression diagnostic, NOT a kernel speed claim — `pallas_mode`
+    says which one this artifact measured. The compiled-Mosaic row is
+    queued in docs/TPU_QUEUE.json."""
+    from onix.models.lda_gibbs import init_state, make_block_step
+
+    n_docs, k = (50_000 if small else 200_000), 20
+    n_tokens = 1 << 19 if small else 1 << 23
+    block = 1 << 14 if small else 1 << 17
+    reps = 2 if small else 4
+
+    rng = np.random.default_rng(0)
+    nb = n_tokens // block
+    docs = jnp.asarray(rng.integers(0, n_docs, n_tokens)
+                       .astype(np.int32).reshape(nb, block))
+    words = jnp.asarray(rng.integers(0, n_vocab, n_tokens)
+                        .astype(np.int32).reshape(nb, block))
+    mask = jnp.ones((nb, block), jnp.float32)
+
+    def timed(form):
+        step = make_block_step(alpha=1.2, eta=0.01, n_vocab=n_vocab,
+                               k_topics=k, nwk_form=form)
+
+        @jax.jit
+        def bench(carry, z):
+            def one(cz, _):
+                c, z = cz
+                c, z = jax.lax.scan(step, c, (docs, words, mask, z))
+                return (c, z), None
+            (carry, z), _ = jax.lax.scan(one, (carry, z),
+                                         jnp.arange(reps))
+            return carry, z
+
+        st = init_state(docs, words, mask, n_docs, n_vocab, k, seed=0)
+        carry, z = bench((st.n_dk, st.n_wk, st.n_k, st.key), st.z)
+        np.asarray(carry[2])          # compile + settle
+        t0 = time.perf_counter()
+        carry, z = bench(carry, z)
+        nwk = np.asarray(carry[1])    # forces completion
+        zh = np.asarray(z)
+        dt = time.perf_counter() - t0
+        assert int(np.asarray(carry[2]).sum()) == n_tokens
+        return dt, nwk, zh
+
+    dt_ref, nwk_ref, z_ref = timed("scatter")
+    dt_pal, nwk_pal, z_pal = timed("pallas")
+    identical = (bool(np.array_equal(nwk_ref, nwk_pal))
+                 and bool(np.array_equal(z_ref, z_pal)))
+    assert identical, "pallas arm diverged from the scatter reference"
+    return {
+        "tokens_sampled_per_sec_per_chip": round(reps * n_tokens / dt_pal,
+                                                 1),
+        "tokens_sampled_per_sec_scatter_ref": round(
+            reps * n_tokens / dt_ref, 1),
+        "arms_bit_identical": identical,
+        "pallas_mode": ("compiled(mosaic)"
+                        if jax.default_backend() == "tpu"
+                        else "interpret(emulated)"),
+        "n_tokens": n_tokens, "sweeps_in_one_program": reps,
+        "n_docs": n_docs, "n_vocab": n_vocab, "n_topics": k,
+        "block_size": block,
+        "wall_seconds": round(dt_pal, 3),
+        "wall_seconds_scatter_ref": round(dt_ref, 3),
+    }
+
+
 def bench_gibbs_fit(jax, jnp, small=False):
     """gibbs_fit_effective: the FIT LOOP's effective tokens/s on the
     production engine — ShardedGibbsLDA at dp=1, the configuration
@@ -458,6 +534,22 @@ def _roofline_detail(detail: dict) -> dict | None:
         out["gibbs_sweep"] = roofline(
             gs["sweeps_in_one_program"] * gs["n_tokens"],
             gs["wall_seconds"], gibbs_sweep_bytes_per_token(k), peak)
+    gp = detail.get("gibbs_sweep_pallas")
+    if isinstance(gp, dict) and "wall_seconds" in gp:
+        # The fused-kernel byte model (obs.gibbs_pallas_bytes_per_token)
+        # replaces the scatter write-back with noise rows + the
+        # amortized dense delta flush; see docs/PERF.md "Pallas fused
+        # sample+count". Off-TPU the wall is interpret-mode emulation,
+        # so the fraction is a tracked diagnostic, not an efficiency
+        # claim (gp["pallas_mode"] records which).
+        from onix.utils.obs import gibbs_pallas_bytes_per_token
+        out["gibbs_sweep_pallas"] = roofline(
+            gp["sweeps_in_one_program"] * gp["n_tokens"],
+            gp["wall_seconds"],
+            gibbs_pallas_bytes_per_token(gp.get("n_topics", 20),
+                                         gp.get("n_vocab", 512),
+                                         gp.get("block_size", 1 << 17)),
+            peak)
     gf = detail.get("gibbs_fit_effective")
     if isinstance(gf, dict) and "wall_seconds" in gf:
         # Same byte model as the sweep kernel — the fit loop samples
@@ -514,6 +606,9 @@ def _probe_backend_poll(probe_deadline_ts: float, interval_s: float = 90.0,
     every probe's latency is recorded: a dead-tunnel round costs ~6
     probes instead of 17 and the artifact shows exactly where the probe
     wall went.
+    The per-probe subprocess timeout is additionally clamped to the
+    time left before `probe_deadline_ts`, so a tight ONIX_PROBE_BUDGET_S
+    cap (see _measure) bounds even a single hanging probe.
     Returns (platform | None, error | None, probes: dict) where probes
     carries {"n", "latencies_s", "total_wall_s"} for `detail`."""
     n = 0
@@ -524,7 +619,8 @@ def _probe_backend_poll(probe_deadline_ts: float, interval_s: float = 90.0,
     while True:
         n += 1
         t_probe = time.time()
-        platform, err = _probe_backend()
+        timeout = max(5.0, min(75.0, probe_deadline_ts - t_probe))
+        platform, err = _probe_backend(timeout)
         latencies.append(round(time.time() - t_probe, 2))
         probes = {"n": n, "latencies_s": latencies,
                   "total_wall_s": round(time.time() - t0, 2)}
@@ -651,7 +747,18 @@ def _measure() -> None:
     deadline_s = float(os.environ.get("ONIX_BENCH_TIMEOUT_S", "2400"))
     t0 = float(os.environ.get("_ONIX_BENCH_T0", time.time()))
     probe_deadline = t0 + 0.62 * deadline_s
+    # ONIX_PROBE_BUDGET_S caps the TOTAL probe wall independently of the
+    # bench deadline: BENCH_r05 burned 17 probes (~21 min) against a
+    # dead tunnel before falling back to CPU shapes. The cap and the
+    # probes actually used both land in detail.backend_probes so the
+    # artifact shows where the probe wall went.
+    probe_budget = os.environ.get("ONIX_PROBE_BUDGET_S")
+    if probe_budget:
+        probe_deadline = min(probe_deadline,
+                             time.time() + float(probe_budget))
     platform, probe_err, probes = _probe_backend_poll(probe_deadline)
+    if probe_budget:
+        probes["budget_s"] = float(probe_budget)
     fallback = platform is None or platform == "cpu"
 
     import jax
@@ -668,7 +775,7 @@ def _measure() -> None:
     detail = {"platform": platform or "cpu (fallback: backend unavailable)"}
     if probe_err:
         detail["backend_error"] = probe_err
-    if probes["n"] > 1 or probe_err:
+    if probes["n"] > 1 or probe_err or "budget_s" in probes:
         # Probe accounting (round-5 lesson: 17 silent 75 s timeouts):
         # count, per-probe latency, and total probe wall, so a dead-
         # tunnel round is diagnosable from the artifact alone.
@@ -737,6 +844,12 @@ def _measure() -> None:
     run("gibbs_sweep", lambda: bench_gibbs_sweep(jax, jnp, small=fallback))
     run("gibbs_sweep_product_vocab",
         lambda: bench_gibbs_sweep(jax, jnp, small=fallback, n_vocab=512))
+    # The Pallas fused sample+count kernel at the same product-vocab
+    # shape, bit-identity asserted against the scatter arm every run
+    # (off-TPU it measures the interpret emulation — pallas_mode says
+    # which; the compiled row is queued in docs/TPU_QUEUE.json).
+    run("gibbs_sweep_pallas",
+        lambda: bench_gibbs_sweep_pallas(jax, jnp, small=fallback))
     # The fit LOOP at the same product-vocab shape: effective tokens/s
     # through the superstep fit vs the pre-r7 per-sweep loop, so the
     # fit-vs-microbench gap is a tracked number with its own roofline

@@ -78,6 +78,15 @@ class LDAConfig:
     # Part of the checkpoint fingerprint: resuming under a different
     # superstep is refused, not silently different.
     superstep: int = 0
+    # n_wk count-update form inside the Gibbs block step: "auto" picks
+    # per backend + collision density at trace time (the measured gate,
+    # lda_gibbs.select_nwk_form — scatter on CPU, MXU one-hot matmul on
+    # TPU at density >= 32, the Pallas fused sample+count kernel once
+    # its TPU crossover lands in _NWK_PALLAS_MIN_DENSITY). Explicit
+    # values pin one form; all three are bit-identical (tested), so
+    # this knob is pure performance — it is NOT part of the checkpoint
+    # fingerprint and may change across a resume.
+    nwk_form: str = "auto"
 
     def validate(self) -> None:
         if self.n_topics < 2:
@@ -102,6 +111,10 @@ class LDAConfig:
             raise ValueError("sync_splits must be >= 1")
         if self.superstep < 0:
             raise ValueError("superstep must be >= 0 (0 = auto)")
+        if self.nwk_form not in ("auto", "scatter", "matmul", "pallas"):
+            raise ValueError(
+                "lda.nwk_form must be auto|scatter|matmul|pallas, "
+                f"got {self.nwk_form!r}")
 
 
 @dataclass

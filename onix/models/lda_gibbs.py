@@ -171,38 +171,157 @@ _NWK_MATMUL_MAX_ELEMS = 1 << 27
 # Unmeasured accelerators (gpu) get no entry and keep the scatter —
 # the same "measured platforms only" policy as scoring's bf16 gate.
 _NWK_MATMUL_MIN_DENSITY = {"tpu": 32.0}
+# Third arm of the n_wk gate: the Pallas fused sample+count kernel
+# (onix/models/pallas_gibbs.py) — removes the scatter's collision
+# serialization entirely (per-tile MXU count-merge into a VMEM-resident
+# accumulator) instead of out-muscling it with the HBM one-hot matmul.
+# Same "measured platforms only" policy: the table is EMPTY until the
+# queued TPU rows land (docs/TPU_QUEUE.json `fitgap_tpu` measures
+# scatter vs matmul vs pallas on the judged shape; the crossover
+# density lands here, expected to sit at/below the matmul's 32). Until
+# then the kernel is reachable via nwk_form="pallas" /
+# ONIX_NWK_FORM=pallas (and runs interpret-mode bit-identity in
+# tier-1), so the default path on every backend is unchanged.
+_NWK_PALLAS_MIN_DENSITY: dict[str, float] = {}
+
+
+def env_nwk_form() -> str | None:
+    """Resolve the ONIX_NWK_FORM experiment override. "auto" (and
+    empty) mean None — the same spelling LDAConfig.nwk_form accepts for
+    "defer to the measured gate" — so exporting ONIX_NWK_FORM=auto
+    resets an inherited override instead of crashing; anything else is
+    validated by select_nwk_form at trace time. Read this ONCE per
+    engine/trace decision: the sharded engine keys its shard_map
+    replication-check drop off the same resolved value it samples with,
+    so the two can never disagree mid-session."""
+    import os
+    env = os.environ.get("ONIX_NWK_FORM")
+    if not env or env == "auto":
+        return None
+    return env
+
+
+def select_nwk_form(*, backend: str, block_size: int, n_rows: int,
+                    nwk_matmul: bool | None = None,
+                    nwk_form: str | None = None) -> str:
+    """Trace-time decision for the n_wk count-update form — the single
+    gate shared by every engine (tests/test_pallas_gibbs.py exercises
+    its edge cases directly).
+
+    Priority: explicit `nwk_form` ("scatter" | "matmul" | "pallas"),
+    then the legacy `nwk_matmul` bool, then the measured per-backend
+    collision-density tables (density = block_size / n_rows expected
+    colliding row-updates per count row per block) bounded by the
+    exactness/memory caps. All three forms are bit-identical; this
+    picks the measured-fastest one for the platform and shape.
+    """
+    if nwk_form is not None:
+        if nwk_form not in ("scatter", "matmul", "pallas"):
+            raise ValueError(
+                f"nwk_form must be scatter|matmul|pallas, got {nwk_form!r}")
+        return nwk_form
+    if nwk_matmul is not None:
+        return "matmul" if nwk_matmul else "scatter"
+    pallas_density = _NWK_PALLAS_MIN_DENSITY.get(backend)
+    if (pallas_density is not None
+            and block_size >= pallas_density * n_rows
+            and n_rows <= _NWK_MATMUL_MAX_V):
+        return "pallas"
+    min_density = _NWK_MATMUL_MIN_DENSITY.get(backend)
+    if (min_density is not None
+            and block_size >= min_density * n_rows
+            and n_rows <= _NWK_MATMUL_MAX_V
+            # Exactness bound: every output of the f32 accumulation is
+            # a sum of block_size {-1,0,1} terms, so |output| <=
+            # block_size must stay below 2^24 or integers stop being
+            # representable exactly. MAX_ELEMS implies it for V >= 8
+            # only; the explicit bound covers tiny-V/huge-B days.
+            and block_size < (1 << 24)
+            and block_size * n_rows <= _NWK_MATMUL_MAX_ELEMS):
+        return "matmul"
+    return "scatter"
 
 
 def make_block_step(*, alpha: float, eta: float, n_vocab: int,
-                    k_topics: int, nwk_matmul: bool | None = None):
+                    k_topics: int, nwk_matmul: bool | None = None,
+                    nwk_form: str | None = None,
+                    sampler: str | None = None):
     """The collapsed-Gibbs block sampler shared by the single-device and
     sharded engines — one definition so the documented dp=1 equivalence
     can never silently diverge.
 
     carry = (n_dk, n_wk, n_k, key); xs = (docs, words, mask, z_old).
 
-    `nwk_matmul`: force the n_wk-delta form (True = one-hot matmul,
-    False = scatter-add); None picks at trace time — matmul on
-    accelerator backends when the n_wk table width is at most
-    _NWK_MATMUL_MAX_V (ONIX_NWK_MATMUL=0/1 overrides for experiments).
-    Both forms produce bit-identical int32 counts.
+    `nwk_form`: force the n_wk count-update form ("scatter" |
+    "matmul" | "pallas"); `nwk_matmul` is the legacy bool spelling
+    (True = matmul, False = scatter). None picks at trace time via
+    `select_nwk_form` — the measured per-backend collision-density gate
+    (ONIX_NWK_FORM / ONIX_NWK_MATMUL override for experiments). All
+    forms produce bit-identical int32 counts and the same z stream.
+
+    `sampler`: force the categorical draw form ("gumbel" | "race");
+    None keeps the measured per-backend pick (gumbel on accelerators,
+    race on CPU — docs/PERF.md "exponential race"). Test-only knob: it
+    lets CPU tier-1 assert the TPU sampler's math bit-for-bit.
     """
     v_eta = n_vocab * eta
     # Sampler form is picked once at trace time; it is a platform
     # property, not runtime state, so the traced program is static.
     backend = jax.default_backend()
-    use_gumbel = backend not in ("cpu",)
-    min_density = _NWK_MATMUL_MIN_DENSITY.get(backend)
-    if nwk_matmul is None:
-        import os
-        env = os.environ.get("ONIX_NWK_MATMUL")
-        if env in ("0", "1"):
-            nwk_matmul = env == "1"
+    if sampler is None:
+        use_gumbel = backend not in ("cpu",)
+    elif sampler in ("gumbel", "race"):
+        use_gumbel = sampler == "gumbel"
+    else:
+        raise ValueError(f"sampler must be gumbel|race, got {sampler!r}")
+    import os
+    # Env overrides apply only when the caller passed NO explicit form
+    # (either spelling) — an explicit nwk_matmul/nwk_form argument must
+    # outrank an exported experiment override, or the test arms that
+    # pin forms would silently compare a form against itself.
+    if nwk_form is None and nwk_matmul is None:
+        nwk_form = env_nwk_form()
+        if nwk_form is None:
+            env = os.environ.get("ONIX_NWK_MATMUL")
+            if env in ("0", "1"):
+                nwk_matmul = env == "1"
 
     def block_step(carry, xs):
         n_dk, n_wk, n_k, key = carry
         d, w, m, z_old = xs
         key, skey = jax.random.split(key)
+        # n_wk shape is static under trace, so the form choice resolves
+        # to ONE compiled path. The auto gate is the measured collision-
+        # density crossover table (select_nwk_form / the module comments
+        # at _NWK_MATMUL_MIN_DENSITY and _NWK_PALLAS_MIN_DENSITY).
+        form = select_nwk_form(backend=backend, block_size=w.shape[0],
+                               n_rows=n_wk.shape[0],
+                               nwk_matmul=nwk_matmul, nwk_form=nwk_form)
+        if form == "matmul" and w.shape[0] >= (1 << 24):
+            raise ValueError(
+                f"nwk matmul form with block size {w.shape[0]} >= 2^24: "
+                "the one-hot matmul's f32 accumulation is no longer "
+                "bit-exact at this block size")
+        if form == "pallas":
+            # Fused sample + count-merge kernel: the SAME skey feeds one
+            # noise draw at the reference's [B, K] shape, so the key
+            # stream is untouched; sampling and the collision-dense
+            # n_wk delta run inside the kernel (pallas_gibbs module doc)
+            # and the n_dk scatter stays here (collision-free).
+            from onix.models import pallas_gibbs
+            shape = (w.shape[0], k_topics)
+            if use_gumbel:
+                noise = jax.random.gumbel(skey, shape, dtype=jnp.float32)
+            else:
+                noise = jax.random.uniform(skey, shape, dtype=jnp.float32,
+                                           minval=1e-38)
+            z_new, d_wk = pallas_gibbs.sample_count_block(
+                n_dk[d], n_wk[w], n_k, noise, w, z_old, m,
+                alpha=alpha, eta=eta, v_eta=v_eta, k_topics=k_topics,
+                n_rows=n_wk.shape[0], use_gumbel=use_gumbel)
+            delta = _one_hot(z_new, k_topics) - _one_hot(z_old, k_topics)
+            return (n_dk.at[d].add(delta), n_wk + d_wk,
+                    n_k + delta.sum(axis=0, dtype=jnp.int32), key), z_new
         oh_old = _one_hot(z_old, k_topics)          # zero row for padding
         ohf = oh_old.astype(jnp.float32)
         # Counts excluding each token's own current assignment.
@@ -244,29 +363,7 @@ def make_block_step(*, alpha: float, eta: float, n_vocab: int,
         # 35M vs 18M tokens/s at K=20).
         delta = _one_hot(z_new, k_topics) - oh_old  # int32-exact update
         n_dk = n_dk.at[d].add(delta)
-        # n_wk shape is static under trace, so the delta form resolves
-        # to ONE compiled path. The auto gate is the measured collision-
-        # density crossover (module comment at _NWK_MATMUL_MIN_DENSITY),
-        # bounded by the exactness/memory caps above it.
-        use_matmul = (nwk_matmul if nwk_matmul is not None
-                      else (min_density is not None
-                            and w.shape[0] >= min_density * n_wk.shape[0]
-                            and n_wk.shape[0] <= _NWK_MATMUL_MAX_V
-                            # Exactness bound: every output of the f32
-                            # accumulation is a sum of B {-1,0,1} terms,
-                            # so |output| <= B must stay below 2^24 or
-                            # integers stop being representable exactly.
-                            # MAX_ELEMS implies it for V >= 8 only; the
-                            # explicit bound covers tiny-V/huge-B days.
-                            and w.shape[0] < (1 << 24)
-                            and w.shape[0] * n_wk.shape[0]
-                            <= _NWK_MATMUL_MAX_ELEMS))
-        if nwk_matmul and w.shape[0] >= (1 << 24):
-            raise ValueError(
-                f"nwk_matmul=True with block size {w.shape[0]} >= 2^24: "
-                "the one-hot matmul's f32 accumulation is no longer "
-                "bit-exact at this block size")
-        if use_matmul:
+        if form == "matmul":
             oh_w = jax.nn.one_hot(w, n_wk.shape[0], dtype=jnp.bfloat16)
             d_wk = jax.lax.dot_general(
                 oh_w, delta.astype(jnp.bfloat16),
@@ -291,6 +388,7 @@ def sweep(
     eta: float,
     n_vocab: int,
     accumulate,
+    nwk_form: str | None = None,
 ) -> GibbsState:
     """One full Gibbs sweep over all token blocks (jit-friendly).
 
@@ -301,7 +399,7 @@ def sweep(
     or not XLA can constant-fold it away."""
     k_topics = state.n_dk.shape[1]
     block_step = make_block_step(alpha=alpha, eta=eta, n_vocab=n_vocab,
-                                 k_topics=k_topics)
+                                 k_topics=k_topics, nwk_form=nwk_form)
 
     (n_dk, n_wk, n_k, key), z = jax.lax.scan(
         block_step,
@@ -338,6 +436,7 @@ def superstep(
     burn_in: int,
     start_sweep,
     n_steps: int,
+    nwk_form: str | None = None,
 ) -> GibbsState:
     """Chain `n_steps` full sweeps inside ONE lax.scan — one dispatch,
     one compiled program per distinct n_steps (static), any start sweep
@@ -351,7 +450,8 @@ def superstep(
     def one(st, i):
         return sweep(st, doc_blocks, word_blocks, mask_blocks,
                      alpha=alpha, eta=eta, n_vocab=n_vocab,
-                     accumulate=start_sweep + i >= burn_in), None
+                     accumulate=start_sweep + i >= burn_in,
+                     nwk_form=nwk_form), None
 
     state, _ = jax.lax.scan(one, state,
                             jnp.arange(n_steps, dtype=jnp.int32))
@@ -478,16 +578,27 @@ class GibbsLDA:
         self.n_docs = n_docs
         self.n_vocab = n_vocab
         chains = config.n_chains
+        # "auto" defers to the measured per-backend gate at trace time;
+        # an explicit config form pins it (select_nwk_form validates).
+        form = None if config.nwk_form == "auto" else config.nwk_form
         base_sweep = functools.partial(
-            sweep, alpha=config.alpha, eta=config.eta, n_vocab=n_vocab)
+            sweep, alpha=config.alpha, eta=config.eta, n_vocab=n_vocab,
+            nwk_form=form)
         base_super = functools.partial(
             superstep, alpha=config.alpha, eta=config.eta,
-            n_vocab=n_vocab, burn_in=config.burn_in)
+            n_vocab=n_vocab, burn_in=config.burn_in, nwk_form=form)
         base_est = functools.partial(
             posterior_estimates, alpha=config.alpha, eta=config.eta)
+        # donate_argnums=(0,): the incoming GibbsState's buffers are
+        # dead the moment the dispatch returns (every caller rebinds),
+        # so XLA reuses them for the output counts instead of copying
+        # the [D,K]+[V,K] tables every sweep — the sharded engine has
+        # donated since r7 (sharded_gibbs.py); this brings the plain
+        # engine level.
         if chains == 1:
             self._sweep = jax.jit(base_sweep,
-                                  static_argnames=("accumulate",))
+                                  static_argnames=("accumulate",),
+                                  donate_argnums=(0,))
             self._estimates = jax.jit(base_est)
             self._ll = jax.jit(log_likelihood)
 
@@ -526,7 +637,8 @@ class GibbsLDA:
                     t, p, d, w, m))(theta, phi_wk).mean()
 
             self._sweep = jax.jit(sweep_chains,
-                                  static_argnames=("accumulate",))
+                                  static_argnames=("accumulate",),
+                                  donate_argnums=(0,))
             self._estimates = jax.jit(jax.vmap(base_est))
             self._ll = jax.jit(ll_chains)
 
@@ -545,7 +657,8 @@ class GibbsLDA:
                 return ((st, ll0, ll) if with_initial_ll else (st, ll))
 
         self._superstep = jax.jit(
-            superstep_ll, static_argnames=("n_steps", "with_initial_ll"))
+            superstep_ll, static_argnames=("n_steps", "with_initial_ll"),
+            donate_argnums=(0,))
 
     def prepare(self, corpus: Corpus, shuffle: bool = True):
         if shuffle:
@@ -571,7 +684,11 @@ class GibbsLDA:
         (`plan_segments`), and a per-sweep `callback` collapses segments
         to single sweeps, so host-visible behavior at every boundary is
         unchanged; the chained loop is bit-identical to sweep-at-a-time
-        (tested).
+        (tested). Like the sharded engine (since r7), the dispatch
+        donates the incoming state's buffers: a `callback` that wants
+        to RETAIN anything across sweeps must materialize it
+        (np.asarray) inside the callback — holding the state's jax
+        arrays past the next dispatch reads deleted buffers.
 
         Optionally checkpoint every `config.checkpoint_every` sweeps
         into `checkpoint_dir` and resume from the newest matching

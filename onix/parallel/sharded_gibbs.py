@@ -43,6 +43,15 @@ try:                        # newer jax exposes shard_map at top level
 except AttributeError:      # older (≤0.4.37): the experimental home
     from jax.experimental.shard_map import shard_map as _shard_map
 
+# The replication-check kwarg was renamed across jax versions
+# (check_rep in the experimental shard_map, check_vma at the top level).
+import inspect as _inspect
+
+_SHARD_MAP_CHECK_KW = (
+    "check_vma"
+    if "check_vma" in _inspect.signature(_shard_map).parameters
+    else "check_rep")
+
 if hasattr(jax.lax, "pcast"):
     _pcast = jax.lax.pcast
 else:
@@ -175,13 +184,16 @@ class ShardedGibbsState(NamedTuple):
 
 
 def _local_sweep(z, n_dk, n_wk, n_k, key, docs, words, mask, *,
-                 alpha, eta, n_vocab, k_topics):
+                 alpha, eta, n_vocab, k_topics, nwk_form=None):
     """The per-device sweep body — the single-device engine's block_step,
     shared via lda_gibbs.make_block_step so the math stays identical.
     `n_wk` may be a vocabulary CHUNK with local word ids; the
-    denominator terms (n_k + V*eta) stay global."""
+    denominator terms (n_k + V*eta) stay global. The n_wk count-update
+    form (scatter | matmul | pallas) gates on the LOCAL chunk width —
+    under mp sharding each chunk's collision density is what matters."""
     block_step = lda_gibbs.make_block_step(
-        alpha=alpha, eta=eta, n_vocab=n_vocab, k_topics=k_topics)
+        alpha=alpha, eta=eta, n_vocab=n_vocab, k_topics=k_topics,
+        nwk_form=nwk_form)
     (n_dk, n_wk, n_k, key), z = jax.lax.scan(
         block_step, (n_dk, n_wk, n_k, key), (docs, words, mask, z))
     return z, n_dk, n_wk, n_k, key
@@ -216,6 +228,34 @@ class ShardedGibbsLDA:
 
         S = max(1, int(config.sync_splits))
         burn = config.burn_in
+        # "auto" defers to the measured per-backend gate at trace time
+        # (lda_gibbs.select_nwk_form); explicit config forms pin it. An
+        # ONIX_NWK_FORM override present at construction is captured
+        # here; when it is unset (form None), BOTH the block steps and
+        # the replication-check decision below re-resolve the env at
+        # trace time — the same moment, so the compiled form and the
+        # check can never disagree even if the env changes in between.
+        nwk_form = (None if config.nwk_form == "auto" else config.nwk_form)
+        if nwk_form is None:
+            nwk_form = lda_gibbs.env_nwk_form()
+        # shard_map has no replication rule for pallas_call, so the
+        # sweep-carrying shard regions must drop the static replication
+        # check whenever the Pallas form CAN be traced (explicitly
+        # pinned, or auto-reachable because the backend has a measured
+        # pallas crossover entry). The check is a tracing-time linter,
+        # not semantics — psum/out_specs behave identically without it
+        # (the dp>1 pallas-vs-scatter equality tests ride this path).
+        # Evaluated at TRACE time, right where make_block_step resolves
+        # the same form, so the two decisions always read the same env.
+        def sweep_smap_kw():
+            form = (nwk_form if nwk_form is not None
+                    else lda_gibbs.env_nwk_form())
+            maybe_pallas = (
+                form == "pallas"
+                or (form is None
+                    and lda_gibbs._NWK_PALLAS_MIN_DENSITY.get(
+                        jax.default_backend()) is not None))
+            return {_SHARD_MAP_CHECK_KW: False} if maybe_pallas else {}
 
         def _group_sweep(z_g, n_dk_l, n_wk_l, n_k_l, key_c,
                          d_g, w_g, m_g):
@@ -241,7 +281,7 @@ class ShardedGibbsLDA:
                     return _local_sweep(
                         zc, ndkc, nwkc, nkc, keyc, dg, wg, mg,
                         alpha=config.alpha, eta=config.eta,
-                        n_vocab=n_vocab, k_topics=k)
+                        n_vocab=n_vocab, k_topics=k, nwk_form=nwk_form)
 
                 z_new, ndk_new, nwk_new, nk_new, key_new = \
                     jax.vmap(one_chain)(zg, ndk_v, nwk_v, nk_v, key_c)
@@ -334,6 +374,7 @@ class ShardedGibbsLDA:
                           P(D, *mp_spec)),
                 out_specs=(P(D, *mp_spec), P(D), P(*mp_spec), P(),
                            P(D, *mp_spec)),
+                **sweep_smap_kw(),
             )(state.z, state.n_dk, state.n_wk, state.n_k, state.keys,
               docs, words, mask)
             do_acc = jnp.float32(accumulate)
@@ -408,6 +449,7 @@ class ShardedGibbsLDA:
                           P(D, *mp_spec), P(D, *mp_spec),
                           P(D, *mp_spec), P()),
                 out_specs=out_specs,
+                **sweep_smap_kw(),
             )(state.z, state.n_dk, state.n_wk, state.n_k, state.keys,
               state.acc_ndk, state.acc_nwk, state.n_acc,
               docs, words, mask, jnp.asarray(start, jnp.int32))
@@ -443,7 +485,7 @@ class ShardedGibbsLDA:
                 ll0 = (sm0 / jnp.maximum(t0, 1.0)).mean()
             block_step = lda_gibbs.make_block_step(
                 alpha=config.alpha, eta=config.eta, n_vocab=n_vocab,
-                k_topics=k)
+                k_topics=k, nwk_form=nwk_form)
 
             def one_sweep(carry, i):
                 z, ndk, nwk, nk, keys, ad, aw, na = carry

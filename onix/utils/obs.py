@@ -175,6 +175,23 @@ def gibbs_sweep_bytes_per_token(k_topics: int) -> float:
     return 4 * k_topics * 4 + 12
 
 
+def gibbs_pallas_bytes_per_token(k_topics: int, n_rows: int,
+                                 block_size: int) -> float:
+    """Modeled HBM traffic per token for the Pallas fused sample+count
+    block step (onix/models/pallas_gibbs.py; docs/PERF.md "Pallas fused
+    sample+count"): the gathered n_dk[d]/n_wk[w] row reads plus the
+    n_dk row scatter write-back (3·K·4 B), the pre-generated noise row
+    written by the RNG and read by the kernel (2·K·4 B), the token
+    stream (w, z_old in, z_new out: 12 B), and the dense [V, K] n_wk
+    delta flush amortized over the block (V·K·4 / B). The n_wk
+    write-back that the scatter model charges per token is gone — that
+    is the kernel's whole point — so on collision-dense shapes the
+    pallas model moves MORE bytes per token than the scatter model
+    only via the noise rows, while removing the serialization."""
+    return (5 * k_topics * 4 + 12
+            + n_rows * k_topics * 4 / max(block_size, 1))
+
+
 def roofline(n_items: int, wall_s: float, bytes_per_item: float,
              peak_bytes_per_s: float | None) -> dict:
     """One component's roofline entry: achieved bytes/s from the

@@ -17,8 +17,10 @@ each isolated here on the real corpus shape:
   D. the likelihood evals (on-device at superstep boundaries since r7)
   E. shape effects — in particular n_wk scatter COLLISION DENSITY
      (block_size / V colliding row-updates per vocab row): the
-     raw_nwk_scatter vs raw_nwk_matmul rows feed the
-     lda_gibbs._NWK_MATMUL_MIN_DENSITY decision table (docs/PERF.md).
+     raw_nwk_scatter / raw_nwk_matmul / raw_nwk_pallas rows feed the
+     lda_gibbs._NWK_MATMUL_MIN_DENSITY and _NWK_PALLAS_MIN_DENSITY
+     decision tables (docs/PERF.md; queued TPU run: docs/TPU_QUEUE.json
+     `fitgap_tpu`), bit-identity asserted across all three forms.
 
 Run on the TPU host:  python scripts/exp_fit_gap.py [n_tokens]
 Tiny tier-1 smoke (so this harness cannot rot between TPU windows):
@@ -197,7 +199,8 @@ def main(argv: list[str] | None = None) -> int:
 
     def timed_raw(tag, step):
         """Chained raw sweeps of `step` — the microbench form on the
-        REAL corpus shape (no ll, no estimates, no accumulate)."""
+        REAL corpus shape (no ll, no estimates, no accumulate). Returns
+        the final (n_wk, z) so the form arms can assert bit-identity."""
         @jax.jit
         def sweepsN(carry, z):
             def one(c_z, _):
@@ -221,23 +224,37 @@ def main(argv: list[str] | None = None) -> int:
                     "mtok_per_s": round(
                         n_sweeps * corpus.n_tokens / dt / 1e6, 2)}
         print(tag, out[tag], flush=True)
+        return np.asarray(carry[1]), np.asarray(z)
 
     timed_raw("raw_sweeps_no_fit",
               make_block_step(alpha=cfg.alpha, eta=cfg.eta,
                               n_vocab=corpus.n_vocab,
                               k_topics=cfg.n_topics))
 
-    # E: n_wk delta form — MXU one-hot matmul vs scatter-add, raw
-    # sweeps. Product vocabularies are collision-dense for the n_wk
-    # scatter (density = B/V colliding updates per row); both forms are
-    # bit-identical (test_gibbs), and these two rows ARE the decision
-    # table behind lda_gibbs._NWK_MATMUL_MIN_DENSITY (docs/PERF.md).
+    # E: n_wk delta form — scatter-add vs MXU one-hot matmul vs the
+    # Pallas fused sample+count kernel, raw sweeps. Product
+    # vocabularies are collision-dense for the n_wk scatter (density =
+    # B/V colliding updates per row); all three forms are bit-identical
+    # (test_gibbs, test_pallas_gibbs — and re-asserted HERE at
+    # experiment scale), and these rows ARE the decision table behind
+    # lda_gibbs._NWK_MATMUL_MIN_DENSITY / _NWK_PALLAS_MIN_DENSITY
+    # (docs/PERF.md; TPU rows in docs/TPU_QUEUE.json `fitgap_tpu`).
+    # Off-TPU the pallas arm runs the interpret-mode emulation — its
+    # CPU rate is a correctness diagnostic, not a speed claim.
     out["nwk_collision_density"] = round(block / corpus.n_vocab, 1)
-    for form, tag in ((False, "raw_nwk_scatter"), (True, "raw_nwk_matmul")):
-        timed_raw(tag, make_block_step(alpha=cfg.alpha, eta=cfg.eta,
-                                       n_vocab=corpus.n_vocab,
-                                       k_topics=cfg.n_topics,
-                                       nwk_matmul=form))
+    finals = {}
+    for form in ("scatter", "matmul", "pallas"):
+        finals[form] = timed_raw(
+            f"raw_nwk_{form}",
+            make_block_step(alpha=cfg.alpha, eta=cfg.eta,
+                            n_vocab=corpus.n_vocab,
+                            k_topics=cfg.n_topics, nwk_form=form))
+    for form in ("matmul", "pallas"):
+        np.testing.assert_array_equal(finals["scatter"][0],
+                                      finals[form][0])
+        np.testing.assert_array_equal(finals["scatter"][1],
+                                      finals[form][1])
+    out["nwk_forms_bit_identical"] = True
 
     text = json.dumps(out)
     print(text)

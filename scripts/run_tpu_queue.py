@@ -1,0 +1,189 @@
+"""Walk docs/TPU_QUEUE.json inside ONE tunnel window.
+
+The tunneled TPU is intermittent (down for hours, up for 40+ minutes —
+docs/PERF.md methodology notes), and queued measurements used to live
+as prose rows scattered across PERF.md/EVIDENCE_r0*.md, re-planned by
+hand every window. This runner makes a window mechanical:
+
+    python scripts/run_tpu_queue.py --list
+    python scripts/run_tpu_queue.py                     # whole queue
+    python scripts/run_tpu_queue.py --only fitgap_tpu,bench_trim
+    python scripts/run_tpu_queue.py --max-minutes 40    # short window
+
+Behavior:
+  * probes the backend first (subprocess with timeout, same machinery
+    as bench.py) and refuses to burn the queue against a dead tunnel
+    or a CPU fallback (--force runs anyway, e.g. for a dry CPU smoke);
+  * runs entries in manifest order, skipping those whose est_minutes
+    don't fit the remaining --max-minutes budget (critical-first is
+    expressed by manifest order);
+  * each entry's stdout/stderr is captured to docs/tpu_queue_logs/<id>.log
+    and entries with `stdout_json_to` get their LAST stdout JSON line
+    written there (bench.py's judged line);
+  * a results manifest (docs/TPU_QUEUE_RESULTS_<utc>.json) records
+    rc/wall/log per entry, so the window's outcome is an artifact even
+    when the tunnel dies mid-queue.
+
+Entries are removed from the queue manifest by hand once their numbers
+are folded into docs/PERF.md — the runner never edits the queue.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pathlib
+import subprocess
+import sys
+import time
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+
+QUEUE = ROOT / "docs" / "TPU_QUEUE.json"
+LOG_DIR = ROOT / "docs" / "tpu_queue_logs"
+
+
+def load_queue() -> list[dict]:
+    return json.loads(QUEUE.read_text())["entries"]
+
+
+def entry_argv(entry: dict) -> list[str]:
+    if entry["kind"] == "pytest":
+        return [sys.executable, "-m", "pytest", *entry["cmd"]]
+    if entry["kind"] == "script":
+        cmd = list(entry["cmd"])
+        if cmd and cmd[0] == "python":
+            cmd[0] = sys.executable
+        return cmd
+    raise ValueError(f"unknown entry kind {entry['kind']!r}")
+
+
+def run_entry(entry: dict, timeout_scale: float) -> dict:
+    LOG_DIR.mkdir(parents=True, exist_ok=True)
+    log_path = LOG_DIR / f"{entry['id']}.log"
+    argv = entry_argv(entry)
+    env = dict(os.environ, **entry.get("env", {}))
+    # 3x the estimate (scaled) before the hard kill: tunnel compiles
+    # routinely run 2-3x a warm estimate, but a hang must not eat the
+    # whole window (the bench watchdog lesson, bench.py main()).
+    timeout = max(300.0, entry.get("est_minutes", 10) * 60 * timeout_scale)
+    t0 = time.monotonic()
+    rec = {"id": entry["id"], "cmd": argv, "log": str(log_path),
+           "timeout_s": round(timeout, 0)}
+    try:
+        r = subprocess.run(argv, cwd=ROOT, env=env, timeout=timeout,
+                           capture_output=True, text=True)
+        rec["rc"] = r.returncode
+        out, err = r.stdout, r.stderr
+    except subprocess.TimeoutExpired as e:
+        rec["rc"] = None
+        rec["timed_out"] = True
+        out = (e.stdout or b"").decode(errors="replace") \
+            if isinstance(e.stdout, bytes) else (e.stdout or "")
+        err = (e.stderr or b"").decode(errors="replace") \
+            if isinstance(e.stderr, bytes) else (e.stderr or "")
+    rec["wall_s"] = round(time.monotonic() - t0, 1)
+    log_path.write_text(f"$ {' '.join(argv)}\n\n== stdout ==\n{out}\n"
+                        f"== stderr ==\n{err}\n")
+    target = entry.get("stdout_json_to")
+    if target and rec.get("rc") == 0:
+        doc = None
+        for line in out.splitlines():
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    doc = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+        if doc is not None:
+            p = ROOT / target
+            p.parent.mkdir(parents=True, exist_ok=True)
+            p.write_text(json.dumps(doc, indent=2) + "\n")
+            rec["stdout_json_to"] = target
+        else:
+            rec["stdout_json_error"] = "no JSON line found on stdout"
+    return rec
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="run every queued TPU measurement in one window")
+    ap.add_argument("--list", action="store_true",
+                    help="print the queue and exit")
+    ap.add_argument("--only", default=None,
+                    help="comma-separated entry ids to run")
+    ap.add_argument("--max-minutes", type=float, default=None,
+                    help="window budget: skip entries whose est_minutes "
+                         "no longer fit the remaining budget")
+    ap.add_argument("--timeout-scale", type=float, default=3.0,
+                    help="hard per-entry kill at est_minutes * this")
+    ap.add_argument("--force", action="store_true",
+                    help="run even when the probed backend is not tpu "
+                         "(CPU dry smoke of the queue mechanics)")
+    ap.add_argument("--results", default=None,
+                    help="results manifest path (default "
+                         "docs/TPU_QUEUE_RESULTS_<utc>.json)")
+    args = ap.parse_args(argv)
+
+    entries = load_queue()
+    if args.only:
+        only = {s.strip() for s in args.only.split(",") if s.strip()}
+        unknown = only - {e["id"] for e in entries}
+        if unknown:
+            ap.error(f"unknown queue ids: {sorted(unknown)}")
+        entries = [e for e in entries if e["id"] in only]
+    if args.list:
+        for e in entries:
+            print(f"{e['id']:<20} ~{e.get('est_minutes', '?'):>4} min  "
+                  f"{e['decides'][:90]}")
+        return 0
+
+    from bench import _probe_backend
+    platform, err = _probe_backend(timeout_s=75.0)
+    print(f"backend probe: {platform!r} ({err or 'ok'})", flush=True)
+    if platform != "tpu" and not args.force:
+        print("refusing to run the queue off-TPU (use --force for a "
+              "CPU dry smoke)", file=sys.stderr)
+        return 2
+
+    deadline = (time.monotonic() + args.max_minutes * 60
+                if args.max_minutes else None)
+    results = {"utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+               "platform": platform, "entries": []}
+    out_path = pathlib.Path(args.results) if args.results else (
+        ROOT / "docs" / ("TPU_QUEUE_RESULTS_"
+                         + time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+                         + ".json"))
+
+    def save():
+        out_path.parent.mkdir(parents=True, exist_ok=True)
+        out_path.write_text(json.dumps(results, indent=2) + "\n")
+
+    for entry in entries:
+        if deadline is not None:
+            left_min = (deadline - time.monotonic()) / 60
+            if entry.get("est_minutes", 10) > left_min:
+                results["entries"].append(
+                    {"id": entry["id"], "skipped":
+                     f"est {entry.get('est_minutes')} min > "
+                     f"{left_min:.0f} min left in window"})
+                save()
+                continue
+        print(f"== {entry['id']} (est ~{entry.get('est_minutes')} min)",
+              flush=True)
+        rec = run_entry(entry, args.timeout_scale)
+        print(f"   rc={rec.get('rc')} wall={rec['wall_s']}s "
+              f"log={rec['log']}", flush=True)
+        results["entries"].append(rec)
+        save()                      # a mid-queue tunnel death keeps
+        #                             every finished entry on disk
+    ok = all(r.get("rc") == 0 for r in results["entries"]
+             if "skipped" not in r)
+    print(json.dumps({"results": str(out_path), "ok": ok}))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

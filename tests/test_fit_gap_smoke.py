@@ -28,6 +28,9 @@ def test_exp_fit_gap_tiny_shape_runs_all_arms(tmp_path):
     for arm in ("sharded_dp1_fast", "sharded_dp1_shardmap",
                 "plain_single", "all_accumulate", "no_accumulate",
                 "per_sweep_loop", "superstep_loop", "raw_sweeps_no_fit",
-                "raw_nwk_scatter", "raw_nwk_matmul"):
+                "raw_nwk_scatter", "raw_nwk_matmul", "raw_nwk_pallas"):
         assert doc[arm]["wall_s"] >= 0.0, arm
     assert doc["nwk_collision_density"] > 0
+    # The three count-update forms were asserted bit-identical at this
+    # run's shape inside the script.
+    assert doc["nwk_forms_bit_identical"] is True
