@@ -876,6 +876,15 @@ def _measure() -> None:
     if fallback:
         detail["note"] = ("CPU fallback shapes — value is NOT the judged "
                           "per-chip rate; see backend_error")
+    # Resilience events tallied during the bench (salvage skips,
+    # injected faults, checkpoint digest mismatches, retry counts) —
+    # empty on a clean run, evidence when a chaos plan was active.
+    from onix.utils.obs import counters as _counters
+    resil = {**_counters.snapshot("ingest"), **_counters.snapshot("salvage"),
+             **_counters.snapshot("faults"), **_counters.snapshot("ckpt")}
+    if resil:
+        detail["resilience"] = resil
+        save()
 
     print(json.dumps({
         "metric": "netflow_events_scored_per_sec_per_chip",

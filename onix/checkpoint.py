@@ -15,11 +15,22 @@ written atomically (tmp + rename) with bounded retention. Orbax would
 add async multi-host IO; for the K×V + N-token state sizes here, a
 synchronous npz keeps the dependency surface flat while preserving the
 same resume contract.
+
+Integrity (the resilience layer): `save` stamps the sha256 of the npz
+bytes into the meta json (`npz_sha256`, format bump `ckpt_format: 2`);
+`load_latest` re-hashes the file and REFUSES a mismatching checkpoint —
+a bit-flipped or short-written npz falls back to the previous
+checkpoint instead of resuming from silently corrupt state (counted
+under `ckpt.digest_mismatch`). Pre-digest checkpoints (no `npz_sha256`
+key) keep loading: their torn-file semantics — json renamed only after
+the npz is durable — already guard the failure mode they were written
+under.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import json
 import pathlib
 
@@ -53,16 +64,31 @@ def save(ckpt_dir: str | pathlib.Path, sweep: int,
 
     The .json is written (renamed into place) only after the .npz is
     durable, so a crash mid-save can never leave a checkpoint that
-    `load_latest` would trust."""
+    `load_latest` would trust. The json carries the npz's sha256, which
+    load_latest verifies — a checkpoint that rotted on disk after a
+    clean save is refused, not resumed from.
+
+    Chaos hook: a `ckpt:save=torn` rule in the active fault plan makes
+    this save stop after the npz rename (the mid-crash torn state),
+    exactly once."""
+    from onix.utils import faults
+
     ckpt_dir = pathlib.Path(ckpt_dir)
     ckpt_dir.mkdir(parents=True, exist_ok=True)
     npz_path, json_path = _paths(ckpt_dir, sweep)
-    meta = dict(meta, sweep=int(sweep))
 
     tmp = npz_path.with_suffix(".npz.tmp")
     with open(tmp, "wb") as f:
         np.savez(f, **{k: np.asarray(v) for k, v in arrays.items()})
+    h = hashlib.sha256()
+    with open(tmp, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 22), b""):
+            h.update(chunk)
+    meta = dict(meta, sweep=int(sweep), npz_sha256=h.hexdigest(),
+                ckpt_format=2)
     tmp.replace(npz_path)
+    if faults.fire("ckpt", "save") == "torn":
+        return      # simulated crash between the npz and json renames
     tmp_j = json_path.with_suffix(".json.tmp")
     tmp_j.write_text(json.dumps(meta, indent=2))
     tmp_j.replace(json_path)
@@ -74,8 +100,14 @@ def save(ckpt_dir: str | pathlib.Path, sweep: int,
 
 
 def load_latest(ckpt_dir: str | pathlib.Path) -> Checkpoint | None:
-    """Newest complete checkpoint, or None. Incomplete pairs (crash
-    between npz and json rename) are skipped."""
+    """Newest complete AND intact checkpoint, or None. Incomplete pairs
+    (crash between npz and json rename), unreadable npzs, and digest
+    mismatches (bit rot, short write) all fall back to the next-older
+    checkpoint — never a resume from corrupt state."""
+    import logging
+
+    from onix.utils.obs import counters
+
     ckpt_dir = pathlib.Path(ckpt_dir)
     if not ckpt_dir.exists():
         return None
@@ -85,6 +117,20 @@ def load_latest(ckpt_dir: str | pathlib.Path) -> Checkpoint | None:
             continue
         try:
             meta = json.loads(json_path.read_text())
+            want = meta.get("npz_sha256")
+            if want is not None:
+                # Chunked hash: a multi-GB sampler state must not be
+                # double-buffered just to verify it.
+                h = hashlib.sha256()
+                with open(npz_path, "rb") as f:
+                    for chunk in iter(lambda: f.read(1 << 22), b""):
+                        h.update(chunk)
+                if h.hexdigest() != want:
+                    counters.inc("ckpt.digest_mismatch")
+                    logging.getLogger("onix.checkpoint").warning(
+                        "checkpoint %s fails its sha256 digest — skipping "
+                        "to the previous checkpoint", npz_path)
+                    continue
             with np.load(npz_path) as z:
                 arrays = {k: z[k] for k in z.files}
         except (json.JSONDecodeError, OSError, ValueError):

@@ -52,6 +52,11 @@ def build_parser() -> argparse.ArgumentParser:
                          metavar="SWEEP",
                          help="testing hook: simulate a preemption after "
                               "this sweep (re-run resumes from checkpoint)")
+    p_score.add_argument("--fault-plan", default=None, metavar="PLAN",
+                         help="chaos drill: declarative fault plan, e.g. "
+                              "'fit:sweep@8=preempt,ckpt:save@1=torn' "
+                              "(docs/ROBUSTNESS.md; also env "
+                              "ONIX_FAULT_PLAN)")
 
     p_ingest = sub.add_parser(
         "ingest", help="decode and load raw telemetry into the store")
@@ -85,6 +90,9 @@ def build_parser() -> argparse.ArgumentParser:
                           help="hashed vocabulary size (static V)")
     p_stream.add_argument("--epochs", type=int, default=1,
                           help="replay the file list N times (burn-in)")
+    p_stream.add_argument("--fault-plan", default=None, metavar="PLAN",
+                          help="chaos drill: declarative fault plan, e.g. "
+                               "'stream:batch@3=raise' (docs/ROBUSTNESS.md)")
 
     p_oa = sub.add_parser(
         "oa", help="operational analytics: enrich scored results for the UI")
@@ -166,6 +174,9 @@ def main(argv: list[str] | None = None) -> int:
                     f"a {args.engine} drill would silently do nothing")
             import os
             os.environ["ONIX_FAULT_SWEEP"] = str(args.fault_inject)
+        if args.fault_plan is not None:
+            from onix.utils import faults
+            faults.install_plan(args.fault_plan)    # parse errors exit now
         from onix.pipelines.run import run_scoring
         return run_scoring(cfg, engine=args.engine)
 
@@ -181,7 +192,10 @@ def main(argv: list[str] | None = None) -> int:
                                 max_seconds=args.max_seconds,
                                 idle_exit=args.drain)
             print(f"onix watch: {stats['files']} files, {stats['rows']} "
-                  f"rows, {stats['errors']} errors "
+                  f"rows, {stats['errors']} errors, "
+                  f"{stats.get('retries', 0)} retries, "
+                  f"{stats.get('quarantined', 0)} quarantined, "
+                  f"{stats.get('salvaged', 0)} salvaged "
                   f"({stats['workers']} workers)")
             return 1 if stats["errors"] else 0
         import time as time_mod
@@ -189,18 +203,33 @@ def main(argv: list[str] | None = None) -> int:
         w = IngestWatcher(cfg, args.datatype, args.landing_dir,
                           require_stable=not args.drain)
         if args.drain:
+            # Drain until nothing dispatches AND no failed file is
+            # still inside its retry budget — a drain must carry every
+            # failure to its salvage-or-quarantine verdict, not abandon
+            # it mid-backoff for the next invocation.
             t0 = time_mod.monotonic()
-            while w.poll_once():
+            while True:
+                dispatched = w.poll_once()
+                if not dispatched and not w.pending_retries():
+                    break
                 if (args.max_seconds is not None
                         and time_mod.monotonic() - t0 > args.max_seconds):
                     break
+                if not dispatched:
+                    time_mod.sleep(min(w.poll_interval, 0.2))
         else:
             w.run(max_seconds=args.max_seconds)
         print(f"onix watch: {w.stats['files']} files, {w.stats['rows']} "
-              f"rows, {w.stats['errors']} errors")
+              f"rows, {w.stats['errors']} errors, "
+              f"{w.stats['retries']} retries, "
+              f"{w.stats['quarantined']} quarantined, "
+              f"{w.stats['salvaged']} salvaged")
         return 1 if w.stats["errors"] else 0
 
     if args.command == "stream":
+        if args.fault_plan is not None:
+            from onix.utils import faults
+            faults.install_plan(args.fault_plan)
         from onix.pipelines.streaming import run_stream
         return run_stream(cfg, args.datatype, args.paths,
                           n_buckets=args.buckets, epochs=args.epochs)

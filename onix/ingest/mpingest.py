@@ -24,6 +24,15 @@ Coordination protocol (all steps NFS-safe — no flock):
           rename is atomic, so exactly one contender wins — then claim
           fresh. At-least-once delivery, like Kafka offset redelivery.
 
+Poison handling mirrors the single-process watcher's: failed attempts
+are counted in `<digest>.attempts` (written only by the claim holder —
+single-writer, so no flock needed), with backoff gates between retries
+and salvage-mode decode on the final attempt; an exhausted file moves
+to `quarantine/` with a sidecar and leaves a `<digest>.quarantined`
+marker no worker will ever re-claim. Because the digest hashes
+path+size+mtime, changed/re-delivered content gets a fresh identity —
+and a fresh retry budget — automatically.
+
 Part-file writes are safe under this concurrency because Store.append
 allocates part numbers with an atomic hard-link (see onix/store.py).
 """
@@ -42,10 +51,14 @@ import time
 from onix.config import OnixConfig
 from onix.ingest.run import DEFAULT_PATTERNS, ingest_file
 from onix.store import Store
+from onix.utils.obs import counters
+from onix.utils.resilience import (RetryPolicy, format_exception,
+                                   quarantine_file)
 
 log = logging.getLogger("onix.ingest.mp")
 
 CLAIMS_DIR = ".onix_claims"
+QUARANTINE_DIR = "quarantine"
 
 
 def _digest(path: pathlib.Path) -> tuple[str, dict]:
@@ -68,10 +81,15 @@ class ClaimStore:
 
     def try_claim(self, path: pathlib.Path) -> str | None:
         """Atomically claim `path`; returns the digest on success, None
-        if done, claimed by a live worker, or lost a race."""
+        if done, quarantined, backing off after a failed attempt,
+        claimed by a live worker, or lost a race."""
         digest, meta = _digest(path)
         if (self.dir / f"{digest}.done").exists():
             return None
+        if (self.dir / f"{digest}.quarantined").exists():
+            return None
+        if time.time() < self._not_before(digest):
+            return None             # retry backoff window
         claim = self.dir / f"{digest}.claim"
         try:
             st = claim.stat()
@@ -96,12 +114,76 @@ class ClaimStore:
         return digest
 
     def commit(self, digest: str) -> None:
-        """Durably mark done (atomic rename of the claim)."""
+        """Durably mark done (atomic rename of the claim); clears any
+        attempts marker — a fail-then-succeed file must not leave a
+        stale backoff gate behind (Ledger.commit does the same)."""
         os.rename(self.dir / f"{digest}.claim", self.dir / f"{digest}.done")
+        self._attempts_path(digest).unlink(missing_ok=True)
 
     def release(self, digest: str) -> None:
         """Drop a claim after a failed ingest so any worker may retry."""
         (self.dir / f"{digest}.claim").unlink(missing_ok=True)
+
+    # -- retry budget / dead-letter (single-writer: only the claim
+    # holder touches a digest's attempts file, so no flock needed) ------
+
+    def _attempts_path(self, digest: str) -> pathlib.Path:
+        return self.dir / f"{digest}.attempts"
+
+    def attempts_of(self, digest: str) -> int:
+        try:
+            return int(json.loads(
+                self._attempts_path(digest).read_text())["n"])
+        except (OSError, ValueError, KeyError):
+            return 0
+
+    def _not_before(self, digest: str) -> float:
+        try:
+            return float(json.loads(
+                self._attempts_path(digest).read_text())["not_before"])
+        except (OSError, ValueError, KeyError):
+            return 0.0
+
+    def record_failure(self, digest: str, path: pathlib.Path,
+                       backoff_s: float) -> int:
+        """Durably count one failed attempt and set the backoff gate;
+        returns the attempt count."""
+        n = self.attempts_of(digest) + 1
+        tmp = self._attempts_path(digest).with_suffix(".attempts.tmp")
+        tmp.write_text(json.dumps(
+            {"n": n, "not_before": time.time() + backoff_s,
+             "path": str(pathlib.Path(path).resolve()),
+             "pid": os.getpid(), "host": socket.gethostname()}))
+        os.replace(tmp, self._attempts_path(digest))
+        return n
+
+    def mark_quarantined(self, digest: str, meta: dict) -> None:
+        """Durable never-re-claim marker; clears the claim + attempts."""
+        marker = self.dir / f"{digest}.quarantined"
+        tmp = marker.with_suffix(".quarantined.tmp")
+        tmp.write_text(json.dumps(meta))
+        os.replace(tmp, marker)
+        self.release(digest)
+        self._attempts_path(digest).unlink(missing_ok=True)
+
+    def prune_missing(self) -> int:
+        """Drop done/attempts markers whose file no longer exists —
+        the multi-process rendering of the ledger compaction (markers
+        for rotated-away captures otherwise accumulate forever).
+        Quarantined markers are KEPT: they pin that exact signature
+        dead-lettered across restarts (a re-delivered copy has a fresh
+        mtime and therefore a fresh digest + budget, by design)."""
+        gone = 0
+        for marker in (*self.dir.glob("*.done"),
+                       *self.dir.glob("*.attempts")):
+            try:
+                path = json.loads(marker.read_text()).get("path")
+            except (OSError, ValueError):
+                continue
+            if path and not pathlib.Path(path).exists():
+                marker.unlink(missing_ok=True)
+                gone += 1
+        return gone
 
     def done_count(self) -> int:
         return sum(1 for _ in self.dir.glob("*.done"))
@@ -114,7 +196,8 @@ def worker_loop(cfg: OnixConfig, datatype: str,
                 max_seconds: float | None = None,
                 lease_seconds: float = 300.0,
                 settle_seconds: float = 2.0,
-                idle_exit: bool = False) -> dict:
+                idle_exit: bool = False,
+                retry: RetryPolicy | None = None) -> dict:
     """One worker process: claim→ingest→commit until stopped.
 
     With `idle_exit`, returns after a poll that found nothing claimable
@@ -124,12 +207,19 @@ def worker_loop(cfg: OnixConfig, datatype: str,
     old — the multi-host rendering of the watcher's two-poll stability
     gate. Claiming a still-growing capture would ingest its truncated
     head, commit it done under the truncated signature, and then ingest
-    the finished file again under a fresh digest: head rows duplicated."""
+    the finished file again under a fresh digest: head rows duplicated.
+
+    Failures follow the shared retry policy: bounded attempts counted
+    durably in the claims dir (any worker may perform any attempt),
+    salvage-mode decode on the last one, then quarantine with sidecar."""
     landing = pathlib.Path(landing)
     claims = ClaimStore(landing, lease_seconds=lease_seconds)
     store = Store(cfg.store.root)
-    stats = {"files": 0, "rows": 0, "errors": 0}
+    retry = retry or RetryPolicy()
+    stats = {"files": 0, "rows": 0, "errors": 0, "retries": 0,
+             "quarantined": 0, "salvaged": 0}
     t0 = time.monotonic()
+    polls = 0
     while True:
         dispatched = 0
         candidates: list[pathlib.Path] = []
@@ -144,18 +234,50 @@ def worker_loop(cfg: OnixConfig, datatype: str,
                 continue    # vanished between glob and stat
             if digest is None:
                 continue
+            attempt = claims.attempts_of(digest) + 1
+            salvage: dict = {}
             try:
                 counts = ingest_file(store, datatype, path,
                                      apply_sampling=cfg.ingest.apply_sampling,
-                                     by_hour=cfg.store.partition_hours)
+                                     by_hour=cfg.store.partition_hours,
+                                     strict=retry.strict_for_attempt(attempt),
+                                     salvage=salvage)
                 claims.commit(digest)
                 stats["files"] += 1
                 stats["rows"] += sum(counts.values())
+                if salvage:
+                    stats["salvaged"] += 1
+                    log.warning("mp salvage-ingested %s: %s", path, salvage)
                 dispatched += 1
-            except Exception:
-                log.exception("mp ingest failed for %s (released)", path)
-                claims.release(digest)
+            except Exception as e:
                 stats["errors"] += 1
+                attempts = claims.record_failure(
+                    digest, path, retry.backoff(attempt))
+                if retry.exhausted(attempts):
+                    try:
+                        _, meta = _digest(path)
+                        sig = [meta["size"], meta["mtime"]]
+                    except OSError:     # vanished mid-failure
+                        meta, sig = {"path": str(path)}, None
+                    claims.mark_quarantined(digest, dict(
+                        meta, error=repr(e), attempts=attempts))
+                    sidecar = quarantine_file(
+                        path, landing / QUARANTINE_DIR, error=repr(e),
+                        attempts=attempts, traceback=format_exception(e),
+                        sig=sig)
+                    stats["quarantined"] += 1
+                    log.error("mp quarantined %s after %d attempts (%r) — "
+                              "sidecar %s", path, attempts, e, sidecar)
+                else:
+                    log.exception("mp ingest failed for %s (attempt %d/%d, "
+                                  "released)", path, attempts,
+                                  retry.max_attempts)
+                    claims.release(digest)
+                    stats["retries"] += 1
+                    counters.inc("ingest.retries")
+        polls += 1
+        if polls % 50 == 0:
+            claims.prune_missing()
         if idle_exit and dispatched == 0:
             return stats
         if max_seconds is not None and time.monotonic() - t0 > max_seconds:
@@ -199,7 +321,8 @@ def run_workers(cfg: OnixConfig, datatype: str,
              for _ in range(n_procs)]
     for p in procs:
         p.start()
-    merged = {"files": 0, "rows": 0, "errors": 0, "workers": n_procs,
+    merged = {"files": 0, "rows": 0, "errors": 0, "retries": 0,
+              "quarantined": 0, "salvaged": 0, "workers": n_procs,
               "dead_workers": 0}
     reported = 0
     while reported < n_procs:
@@ -212,15 +335,17 @@ def run_workers(cfg: OnixConfig, datatype: str,
                 try:
                     while reported < n_procs:
                         st = q.get(timeout=0.2)
-                        for k in ("files", "rows", "errors"):
-                            merged[k] += st[k]
+                        for k in ("files", "rows", "errors", "retries",
+                                  "quarantined", "salvaged"):
+                            merged[k] += st.get(k, 0)
                         reported += 1
                 except queue_mod.Empty:
                     pass
                 break   # remaining workers died without reporting
             continue
-        for k in ("files", "rows", "errors"):
-            merged[k] += st[k]
+        for k in ("files", "rows", "errors", "retries", "quarantined",
+                  "salvaged"):
+            merged[k] += st.get(k, 0)
         reported += 1
     for p in procs:
         p.join()

@@ -136,9 +136,19 @@ def str_to_ip(strs) -> np.ndarray:
     return (parts[:, 0] << 24) | (parts[:, 1] << 16) | (parts[:, 2] << 8) | parts[:, 3]
 
 
-def decode_bytes(data: bytes, apply_sampling: bool = False) -> pd.DataFrame:
+def decode_bytes(data: bytes, apply_sampling: bool = False,
+                 strict: bool = True,
+                 salvage: dict | None = None) -> pd.DataFrame:
     """Decode a (possibly mixed) v5/v9/IPFIX packet stream into the
     ingest flow table.
+
+    With `strict=False` (the retry policy's final attempt), a malformed
+    stream is SALVAGED instead of rejected: the longest decodable
+    packet-aligned prefix lands as rows, the corrupt tail is skipped
+    and counted (`salvage` dict + obs counters) — see
+    `_salvage_wire_stream`. A stream with nothing decodable still
+    raises, so a pure-garbage file quarantines rather than committing
+    as an empty success.
 
     With `apply_sampling`, packet/byte counters are scaled by the
     ANNOUNCING exporter's sampling interval (options records, field 34
@@ -155,6 +165,8 @@ def decode_bytes(data: bytes, apply_sampling: bool = False) -> pd.DataFrame:
     bp = buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
     n = lib.nfx_count(bp, len(data))
     if n < 0:
+        if not strict:
+            return _salvage_wire_stream(data, apply_sampling, salvage)
         raise ValueError("malformed netflow v5/v9 stream")
     arrays = _flow_arrays(n)
     decode = lib.nfx_decode_scaled if apply_sampling else lib.nfx_decode
@@ -162,6 +174,90 @@ def decode_bytes(data: bytes, apply_sampling: bool = False) -> pd.DataFrame:
     if wrote != n:
         raise ValueError(f"decode error: wrote {wrote} of {n}")
     return _arrays_to_table(arrays, n)
+
+
+def _wire_packet_cuts(data: bytes) -> list[int]:
+    """Best-effort packet boundary offsets [0, end_of_pkt_1, ...] for a
+    mixed v5/v9/IPFIX stream, walked from the headers alone: v5 length
+    is computed from its record count, IPFIX carries an explicit length,
+    and v9 is walked flowset-by-flowset (set ids 2..255 are reserved on
+    the wire, so a u16 of 5/9/10 where a set id should be IS the next
+    packet header). The walk stops at the first frame that no longer
+    parses — everything before it is a candidate salvage prefix."""
+    cuts = [0]
+    off = 0
+    n = len(data)
+    while off + 4 <= n:
+        ver = int.from_bytes(data[off:off + 2], "big")
+        if ver == 5:
+            cnt = int.from_bytes(data[off + 2:off + 4], "big")
+            if not 0 < cnt <= 3000:
+                break
+            end = off + 24 + 48 * cnt
+        elif ver == 10:
+            ln = int.from_bytes(data[off + 2:off + 4], "big")
+            if ln < 16:
+                break
+            end = off + ln
+        elif ver == 9:
+            p = off + 20
+            if p > n:
+                break
+            while p + 4 <= n:
+                sid = int.from_bytes(data[p:p + 2], "big")
+                if sid in (5, 9, 10):
+                    break           # next packet header
+                flen = int.from_bytes(data[p + 2:p + 4], "big")
+                if flen < 4 or p + flen > n:
+                    p = -1          # malformed flowset framing
+                    break
+                p += flen
+            if p < 0:
+                break
+            end = p
+        else:
+            break
+        if end > n:
+            break
+        off = end
+        cuts.append(off)
+    return cuts
+
+
+def _salvage_wire_stream(data: bytes, apply_sampling: bool,
+                         salvage: dict | None) -> pd.DataFrame:
+    """Salvage-mode decode of a malformed wire stream: bisect the
+    longest packet-aligned prefix the native decoder accepts (prefix
+    validity is monotone — packets are independently framed), decode
+    it, and count the skipped tail. Raises the original malformed error
+    when NOTHING decodes — an all-garbage file must quarantine, never
+    commit as an empty success."""
+    from onix.utils.obs import counters
+
+    lib = load_library()
+    buf = np.frombuffer(data, np.uint8)
+    bp = buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+    cuts = _wire_packet_cuts(data)
+    lo, hi = 0, len(cuts) - 1       # cuts[lo] always decodable (empty)
+    while lo < hi:
+        mid = (lo + hi + 1) // 2
+        if lib.nfx_count(bp, cuts[mid]) >= 0:
+            lo = mid
+        else:
+            hi = mid - 1
+    good = cuts[lo]
+    n_rows = lib.nfx_count(bp, good) if good else 0
+    if good == 0 or n_rows <= 0:
+        raise ValueError("malformed netflow v5/v9 stream "
+                         "(nothing salvageable)")
+    skipped = len(data) - good
+    counters.inc("salvage.wire_skipped_bytes", skipped)
+    counters.inc("salvage.files")
+    if salvage is not None:
+        salvage["skipped_bytes"] = salvage.get("skipped_bytes", 0) + skipped
+        salvage["salvaged_records"] = (salvage.get("salvaged_records", 0)
+                                       + int(n_rows))
+    return decode_bytes(data[:good], apply_sampling=apply_sampling)
 
 
 def _flow_arrays(n: int) -> dict[str, np.ndarray]:
@@ -224,7 +320,8 @@ def is_nfcapd(data: bytes) -> bool:
     return data[:2] in _NFCAPD_MAGICS
 
 
-def decode_nfcapd(path: str | pathlib.Path) -> pd.DataFrame:
+def decode_nfcapd(path: str | pathlib.Path, strict: bool = True,
+                  salvage: dict | None = None) -> pd.DataFrame:
     """Decode an nfcapd file natively for layout-v1 files — uncompressed
     OR block-compressed (the clean-room reader in native/nfdecode
     decodes LZO1X and LZ4 blocks itself and BZ2 via the system libbz2;
@@ -236,6 +333,12 @@ def decode_nfcapd(path: str | pathlib.Path) -> pd.DataFrame:
     concern. Raises DecoderUnavailable when a file needs the absent
     tool.
 
+    With `strict=False`, a malformed file (truncated mid-block,
+    bit-flipped payload, lying block size) is salvaged block by block:
+    the container's explicit block framing lets each data block decode
+    independently, so intact blocks land as rows and corrupt ones are
+    skipped and counted (`_salvage_nfcapd`).
+
     Counters come back exactly as stored: nfdump applies any sampling
     scaling when it captures/stores, so there is nothing left to scale
     here (the wire-format paths' apply_sampling has no container
@@ -245,8 +348,13 @@ def decode_nfcapd(path: str | pathlib.Path) -> pd.DataFrame:
     buf = np.frombuffer(data, np.uint8)
     bp = buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
     n = lib.nfcapd_count_all(bp, len(data))
-    if n == -1:
-        raise ValueError(f"malformed nfcapd file: {path}")
+    if n == -1 or n == -5:
+        # Malformed framing / a compressed block the native decoders
+        # reject — both salvageable per block in non-strict mode.
+        if not strict:
+            return _salvage_nfcapd(data, path, salvage)
+        if n == -1:
+            raise ValueError(f"malformed nfcapd file: {path}")
     if n == -3:
         raise ValueError(
             f"{path}: nfcapd file written by a big-endian host is not "
@@ -256,6 +364,15 @@ def decode_nfcapd(path: str | pathlib.Path) -> pd.DataFrame:
     # file or decoder gap): all adjudicated by the format owner's tool.
     if n < 0:
         return _decode_nfcapd_nfdump(path)
+    try:
+        return _nfcapd_arrays_decode(data, lib, bp, int(n))
+    except ValueError:
+        if not strict:
+            return _salvage_nfcapd(data, path, salvage)
+        raise
+
+
+def _nfcapd_arrays_decode(data: bytes, lib, bp, n: int) -> pd.DataFrame:
     arrays = {
         "sip_hi": np.empty(n, np.uint64), "sip_lo": np.empty(n, np.uint64),
         "dip_hi": np.empty(n, np.uint64), "dip_lo": np.empty(n, np.uint64),
@@ -303,6 +420,67 @@ def _mixed_ip_strings(hi: np.ndarray, lo: np.ndarray,
              for h, l in uniq.tolist()], dtype=object)
         out[v6] = strs[inv]
     return out
+
+
+#: nfcapd layout-v1 geometry shared by the reader and the salvager:
+#: file header (12-byte fixed part + 128-byte ident), stat record, and
+#: the per-block header (<IIHH: NumRecords, size, id, pad).
+_NFCAPD_HEADER_LEN = 12 + 128
+_NFCAPD_STAT_LEN = 136
+_NFCAPD_BLOCK_HDR_LEN = 12
+
+
+def _salvage_nfcapd(data: bytes, path, salvage: dict | None) -> pd.DataFrame:
+    """Block-granular salvage of a malformed nfcapd v1 file. The
+    container frames every block with an explicit size and blocks are
+    self-contained (no cross-block template state, unlike v9), so each
+    block is re-wrapped as its own single-block file and decoded
+    independently: intact blocks land as rows, a truncated tail or a
+    bit-flipped/lying block is skipped and counted. Raises when nothing
+    decodes — an all-garbage file must quarantine, not commit empty."""
+    from onix.utils.obs import counters
+
+    lib = load_library()
+    body_off = _NFCAPD_HEADER_LEN + _NFCAPD_STAT_LEN
+    if len(data) < body_off or not is_nfcapd(data[:2]):
+        raise ValueError(f"malformed nfcapd file: {path} "
+                         "(header too short to salvage)")
+    head, stat = data[:_NFCAPD_HEADER_LEN], data[body_off - _NFCAPD_STAT_LEN:
+                                                 body_off]
+    one_block_head = head[:8] + (1).to_bytes(4, "little") + head[12:]
+    tables: list[pd.DataFrame] = []
+    skipped = 0
+    off = body_off
+    while off + _NFCAPD_BLOCK_HDR_LEN <= len(data):
+        size = int.from_bytes(data[off + 4:off + 8], "little")
+        end = off + _NFCAPD_BLOCK_HDR_LEN + size
+        if end > len(data):
+            skipped += 1            # truncated tail block
+            break
+        blob = one_block_head + stat + data[off:end]
+        buf = np.frombuffer(blob, np.uint8)
+        bp = buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+        n = lib.nfcapd_count_all(bp, len(blob))
+        if n < 0:
+            skipped += 1            # bit-flipped / lying block
+        else:
+            try:
+                tables.append(_nfcapd_arrays_decode(blob, lib, bp, int(n)))
+            except ValueError:
+                skipped += 1
+        off = end
+    total = sum(len(t) for t in tables)
+    if total == 0:
+        raise ValueError(f"malformed nfcapd file: {path} "
+                         "(nothing salvageable)")
+    counters.inc("salvage.nfcapd_skipped_blocks", skipped)
+    counters.inc("salvage.files")
+    if salvage is not None:
+        salvage["skipped_blocks"] = (salvage.get("skipped_blocks", 0)
+                                     + skipped)
+        salvage["salvaged_records"] = (salvage.get("salvaged_records", 0)
+                                       + total)
+    return pd.concat(tables, ignore_index=True)
 
 
 def _decode_nfcapd_nfdump(path: str | pathlib.Path) -> pd.DataFrame:
@@ -358,14 +536,16 @@ def _decode_nfcapd_nfdump(path: str | pathlib.Path) -> pd.DataFrame:
 
 
 def decode_file(path: str | pathlib.Path,
-                apply_sampling: bool = False) -> pd.DataFrame:
+                apply_sampling: bool = False, strict: bool = True,
+                salvage: dict | None = None) -> pd.DataFrame:
     data = pathlib.Path(path).read_bytes()
     if is_nfcapd(data):
         # Container files carry counters as nfdump stored them (any
         # sampling scaling already applied at capture) — apply_sampling
         # is a wire-format concern and has no effect here.
-        return decode_nfcapd(path)
-    return decode_bytes(data, apply_sampling=apply_sampling)
+        return decode_nfcapd(path, strict=strict, salvage=salvage)
+    return decode_bytes(data, apply_sampling=apply_sampling,
+                        strict=strict, salvage=salvage)
 
 
 # -- v5 packet writer (synthetic captures + round-trip tests) --------------

@@ -23,19 +23,67 @@ TSHARK_FIELDS = ["frame_time_epoch", "frame_len", "ip_src", "ip_dst",
                  "dns_qry_name", "dns_qry_type", "dns_qry_rcode"]
 
 
-def parse_tshark_dns(path: str | pathlib.Path) -> pd.DataFrame:
-    """Parse tshark TSV field output into the dns table schema."""
+def _count_salvaged(path, n_bad: int, n_good: int,
+                    salvage: dict | None) -> None:
+    """Record a text decoder's skipped-line tally (obs counters + the
+    caller's per-file salvage dict). A file with bad lines and ZERO
+    good ones is not salvage material — callers raise before this."""
+    from onix.utils.obs import counters
+
+    if n_bad == 0:
+        return
+    counters.inc("salvage.skipped_lines", n_bad)
+    counters.inc("salvage.files")
+    if salvage is not None:
+        salvage["skipped_lines"] = salvage.get("skipped_lines", 0) + n_bad
+        salvage["salvaged_records"] = (salvage.get("salvaged_records", 0)
+                                       + n_good)
+
+
+def parse_tshark_dns(path: str | pathlib.Path, strict: bool = True,
+                     salvage: dict | None = None) -> pd.DataFrame:
+    """Parse tshark TSV field output into the dns table schema.
+
+    `strict=False` (the retry policy's final attempt) skips malformed
+    lines — wrong field count, non-numeric epoch/length — with a
+    per-file salvage count instead of rejecting the whole file. A file
+    whose every line is malformed still raises (quarantine material,
+    not an empty success)."""
     rows = []
-    for line_no, line in enumerate(
-            pathlib.Path(path).read_text().splitlines(), 1):
+    n_bad = 0
+    had_lines = False
+    # errors="replace" ONLY in salvage mode: strict mode must hard-error
+    # on undecodable bytes (retry -> salvage), never commit mojibake as
+    # a first-attempt success.
+    text = pathlib.Path(path).read_text(
+        errors="replace" if not strict else "strict")
+    for line_no, line in enumerate(text.splitlines(), 1):
         if not line.strip():
             continue
+        had_lines = True
         parts = line.split("\t")
         if len(parts) != len(TSHARK_FIELDS):
+            if not strict:
+                n_bad += 1
+                continue
             raise ValueError(
                 f"{path}:{line_no}: expected {len(TSHARK_FIELDS)} "
                 f"tab-separated fields, got {len(parts)}")
         rows.append(parts)
+    if not strict and rows:
+        # Numeric sanity per row: a bit-flipped epoch/frame_len must
+        # drop its row, not poison the whole frame's conversion.
+        keep = []
+        for r in rows:
+            try:
+                float(r[0]), int(r[1])
+                keep.append(r)
+            except ValueError:
+                n_bad += 1
+        rows = keep
+    if had_lines and not rows:
+        raise ValueError(f"{path}: no parseable tshark TSV lines")
+    _count_salvaged(path, n_bad, len(rows), salvage)
     if not rows:
         return pd.DataFrame(columns=["frame_time", "frame_len", "ip_dst",
                                      "dns_qry_name", "dns_qry_type",
@@ -65,24 +113,53 @@ BLUECOAT_FIELDS = ["date", "time", "time_taken", "clientip", "respcode",
                    "csbytes"]
 
 
-def parse_bluecoat(path: str | pathlib.Path) -> pd.DataFrame:
-    """Parse Bluecoat-style access log lines into the proxy table schema."""
+def parse_bluecoat(path: str | pathlib.Path, strict: bool = True,
+                   salvage: dict | None = None) -> pd.DataFrame:
+    """Parse Bluecoat-style access log lines into the proxy table schema.
+
+    `strict=False` skips malformed lines (unbalanced quotes, wrong field
+    count, non-numeric respcode/byte counters) with a per-file salvage
+    count; a file with lines but NO parseable ones still raises."""
     rows = []
-    for line_no, line in enumerate(
-            pathlib.Path(path).read_text().splitlines(), 1):
+    n_bad = 0
+    had_lines = False
+    text = pathlib.Path(path).read_text(
+        errors="replace" if not strict else "strict")
+    for line_no, line in enumerate(text.splitlines(), 1):
         line = line.strip()
         if not line or line.startswith("#"):
             continue
+        had_lines = True
         try:
             parts = shlex.split(line)
         except ValueError as e:     # unbalanced quote in a field
+            if not strict:
+                n_bad += 1
+                continue
             raise ValueError(f"{path}:{line_no}: unparseable log line "
                              f"({e})") from e
         if len(parts) != len(BLUECOAT_FIELDS):
+            if not strict:
+                n_bad += 1
+                continue
             raise ValueError(
                 f"{path}:{line_no}: expected {len(BLUECOAT_FIELDS)} fields, "
                 f"got {len(parts)}")
         rows.append(parts)
+    if not strict and rows:
+        keep = []
+        for r in rows:
+            try:
+                int(r[BLUECOAT_FIELDS.index("respcode")])
+                int(r[BLUECOAT_FIELDS.index("csbytes")])
+                int(r[BLUECOAT_FIELDS.index("scbytes")])
+                keep.append(r)
+            except ValueError:
+                n_bad += 1
+        rows = keep
+    if had_lines and not rows:
+        raise ValueError(f"{path}: no parseable bluecoat log lines")
+    _count_salvaged(path, n_bad, len(rows), salvage)
     cols = ["p_date", "p_time", "clientip", "host", "reqmethod", "useragent",
             "resconttype", "respcode", "uripath", "csbytes", "scbytes"]
     if not rows:

@@ -95,18 +95,111 @@ def extract_dns_tsv(pcap_path: str | pathlib.Path) -> str:
     return p.stdout
 
 
-def parse_dns_pcap(pcap_path: str | pathlib.Path) -> pd.DataFrame:
-    """pcap -> the dns table schema (via the shared TSV contract)."""
+def _salvage_capture_bytes(data: bytes) -> tuple[bytes, int]:
+    """Best-effort clean of a corrupt capture: (cleaned bytes, skipped
+    block/record count). pcapng blocks carry explicit framed lengths
+    (type, total_length, trailing total_length) — blocks whose framing
+    lies are dropped and the walk resynchronizes at the reported
+    boundary; classic pcap records are truncated at the first
+    implausible header (incl_len past the snap ceiling). The cleaned
+    bytes go back through the normal extractor."""
+    import struct as _s
+
+    if len(data) >= 4 and data[:4] == b"\x0a\x0d\x0d\x0a":     # pcapng
+
+        def consistent(at: int) -> int:
+            """Block length at `at` if its framing is self-consistent
+            (sane length + the trailing total_length echo), else 0."""
+            if at + 12 > len(data):
+                return 0
+            blen = _s.unpack_from("<I", data, at + 4)[0]
+            if blen < 12 or blen % 4 or at + blen > len(data):
+                return 0
+            return blen if _s.unpack_from(
+                "<I", data, at + blen - 4)[0] == blen else 0
+
+        out = bytearray()
+        skipped = 0
+        off = 0
+        while off + 12 <= len(data):
+            blen = consistent(off)
+            if blen:
+                out += data[off:off + blen]
+                off += blen
+                continue
+            # Corrupt framing: drop this block and RESYNC at the next
+            # self-consistent block header (blocks are 4-aligned and
+            # carry their length twice, so a scan re-anchors reliably).
+            skipped += 1
+            p = off + 4
+            while p + 12 <= len(data) and not consistent(p):
+                p += 4
+            if p + 12 > len(data):
+                break
+            off = p
+        return bytes(out), skipped
+    if len(data) >= 24 and data[:4] in (b"\xd4\xc3\xb2\xa1",
+                                        b"\x4d\x3c\xb2\xa1"):  # LE pcap
+        out = bytearray(data[:24])
+        skipped = 0
+        off = 24
+        while off + 16 <= len(data):
+            incl = _s.unpack_from("<I", data, off + 8)[0]
+            if incl > (1 << 20) or off + 16 + incl > len(data):
+                skipped += 1
+                break               # implausible record: truncate here
+            out += data[off:off + 16 + incl]
+            off += 16 + incl
+        return bytes(out), skipped
+    return data, 0
+
+
+def parse_dns_pcap(pcap_path: str | pathlib.Path, strict: bool = True,
+                   salvage: dict | None = None) -> pd.DataFrame:
+    """pcap -> the dns table schema (via the shared TSV contract).
+
+    `strict=False` (the retry policy's final attempt) salvages a
+    corrupt capture: undecodable pcapng blocks / truncated pcap records
+    are dropped (counted) and the surviving frames go through the
+    normal extractor; malformed TSV rows are then line-skipped too. A
+    capture yielding NOTHING still raises — quarantine material."""
     import tempfile
 
     from onix.ingest.parsers import parse_tshark_dns
 
-    tsv = extract_dns_tsv(pcap_path)
+    try:
+        tsv = extract_dns_tsv(pcap_path)
+    except ValueError:
+        if strict:
+            raise
+        from onix.utils.obs import counters
+
+        data = pathlib.Path(pcap_path).read_bytes()
+        cleaned, skipped = _salvage_capture_bytes(data)
+        if not skipped and cleaned == data:
+            raise               # nothing to clean: not salvage material
+        with tempfile.NamedTemporaryFile(
+                suffix=pathlib.Path(pcap_path).suffix,
+                delete=False) as f:
+            f.write(cleaned)
+            tmp_cap = f.name
+        try:
+            tsv = extract_dns_tsv(tmp_cap)
+        finally:
+            pathlib.Path(tmp_cap).unlink(missing_ok=True)
+        if not tsv.strip():
+            raise ValueError(f"{pcap_path}: nothing salvageable "
+                             f"({skipped} corrupt blocks dropped)")
+        counters.inc("salvage.pcap_skipped_blocks", skipped)
+        counters.inc("salvage.files")
+        if salvage is not None:
+            salvage["skipped_blocks"] = (salvage.get("skipped_blocks", 0)
+                                         + skipped)
     with tempfile.NamedTemporaryFile("w", suffix=".tsv", delete=False) as f:
         f.write(tsv)
         tmp = f.name
     try:
-        return parse_tshark_dns(tmp)
+        return parse_tshark_dns(tmp, strict=strict, salvage=salvage)
     finally:
         pathlib.Path(tmp).unlink(missing_ok=True)
 

@@ -27,21 +27,34 @@ DEFAULT_PATTERNS = ("*.nf5", "*.tsv", "*.log", "*.csv", "*.pcap",
 
 
 def decode(datatype: str, path: str | pathlib.Path,
-           apply_sampling: bool = False) -> pd.DataFrame:
+           apply_sampling: bool = False, strict: bool = True,
+           salvage: dict | None = None) -> pd.DataFrame:
+    """Decode one raw file. `strict=False` is SALVAGE mode — the retry
+    policy's final attempt: malformed records/blocks/lines are skipped
+    and counted (`salvage` dict + obs counters) instead of rejecting
+    the whole file; a file with nothing decodable still raises.
+
+    Chaos hook: an `ingest:decode` rule in the active fault plan fires
+    here, before any bytes are read — the injected error is
+    indistinguishable from a poison file to the retry machinery."""
+    from onix.utils import faults
+
+    faults.fire("ingest", "decode")
     if datatype == "flow":
         from onix.ingest.nfdecode import decode_file
-        return decode_file(path, apply_sampling=apply_sampling)
+        return decode_file(path, apply_sampling=apply_sampling,
+                           strict=strict, salvage=salvage)
     if datatype == "dns":
         # .pcap goes through tshark-or-native extraction (SURVEY.md
         # §3.2 DNS variant); anything else is pre-extracted tshark TSV.
         if str(path).endswith((".pcap", ".pcapng", ".cap")):
             from onix.ingest.pcap import parse_dns_pcap
-            return parse_dns_pcap(path)
+            return parse_dns_pcap(path, strict=strict, salvage=salvage)
         from onix.ingest.parsers import parse_tshark_dns
-        return parse_tshark_dns(path)
+        return parse_tshark_dns(path, strict=strict, salvage=salvage)
     if datatype == "proxy":
         from onix.ingest.parsers import parse_bluecoat
-        return parse_bluecoat(path)
+        return parse_bluecoat(path, strict=strict, salvage=salvage)
     raise ValueError(f"unknown datatype {datatype!r}")
 
 
@@ -70,14 +83,18 @@ def _hour_of(datatype: str, table: pd.DataFrame) -> pd.Series:
 def ingest_file(store: Store, datatype: str,
                 path: str | pathlib.Path,
                 apply_sampling: bool = False,
-                by_hour: bool = False) -> dict[str, int]:
+                by_hour: bool = False, strict: bool = True,
+                salvage: dict | None = None) -> dict[str, int]:
     """Decode one raw file and append its rows to the day partitions it
     spans (Store.append allocates part numbers atomically, so parallel
     worker threads AND processes never collide). With `by_hour`
     (store.partition_hours), rows land in y=/m=/d=/h= sub-partitions —
     the reference's hourly Hive level (SURVEY.md §2.1 #3) — which every
-    day-scoped reader folds in transparently. Returns {date: n_rows}."""
-    table = decode(datatype, path, apply_sampling=apply_sampling)
+    day-scoped reader folds in transparently. `strict=False` decodes in
+    salvage mode (skip-and-count — the retry policy's final attempt).
+    Returns {date: n_rows}."""
+    table = decode(datatype, path, apply_sampling=apply_sampling,
+                   strict=strict, salvage=salvage)
     out: dict[str, int] = {}
     if not len(table):
         return out

@@ -476,6 +476,7 @@ def run_fit_segments(state, start: int, segments, *, superstep_fn,
     `notify(sweep, state, ll)` adapts each engine's public callback
     signature. Returns (state, ll_history)."""
     from onix import checkpoint as ckpt
+    from onix.utils import faults
 
     ll_history: list[tuple[int, float]] = []
     if not segments:
@@ -497,6 +498,10 @@ def run_fit_segments(state, start: int, segments, *, superstep_fn,
             raise ckpt.SimulatedPreemption(
                 f"fault injected after sweep {s} "
                 f"(checkpoint_dir={checkpoint_dir})")
+        # Declarative chaos plan (ONIX_FAULT_PLAN `fit:sweep@N=...`):
+        # fires at the first superstep boundary at or after sweep N —
+        # the generalized form of the legacy ONIX_FAULT_SWEEP hook.
+        faults.fire("fit", "sweep", index=s)
         if notify is not None:
             notify(s, state, ll_history[-1][1])
     return state, ll_history

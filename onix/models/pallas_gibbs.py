@@ -96,6 +96,8 @@ def _default_interpret() -> bool:
     try:
         platform = jax.devices()[0].platform
     except Exception:                           # noqa: BLE001
+        from onix.utils.obs import counters
+        counters.inc("pallas.device_probe_fallback")
         platform = jax.default_backend()
     return platform != "tpu"
 

@@ -308,6 +308,13 @@ def run_scoring(cfg: OnixConfig, engine: str = "gibbs",
         "bin_edges": {k: (v if isinstance(v, list) else np.asarray(v).tolist())
                       for k, v in words.edges.items()},
     }
+    # Resilience events tallied during this run (salvage skips, injected
+    # faults, checkpoint digest mismatches) — absent on a clean run.
+    from onix.utils.obs import counters as _counters
+    resil = {**_counters.snapshot("salvage"), **_counters.snapshot("faults"),
+             **_counters.snapshot("ckpt")}
+    if resil:
+        manifest["resilience"] = resil
     out_csv.with_suffix(".manifest.json").write_text(
         json.dumps(manifest, indent=2))
     cfg.archive(out_csv.with_suffix(".config.json"))

@@ -283,6 +283,15 @@ def run_scale(n_events: int, n_hosts: int | None = None,
            if resumed_sessions else {}),
         **stream_info,
     }
+    # Resilience events this run tallied (retries, salvage skips,
+    # injected faults, checkpoint digest mismatches) — empty on a clean
+    # run, and the chaos harness's evidence on a faulted one.
+    from onix.utils.obs import counters
+    resil = {**counters.snapshot("ingest"), **counters.snapshot("salvage"),
+             **counters.snapshot("faults"), **counters.snapshot("ckpt"),
+             **counters.snapshot("scale.resume_torn_discarded")}
+    if resil:
+        manifest["resilience"] = resil
     if out_path is not None:
         out_path = pathlib.Path(out_path)
         out_path.parent.mkdir(parents=True, exist_ok=True)
@@ -331,7 +340,10 @@ class _ResumeState:
             return None
         try:
             return np.load(p, allow_pickle=False)
-        except Exception:               # torn write from a killed run
+        except Exception as e:          # torn write from a killed run
+            from onix.utils.obs import counters
+            counters.inc("scale.resume_torn_discarded")
+            print(f"scale resume: discarding torn checkpoint {p} ({e!r})")
             p.unlink()
             return None
 

@@ -73,6 +73,8 @@ from onix.config import OnixConfig
 from onix.models.lda_svi import SVILda, SVIState, make_minibatch, phi_estimate
 from onix.models.scoring import score_all
 from onix.pipelines.words import WORD_FNS
+from onix.utils import resilience
+from onix.utils.obs import counters
 
 
 def _next_pow2(n: int, floor: int = 256) -> int:
@@ -525,7 +527,16 @@ class StreamingScorer:
         `cols` takes a pre-converted column dict from convert_columns
         (the ColumnPrefetcher hands it over) so the ~30%-of-batch-wall
         frame→columns host conversion (docs/PERF.md r6) that already ran
-        under the previous batch's device step is not paid again."""
+        under the previous batch's device step is not paid again.
+
+        Chaos hook: a `stream:batch` rule in the active fault plan
+        fires HERE, before any scorer state (model, doc table, gamma,
+        batch counter) is touched — so a caller that retries the batch
+        (run_stream does, bounded) replays it against unchanged state
+        and the stream's artifacts are identical to a fault-free run."""
+        from onix.utils import faults
+
+        faults.fire("stream", "batch")
         n_events = len(table)
         if n_events == 0:
             return BatchResult(np.empty(0), table.iloc[0:0].copy(), 0, 0,
@@ -785,8 +796,24 @@ def run_stream(cfg: OnixConfig, datatype: str, paths: list[str],
 
     todo = list(batches())
     prefetched = ColumnPrefetcher(scorer, (thunk for _, _, thunk in todo))
+    # Injected batch faults (the chaos drill) are retried under the
+    # shared bounded policy. The retry is restricted to InjectedFault
+    # BY DESIGN: the fault hook fires at process() entry before any
+    # scorer state mutates, so a replay is exact — whereas an arbitrary
+    # mid-process error (device OOM during the SVI step) could land
+    # after the model/doc-table updates and a blind replay would
+    # double-train the batch. Real errors propagate: streams fail
+    # loudly, they neither skip telemetry nor double-apply it.
+    from onix.utils.faults import InjectedFault
+    batch_policy = resilience.RetryPolicy(max_attempts=3,
+                                          base_backoff_s=0.05,
+                                          max_backoff_s=2.0,
+                                          salvage_on_final=False)
     for (epoch, p, _), (table, cols) in zip(todo, prefetched):
-        res = scorer.process(table, cols=cols)
+        res = resilience.retry_call(
+            lambda strict: scorer.process(table, cols=cols),
+            policy=batch_policy, counter_prefix="stream.batch",
+            retry_on=InjectedFault)
         total_events += res.n_events
         if epoch == epochs - 1 and len(res.alerts):
             # Alerts land in per-day files keyed like batch results.
@@ -805,4 +832,9 @@ def run_stream(cfg: OnixConfig, datatype: str, paths: list[str],
               f"svi step {res.step}")
     print(f"stream done: {total_events} events, {total_alerts} alerts, "
           f"{len(scorer.pad_shapes)} compiled shapes")
+    resil = {**counters.snapshot("stream.batch"),
+             **counters.snapshot("faults"),
+             **counters.snapshot("salvage")}
+    if resil:
+        print(f"stream resilience: {resil}")
     return 0

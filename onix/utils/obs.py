@@ -20,7 +20,49 @@ import contextlib
 import json
 import os
 import pathlib
+import threading
 import time
+
+
+class CounterRegistry:
+    """Process-wide named event counters — the one place every
+    resilience event (retry, quarantine, salvage, injected fault,
+    checkpoint digest mismatch) is tallied, so watcher stats, streaming
+    stage reports, and bench/scale manifests all read the same numbers
+    instead of each keeping a private ledger. Thread-safe; names are
+    dotted paths (`ingest.quarantined`, `salvage.skipped_records`)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts: dict[str, int] = {}
+
+    def inc(self, name: str, n: int = 1) -> int:
+        with self._lock:
+            self._counts[name] = self._counts.get(name, 0) + int(n)
+            return self._counts[name]
+
+    def get(self, name: str) -> int:
+        with self._lock:
+            return self._counts.get(name, 0)
+
+    def snapshot(self, prefix: str = "") -> dict[str, int]:
+        """Copy of the current counts (optionally only names under
+        `prefix`) — what manifests embed."""
+        with self._lock:
+            return {k: v for k, v in sorted(self._counts.items())
+                    if k.startswith(prefix)}
+
+    def reset(self, prefix: str = "") -> None:
+        with self._lock:
+            if not prefix:
+                self._counts.clear()
+            else:
+                for k in [k for k in self._counts if k.startswith(prefix)]:
+                    del self._counts[k]
+
+
+#: The process-global registry (tests reset() it between cases).
+counters = CounterRegistry()
 
 
 def enable_compile_cache(cache_dir: str | pathlib.Path) -> None:

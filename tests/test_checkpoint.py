@@ -60,6 +60,85 @@ def test_load_skips_torn_checkpoint(tmp_path):
     assert got is not None and got.sweep == 1
 
 
+def test_save_stamps_sha256_digest(tmp_path):
+    import hashlib
+    import json
+
+    ckpt.save(tmp_path, 3, {"x": np.arange(8)}, {"fingerprint": "f"})
+    meta = json.loads((tmp_path / "ckpt-000003.json").read_text())
+    assert meta["ckpt_format"] == 2
+    assert meta["npz_sha256"] == hashlib.sha256(
+        (tmp_path / "ckpt-000003.npz").read_bytes()).hexdigest()
+
+
+def test_digest_mismatch_falls_back_to_previous_checkpoint(tmp_path):
+    """A bit-flipped npz must be REJECTED by the digest check and the
+    load fall back to the previous intact checkpoint — np.load often
+    tolerates flipped array bytes, so 'it loaded' is not integrity."""
+    from onix.utils.obs import counters
+
+    counters.reset("ckpt")
+    ckpt.save(tmp_path, 2, {"x": np.arange(10)}, {"fingerprint": "f"},
+              keep=3)
+    ckpt.save(tmp_path, 4, {"x": np.arange(10) * 7}, {"fingerprint": "f"},
+              keep=3)
+    npz = tmp_path / "ckpt-000004.npz"
+    raw = bytearray(npz.read_bytes())
+    raw[len(raw) // 2] ^= 0xFF
+    npz.write_bytes(bytes(raw))
+    got = ckpt.load_latest(tmp_path)
+    assert got is not None and got.sweep == 2
+    np.testing.assert_array_equal(got.arrays["x"], np.arange(10))
+    assert counters.get("ckpt.digest_mismatch") == 1
+    # nothing intact left -> None, never corrupt state
+    (tmp_path / "ckpt-000002.npz").write_bytes(b"\x00" * 64)
+    assert ckpt.load_latest(tmp_path) is None
+
+
+def test_predigest_checkpoints_still_load(tmp_path):
+    """A checkpoint written before the digest layout (no npz_sha256 in
+    its meta) keeps loading — torn-file semantics already guarded the
+    failure mode it was written under."""
+    import json
+
+    with open(tmp_path / "ckpt-000006.npz", "wb") as f:
+        np.savez(f, x=np.arange(4))
+    (tmp_path / "ckpt-000006.json").write_text(
+        json.dumps({"fingerprint": "f", "sweep": 6}))
+    got = ckpt.load_latest(tmp_path)
+    assert got is not None and got.sweep == 6
+    np.testing.assert_array_equal(got.arrays["x"], np.arange(4))
+
+
+def test_resume_rejects_bit_flipped_checkpoint_end_to_end(tmp_path):
+    """The acceptance drill: preempt a fit, bit-flip the NEWEST
+    checkpoint on disk, and the resumed fit must fall back to the
+    previous checkpoint and still reach the uninterrupted result."""
+    corpus = _corpus(seed=8)
+    cfg = _cfg(n_sweeps=12, checkpoint_every=2)
+    ref = GibbsLDA(cfg, corpus.n_docs, corpus.n_vocab).fit(corpus)
+
+    model = GibbsLDA(cfg, corpus.n_docs, corpus.n_vocab)
+
+    def die_at(s, state, ll):
+        if s == 9:
+            raise SimulatedPreemption
+
+    with pytest.raises(SimulatedPreemption):
+        model.fit(corpus, callback=die_at, checkpoint_dir=tmp_path)
+    npzs = sorted(tmp_path.rglob("ckpt-*.npz"))
+    assert len(npzs) >= 2
+    newest = npzs[-1]
+    raw = bytearray(newest.read_bytes())
+    raw[len(raw) // 3] ^= 0x55
+    newest.write_bytes(bytes(raw))
+
+    resumed = GibbsLDA(cfg, corpus.n_docs, corpus.n_vocab).fit(
+        corpus, checkpoint_dir=tmp_path)
+    _assert_states_equal(ref["state"], resumed["state"])
+    np.testing.assert_allclose(ref["theta"], resumed["theta"])
+
+
 def test_gibbs_resume_is_bit_identical(tmp_path):
     corpus = _corpus()
     cfg = _cfg()

@@ -154,7 +154,7 @@ def test_watcher_ingests_and_dedupes(tmp_path):
     # same size+mtime across two polls before it is claimed).
     assert w.poll_once() == 0
     assert w.poll_once() == 2
-    assert w.stats == {"files": 2, "rows": 70, "errors": 0}
+    assert (w.stats["files"], w.stats["rows"], w.stats["errors"]) == (2, 70, 0)
     # Unchanged files are not re-ingested.
     assert w.poll_once() == 0
     # A new file while running in a thread is picked up.
@@ -173,13 +173,25 @@ def test_watcher_ingests_and_dedupes(tmp_path):
     assert w2.poll_once() == 0 and w2.poll_once() == 0
     w2._pool.shutdown()
 
-    # Bad file: error counted, claim released for retry.
+    # Bad file: error counted, claim released, retried under the
+    # BOUNDED budget (zero backoff here so polls retry immediately),
+    # then quarantined — never the pre-r8 retry-every-poll-forever.
+    from onix.utils.resilience import RetryPolicy
     (landing / "bad.nf5").write_bytes(b"garbage bytes here")
-    w3 = IngestWatcher(cfg, "flow", landing)
+    w3 = IngestWatcher(cfg, "flow", landing,
+                       retry=RetryPolicy(max_attempts=3, base_backoff_s=0,
+                                         jitter=0))
     assert w3.poll_once() == 0    # observing poll
     assert w3.poll_once() == 1
     assert w3.stats["errors"] == 1
     assert w3.poll_once() == 1    # retried (still failing)
+    assert w3.poll_once() == 1    # final (salvage) attempt -> quarantine
+    assert w3.poll_once() == 0    # dead-lettered: never offered again
+    assert w3.stats["errors"] == 3
+    assert w3.stats["retries"] == 2
+    assert w3.stats["quarantined"] == 1
+    assert not (landing / "bad.nf5").exists()
+    assert (landing / "quarantine" / "bad.nf5").exists()
     w3._pool.shutdown()
 
 

@@ -1,0 +1,560 @@
+"""Resilience-layer tests: retry policy, quarantine-on-poison ingest,
+salvage decode, ledger compaction, and the corrupt-input corpus.
+
+The contract under test (ISSUE 4 / docs/ROBUSTNESS.md): a poison file
+is retried at most N times with backoff — the FINAL attempt in salvage
+mode so a mostly-good capture still lands — then moves to `quarantine/`
+with a JSON sidecar and is never re-claimed; good files keep flowing
+throughout. Pre-r8, one corrupt nfcapd file was retried on every poll
+forever and one malformed record rejected an entire file.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from onix.config import OnixConfig
+from onix.ingest.watcher import IngestWatcher, Ledger
+from onix.store import Store
+from onix.utils.obs import counters
+from onix.utils.resilience import (Deadline, DeadlineExceeded, RetryPolicy,
+                                   quarantine_file, retry_call,
+                                   run_with_deadline)
+
+try:
+    from onix.ingest import nfdecode as nfd
+    nfd.load_library()
+    HAVE_DECODER = True
+except Exception:
+    HAVE_DECODER = False
+
+needs_decoder = pytest.mark.skipif(not HAVE_DECODER,
+                                   reason="g++/make unavailable")
+
+
+@pytest.fixture(autouse=True)
+def _reset_counters():
+    counters.reset()
+    yield
+    counters.reset()
+
+
+def _fast_retry(**kw):
+    base = dict(max_attempts=3, base_backoff_s=0.0, jitter=0.0)
+    base.update(kw)
+    return RetryPolicy(**base)
+
+
+# ---------------------------------------------------------------------------
+# RetryPolicy / retry_call / Deadline
+# ---------------------------------------------------------------------------
+
+
+def test_retry_policy_backoff_and_salvage_schedule():
+    p = RetryPolicy(max_attempts=3, base_backoff_s=1.0, max_backoff_s=3.0,
+                    jitter=0.0)
+    assert [p.backoff(k) for k in (1, 2, 3, 4)] == [1.0, 2.0, 3.0, 3.0]
+    # strict, strict, salvage — the last budgeted attempt skips-and-counts
+    assert [p.strict_for_attempt(k) for k in (1, 2, 3)] == [True, True, False]
+    assert not p.exhausted(2) and p.exhausted(3)
+    # jitter stays inside its band and never goes negative
+    pj = RetryPolicy(base_backoff_s=1.0, jitter=0.5)
+    for _ in range(50):
+        assert 0.5 <= pj.backoff(1) <= 1.5
+
+
+def test_retry_call_retries_then_salvages_then_raises():
+    calls = []
+
+    def flaky(strict):
+        calls.append(strict)
+        raise ValueError("nope")
+
+    with pytest.raises(ValueError):
+        retry_call(flaky, policy=_fast_retry(), counter_prefix="t")
+    assert calls == [True, True, False]
+    assert counters.get("t.retries") == 2
+    assert counters.get("t.failures") == 3
+
+    calls.clear()
+
+    def heals(strict):
+        calls.append(strict)
+        if len(calls) < 2:
+            raise ValueError("transient")
+        return "ok"
+
+    assert retry_call(heals, policy=_fast_retry()) == "ok"
+    assert calls == [True, True]
+
+
+def test_deadline_and_thread_wrapper():
+    d = Deadline(seconds=0.0)
+    assert d.expired()
+    with pytest.raises(DeadlineExceeded):
+        d.check("decode")
+    assert Deadline(seconds=60).remaining() > 50
+    assert run_with_deadline(lambda x: x * 2, 5.0, 21) == 42
+    with pytest.raises(DeadlineExceeded):
+        run_with_deadline(time.sleep, 0.05, 5.0, what="nap")
+    assert counters.get("resilience.deadline_exceeded") >= 2
+
+
+def test_quarantine_file_moves_and_sidecars(tmp_path):
+    f = tmp_path / "poison.log"
+    f.write_text("bad")
+    sidecar = quarantine_file(f, tmp_path / "quarantine", error="boom",
+                              attempts=3, traceback="tb", sig=[3, 1.0])
+    assert not f.exists()
+    assert (tmp_path / "quarantine" / "poison.log").read_text() == "bad"
+    meta = json.loads(sidecar.read_text())
+    assert meta["error"] == "boom" and meta["attempts"] == 3
+    assert meta["sig"] == [3, 1.0] and meta["traceback"] == "tb"
+    # a re-delivered poison file never overwrites the prior evidence
+    f.write_text("bad2")
+    s2 = quarantine_file(f, tmp_path / "quarantine", error="boom2",
+                         attempts=3)
+    assert s2 != sidecar
+    assert (tmp_path / "quarantine" / "poison.log.1").read_text() == "bad2"
+    assert counters.get("ingest.quarantined") == 2
+
+
+# ---------------------------------------------------------------------------
+# Ledger semantics (the two satellite fixes + attempts/quarantine)
+# ---------------------------------------------------------------------------
+
+
+def test_ledger_release_keeps_done_record(tmp_path):
+    """release() after a failed RE-ingest of a changed file must drop
+    only the in-flight claim — the durable record of the EARLIER
+    successful ingest survives (pre-r8 it was erased, so a crash during
+    the re-ingest forgot the original delivery entirely)."""
+    f = tmp_path / "a.log"
+    f.write_text("v1")
+    led = Ledger(tmp_path / "ledger.json")
+    assert led.claim(f)
+    led.commit(f)
+    old_sig = Ledger._key(f)[1]
+    # file changes -> re-offered -> claimed -> ingest fails -> released
+    f.write_text("v2 longer")
+    assert led.claim(f)
+    led.release(f)
+    led2 = Ledger(tmp_path / "ledger.json")
+    assert led2._done[str(f.resolve())] == old_sig
+
+    # changed file is claimable again; the ORIGINAL sig stays done
+    assert led2.claim(f)
+
+
+def test_ledger_attempts_persist_and_reset_on_change(tmp_path):
+    f = tmp_path / "a.log"
+    f.write_text("x")
+    led = Ledger(tmp_path / "ledger.json")
+    assert led.claim(f)
+    n, sig = led.record_failure(f)
+    assert (n, led.attempts_of(f)) == (1, 1)
+    led.release(f)
+    # attempts survive a watcher restart (fresh Ledger instance)
+    led2 = Ledger(tmp_path / "ledger.json")
+    assert led2.attempts_of(f) == 1
+    assert led2.claim(f)
+    n2, _ = led2.record_failure(f)
+    assert n2 == 2
+    led2.release(f)
+    # changed content restarts the budget
+    f.write_text("different bytes")
+    assert led2.attempts_of(f) == 0
+
+
+def test_ledger_quarantine_blocks_reclaim_and_survives_restart(tmp_path):
+    f = tmp_path / "a.log"
+    f.write_text("x")
+    led = Ledger(tmp_path / "ledger.json")
+    assert led.claim(f)
+    _, sig = led.record_failure(f)
+    led.quarantine(f, sig)
+    assert not led.claim(f)
+    led2 = Ledger(tmp_path / "ledger.json")
+    assert not led2.claim(f)
+    # CHANGED content under the same path gets a fresh chance
+    f.write_text("brand new content")
+    assert led2.claim(f)
+
+
+def test_ledger_prunes_missing_files_but_keeps_quarantined(tmp_path):
+    """Satellite: done/attempt entries for files that left the disk are
+    pruned (long-lived watchers must not grow unboundedly); quarantined
+    entries are kept — they block an identical re-delivery."""
+    a, b, c = tmp_path / "a.log", tmp_path / "b.log", tmp_path / "c.log"
+    for f in (a, b, c):
+        f.write_text("x")
+    led = Ledger(tmp_path / "ledger.json")
+    for f in (a, b):
+        assert led.claim(f)
+    led.commit(a)
+    led.record_failure(b)
+    led.release(b)
+    assert led.claim(c)
+    _, sig = led.record_failure(c)
+    led.quarantine(c, sig)
+    a.unlink()
+    b.unlink()
+    c.unlink()      # quarantine would have moved it
+    assert led.prune_missing() == 2
+    led2 = Ledger(tmp_path / "ledger.json")
+    assert not led2._done and not led2._attempts
+    assert led2._quarantined
+
+
+def test_watcher_prunes_last_sig(tmp_path):
+    landing = tmp_path / "landing"
+    landing.mkdir()
+    cfg = OnixConfig()
+    cfg.store.root = str(tmp_path / "store")
+    w = IngestWatcher(cfg, "proxy", landing, prune_every=2)
+    f = landing / "a.log"
+    f.write_text("# only comments\n")
+    w.poll_once()
+    assert w._last_sig
+    f.unlink()
+    w.poll_once()       # 2nd poll: prune cycle
+    assert not w._last_sig
+    w._pool.shutdown()
+
+
+def test_ledger_v1_layout_loads_as_done(tmp_path):
+    f = tmp_path / "a.log"
+    f.write_text("x")
+    key, sig = Ledger._key(f)
+    (tmp_path / "ledger.json").write_text(json.dumps({key: sig}))
+    led = Ledger(tmp_path / "ledger.json")
+    assert not led.claim(f)         # recorded done under the v1 layout
+
+
+# ---------------------------------------------------------------------------
+# Corrupt-input corpus through the watcher (satellite): each poison
+# class -> bounded retries -> quarantine with sidecar; salvageable files
+# land on the final attempt; good-file throughput unaffected.
+# ---------------------------------------------------------------------------
+
+
+GOOD_LINE = ('2016-07-08 09:15:00 120 10.0.0.1 200 TCP_HIT GET http '
+             'example.com 80 / - - - text/html "UA one" - 500 300\n')
+
+
+def _drain(w, want, seconds=10.0):
+    deadline = time.time() + seconds
+    while time.time() < deadline:
+        w.poll_once()
+        if want(w):
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def test_unbalanced_quote_bluecoat_corpus(tmp_path):
+    """Unbalanced-quote Bluecoat poison: all-bad file quarantined with
+    sidecar after the full budget; partly-bad file SALVAGED on the
+    final attempt (bad lines skipped and counted); good file rows all
+    land."""
+    landing = tmp_path / "landing"
+    landing.mkdir()
+    cfg = OnixConfig()
+    cfg.store.root = str(tmp_path / "store")
+    (landing / "good.log").write_text(GOOD_LINE * 7)
+    (landing / "poison.log").write_text('2016-07-08 "never closed\n' * 3)
+    (landing / "partial.log").write_text(
+        GOOD_LINE * 4 + '2016-07-08 "never closed\n' + GOOD_LINE * 2)
+    w = IngestWatcher(cfg, "proxy", landing, n_workers=2,
+                      retry=_fast_retry())
+    assert w.poll_once() == 0       # quiescence poll
+    assert _drain(w, lambda w: w.stats["quarantined"] == 1
+                  and w.stats["files"] == 2)
+    assert w.stats["salvaged"] == 1
+    assert w.stats["retries"] == 4          # 2 per failing file
+    # good + salvaged rows all landed: 7 + (4 + 2)
+    store = Store(cfg.store.root)
+    assert sum(len(store.read("proxy", d))
+               for d in store.dates("proxy")) == 13
+    sidecar = json.loads(
+        (landing / "quarantine" / "poison.log.quarantine.json").read_text())
+    assert sidecar["attempts"] == 3
+    assert "bluecoat" in sidecar["error"] or "ValueError" in sidecar["error"]
+    assert sidecar["traceback"]
+    assert counters.get("salvage.skipped_lines") == 1
+    # quarantined file never re-offered (poll finds nothing new)
+    before = w.stats["errors"]
+    for _ in range(3):
+        assert w.poll_once() == 0
+    assert w.stats["errors"] == before
+    w._pool.shutdown()
+
+
+@needs_decoder
+def test_truncated_nfcapd_corpus(tmp_path):
+    """Truncated nfcapd: strict attempts fail, the final salvage
+    attempt lands every intact block's rows; pure garbage quarantines;
+    a clean capture is unaffected."""
+    from tests.test_ingest import _synth_flow_arrays
+
+    landing = tmp_path / "landing"
+    landing.mkdir()
+    cfg = OnixConfig()
+    cfg.store.root = str(tmp_path / "store")
+    table = _synth_flow_arrays(n=60, seed=5)
+    data = nfd.write_nfcapd(table, records_per_block=20)
+    (landing / "nfcapd.201607080000").write_bytes(data)
+    (landing / "nfcapd.201607080500").write_bytes(data[:-40])    # torn tail
+    (landing / "nfcapd.201607081000").write_bytes(
+        b"\x0c\xa5" + os.urandom(400))                           # garbage
+    w = IngestWatcher(cfg, "flow", landing, n_workers=2,
+                      retry=_fast_retry())
+    assert w.poll_once() == 0
+    assert _drain(w, lambda w: w.stats["quarantined"] == 1
+                  and w.stats["files"] == 2)
+    assert w.stats["salvaged"] == 1
+    store = Store(cfg.store.root)
+    total = sum(len(store.read("flow", d)) for d in store.dates("flow"))
+    # clean file: 60 rows; torn file: all but its torn tail block
+    assert 60 + 40 <= total < 120
+    assert counters.get("salvage.nfcapd_skipped_blocks") >= 1
+    assert (landing / "quarantine" / "nfcapd.201607081000").exists()
+    w._pool.shutdown()
+
+
+def test_bit_flipped_pcapng_corpus(tmp_path):
+    """Bit-flipped pcapng (corrupt block length framing): strict
+    attempts fail, salvage resynchronizes past the corrupt block and
+    lands the surviving frames."""
+    import struct
+
+    from onix.ingest import pcap as pc
+
+    landing = tmp_path / "landing"
+    landing.mkdir()
+    cfg = OnixConfig()
+    cfg.store.root = str(tmp_path / "store")
+    table = pd.DataFrame({
+        "ip_dst": ["10.0.0.%d" % (i % 5 + 1) for i in range(12)],
+        "dns_qry_name": ["host%d.example.com" % i for i in range(12)],
+        "dns_qry_type": [1] * 12, "dns_qry_rcode": [0] * 12,
+        "frame_time_epoch": 1467972000.0 + np.arange(12.0)})
+    data = pc.write_dns_pcapng(table)
+    raw = bytearray(data)
+    off, seen = 0, 0
+    while off + 12 <= len(raw):
+        btype, blen = struct.unpack_from("<II", raw, off)
+        if btype == 6:
+            seen += 1
+            if seen == 3:
+                struct.pack_into("<I", raw, off + 4, 0x0FFFFFF0)
+                break
+        off += blen
+    assert seen == 3
+    (landing / "flip.pcapng").write_bytes(bytes(raw))
+    (landing / "clean.pcapng").write_bytes(data)
+    try:
+        w = IngestWatcher(cfg, "dns", landing, n_workers=2,
+                          retry=_fast_retry())
+    except Exception:
+        pytest.skip("dns ingest unavailable")
+    assert w.poll_once() == 0
+    try:
+        ok = _drain(w, lambda w: w.stats["files"] == 2)
+    finally:
+        w._pool.shutdown()
+    if not ok and w.stats["files"] == 0:
+        pytest.skip("no pcap extractor available in this environment")
+    assert ok
+    assert w.stats["salvaged"] == 1
+    assert w.stats["quarantined"] == 0
+    assert counters.get("salvage.pcap_skipped_blocks") >= 1
+    store = Store(cfg.store.root)
+    total = sum(len(store.read("dns", d)) for d in store.dates("dns"))
+    assert total == 12 + 11         # clean file + all-but-one salvaged
+
+
+# ---------------------------------------------------------------------------
+# Salvage decoders directly
+# ---------------------------------------------------------------------------
+
+
+def test_parse_bluecoat_salvage_counts(tmp_path):
+    from onix.ingest.parsers import parse_bluecoat
+
+    p = tmp_path / "a.log"
+    p.write_text(GOOD_LINE + '2016-07-08 "broken\n'
+                 + GOOD_LINE.replace(" 500 300", " 5x0 300")
+                 + GOOD_LINE)
+    with pytest.raises(ValueError):
+        parse_bluecoat(p)
+    s = {}
+    out = parse_bluecoat(p, strict=False, salvage=s)
+    assert len(out) == 2
+    assert s["skipped_lines"] == 2 and s["salvaged_records"] == 2
+    # nothing parseable -> still an error (quarantine material)
+    bad = tmp_path / "b.log"
+    bad.write_text('2016-07-08 "broken\n' * 2)
+    with pytest.raises(ValueError, match="no parseable"):
+        parse_bluecoat(bad, strict=False)
+
+
+def test_parse_tshark_dns_salvage_counts(tmp_path):
+    from onix.ingest.parsers import parse_tshark_dns
+
+    p = tmp_path / "a.tsv"
+    p.write_text(
+        "1467972000.5\t82\t8.8.8.8\t10.0.0.7\twww.example.com\t1\t0\n"
+        "short\tline\n"
+        "not_a_number\t82\t8.8.8.8\t10.0.0.9\tx.com\t1\t0\n"
+        "1467972001.2\t120\t8.8.4.4\t10.0.0.9\tzzz.bad.biz\t16\t3\n")
+    with pytest.raises(ValueError):
+        parse_tshark_dns(p)
+    s = {}
+    out = parse_tshark_dns(p, strict=False, salvage=s)
+    assert len(out) == 2
+    assert s["skipped_lines"] == 2
+    bad = tmp_path / "b.tsv"
+    bad.write_text("just\tgarbage\n")
+    with pytest.raises(ValueError, match="no parseable"):
+        parse_tshark_dns(bad, strict=False)
+
+
+@needs_decoder
+def test_wire_stream_salvage_prefix(tmp_path):
+    from tests.test_ingest import _synth_flow_arrays
+
+    table = _synth_flow_arrays(n=40, seed=9)
+    blob = nfd.write_v5(table) + nfd.write_v9(table)
+    trunc = blob[:-25]
+    with pytest.raises(ValueError):
+        nfd.decode_bytes(trunc)
+    s = {}
+    out = nfd.decode_bytes(trunc, strict=False, salvage=s)
+    assert 40 <= len(out) < 80          # v5 stream + v9 head survive
+    assert s["skipped_bytes"] > 0 and s["salvaged_records"] == len(out)
+    with pytest.raises(ValueError, match="salvageable"):
+        nfd.decode_bytes(b"\x00\x00garbage" * 20, strict=False)
+
+
+@needs_decoder
+def test_nfcapd_block_salvage(tmp_path):
+    from tests.test_ingest import _synth_flow_arrays
+
+    table = _synth_flow_arrays(n=60, seed=11)
+    data = nfd.write_nfcapd(table, records_per_block=20)
+    torn = tmp_path / "nfcapd.torn"
+    torn.write_bytes(data[:-33])
+    with pytest.raises(ValueError):
+        nfd.decode_file(torn)
+    s = {}
+    out = nfd.decode_file(torn, strict=False, salvage=s)
+    # 4 blocks (ext-map/exporter block + 3 record blocks of 20): the
+    # torn tail drops one record block at most
+    assert len(out) >= 40
+    assert s["skipped_blocks"] == 1
+    assert s["salvaged_records"] == len(out)
+
+
+# ---------------------------------------------------------------------------
+# mpingest quarantine protocol
+# ---------------------------------------------------------------------------
+
+
+def test_mpingest_retry_then_quarantine(tmp_path):
+    from onix.ingest.mpingest import ClaimStore, worker_loop
+
+    landing = tmp_path / "landing"
+    landing.mkdir()
+    cfg = OnixConfig()
+    cfg.store.root = str(tmp_path / "store")
+    cfg.validate()
+    good = landing / "good.log"
+    good.write_text(GOOD_LINE * 3)
+    bad = landing / "poison.log"
+    bad.write_text('2016-07-08 "never closed\n')
+    old = time.time() - 60
+    os.utime(good, (old, old))
+    os.utime(bad, (old, old))
+    policy = _fast_retry()
+    stats = {"files": 0, "rows": 0, "errors": 0, "retries": 0,
+             "quarantined": 0, "salvaged": 0}
+    # drive several drain passes: each pass burns one attempt
+    for _ in range(4):
+        st = worker_loop(cfg, "proxy", landing, idle_exit=True,
+                         retry=policy, settle_seconds=1.0)
+        for k in stats:
+            stats[k] += st[k]
+    assert stats["files"] == 1 and stats["rows"] == 3
+    assert stats["errors"] == 3
+    assert stats["quarantined"] == 1 and stats["retries"] == 2
+    assert (landing / "quarantine" / "poison.log").exists()
+    sidecar = json.loads((landing / "quarantine"
+                          / "poison.log.quarantine.json").read_text())
+    assert sidecar["attempts"] == 3
+    claims = ClaimStore(landing)
+    assert list(claims.dir.glob("*.quarantined"))
+    assert not list(claims.dir.glob("*.claim"))
+    # the quarantined marker survives; nothing further happens
+    st = worker_loop(cfg, "proxy", landing, idle_exit=True, retry=policy,
+                     settle_seconds=1.0)
+    assert st["errors"] == 0 and st["files"] == 0
+
+
+def test_mpingest_prune_missing_markers(tmp_path):
+    from onix.ingest.mpingest import ClaimStore
+
+    landing = tmp_path / "landing"
+    landing.mkdir()
+    f = landing / "a.log"
+    f.write_text(GOOD_LINE)
+    claims = ClaimStore(landing)
+    d = claims.try_claim(f)
+    claims.commit(d)
+    assert claims.done_count() == 1
+    f.unlink()
+    assert claims.prune_missing() == 1
+    assert claims.done_count() == 0
+
+
+def test_mpingest_commit_clears_attempts_marker(tmp_path):
+    """A fail-then-succeed file must not leave a stale backoff gate in
+    the claims dir (Ledger.commit clears attempts the same way)."""
+    from onix.ingest.mpingest import ClaimStore
+
+    landing = tmp_path / "landing"
+    landing.mkdir()
+    f = landing / "a.log"
+    f.write_text(GOOD_LINE)
+    claims = ClaimStore(landing)
+    d = claims.try_claim(f)
+    claims.record_failure(d, f, backoff_s=60.0)
+    claims.release(d)
+    assert claims.try_claim(f) is None      # backoff gate holds
+    (claims._attempts_path(d)).write_text(
+        claims._attempts_path(d).read_text().replace(
+            '"not_before"', '"nb_old"'))    # expire the gate
+    d2 = claims.try_claim(f)
+    assert d2 == d
+    claims.commit(d2)
+    assert not claims._attempts_path(d).exists()
+    assert claims.attempts_of(d) == 0
+
+
+def test_parsers_strict_mode_rejects_undecodable_bytes(tmp_path):
+    """Mojibake must not enter the store as a first-attempt success:
+    strict mode hard-errors on undecodable bytes; salvage mode decodes
+    with replacement and line-filters."""
+    from onix.ingest.parsers import parse_bluecoat
+
+    p = tmp_path / "a.log"
+    p.write_bytes(GOOD_LINE.encode() + b"\xff\xfe broken bytes\n"
+                  + GOOD_LINE.encode())
+    with pytest.raises(UnicodeDecodeError):
+        parse_bluecoat(p)
+    out = parse_bluecoat(p, strict=False)
+    assert len(out) == 2
