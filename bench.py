@@ -496,6 +496,96 @@ def bench_scoring_zipf(jax, jnp, n_docs, n_vocab, tag, small=False):
     }
 
 
+def bench_streaming(jax, jnp, small=False):
+    """streaming: the minibatch pipeline's events/s on a synthetic flow
+    feed — the per-batch path vs the fused superstep path
+    (pipeline.stream_superstep) over the SAME batches, so the pipeline
+    rate (VERDICT r5 item 5's judged number) regresses visibly in
+    every bench run instead of living only in stream_scale artifacts.
+
+    Protocol: one warm epoch per arm compiles every program (streams
+    run warm — cold compile is a one-time cost the persistent cache
+    absorbs on accelerators), then a timed epoch on a FRESH feed of
+    identical shapes. The two arms' alert sets are asserted
+    winner-set-identical per batch — the superstep rate can never
+    silently come from different detections. Stage walls, dispatch
+    counts, compiled-shape stats, and a modeled E-step roofline
+    fraction (obs.svi_estep_bytes_per_pair) ride along."""
+    import dataclasses as dc
+
+    from onix.config import OnixConfig
+    from onix.pipelines.streaming import StreamingScorer
+    from onix.pipelines.synth import synth_flow_day
+    from onix.utils.obs import (device_peak_bytes_per_s, roofline,
+                                svi_estep_bytes_per_pair)
+
+    n_batches = 6 if small else 10
+    batch_events = 20_000 if small else 100_000
+    superstep = 3 if small else 5
+    cfg = OnixConfig()
+    cfg.validate()
+
+    def feed(seed0):
+        return [synth_flow_day(n_events=batch_events,
+                               n_hosts=max(120, batch_events // 250),
+                               n_anomalies=8, seed=seed0 + b)[0]
+                for b in range(n_batches)]
+
+    warm, timed = feed(500), feed(900)
+
+    def run_arm(s):
+        c = dc.replace(cfg, pipeline=dc.replace(cfg.pipeline,
+                                                stream_superstep=s))
+        sc = StreamingScorer(c, "flow", n_buckets=1 << 12)
+        sc.process_many([(t, None) for t in warm])
+        for key in sc.stage_walls:
+            sc.stage_walls[key] = 0.0
+        base_dispatch = dict(sc.dispatches)
+        base_pairs = sc.pair_rows
+        t0 = time.perf_counter()
+        results = sc.process_many([(t, None) for t in timed])
+        np.asarray(results[-1].scores)
+        dt = time.perf_counter() - t0
+        disp = {k: v - base_dispatch[k] for k, v in sc.dispatches.items()}
+        return sc, results, dt, disp, sc.pair_rows - base_pairs
+
+    sc_a, res_a, dt_a, disp_a, _ = run_arm(1)
+    sc_b, res_b, dt_b, disp_b, pairs = run_arm(superstep)
+    parity = all(
+        set(a.alerts["event_idx"].tolist())
+        == set(b.alerts["event_idx"].tolist())
+        for a, b in zip(res_a, res_b))
+    assert parity, "superstep arm's winner sets diverged from per-batch"
+    n_events = sum(r.n_events for r in res_a)
+    try:
+        peak, peak_src = device_peak_bytes_per_s()
+    except Exception:                           # noqa: BLE001
+        peak, peak_src = None, "probe failed"
+    iters = sc_b._lda_eff.svi_warm_iters or sc_b._lda_eff.svi_local_iters
+    rl = roofline(pairs, sc_b.stage_walls["svi_update"],
+                  svi_estep_bytes_per_pair(cfg.lda.n_topics, iters), peak)
+    rl["peak_source"] = peak_src
+    return {
+        "events_per_sec_superstep": round(n_events / dt_b, 1),
+        "events_per_sec_per_batch": round(n_events / dt_a, 1),
+        "speedup_superstep_vs_per_batch": round(dt_a / dt_b, 3),
+        "winner_sets_identical": parity,
+        "superstep": superstep,
+        "n_batches": n_batches, "events_per_batch": batch_events,
+        "dispatches_per_batch_arm": disp_a,
+        "dispatches_superstep_arm": disp_b,
+        "stage_walls_per_batch_arm": {
+            k: round(v, 3) for k, v in sc_a.stage_walls.items()},
+        "stage_walls_superstep_arm": {
+            k: round(v, 3) for k, v in sc_b.stage_walls.items()},
+        "compiled_shapes": sorted(sc_b.pad_shapes),
+        "shape_stats": dict(sc_b.shape_stats),
+        "svi_estep_roofline_modeled": rl,
+        "wall_seconds_superstep": round(dt_b, 3),
+        "wall_seconds_per_batch": round(dt_a, 3),
+    }
+
+
 def _roofline_detail(detail: dict) -> dict | None:
     """detail.roofline: achieved bytes/s + fraction-of-peak for the two
     judged hot loops, from each component's modeled per-item traffic
@@ -864,6 +954,10 @@ def _measure() -> None:
     run("scoring_zipf_dedup",
         lambda: bench_scoring_zipf(jax, jnp, 1_000_000, 2_048,
                                    "pair_dedup", small=fallback))
+    # The streaming minibatch pipeline (per-batch vs fused superstep,
+    # winner parity asserted) — the VERDICT r5 streaming rate as a
+    # tracked number every run (docs/PERF.md r10).
+    run("streaming", lambda: bench_streaming(jax, jnp, small=fallback))
     # Roofline accounting over whatever components completed — bytes/s
     # and fraction-of-peak become tracked numbers (docs/PERF.md), so a
     # throughput regression is a falling fraction, not a prose claim.

@@ -90,6 +90,24 @@ def build_parser() -> argparse.ArgumentParser:
                           help="hashed vocabulary size (static V)")
     p_stream.add_argument("--epochs", type=int, default=1,
                           help="replay the file list N times (burn-in)")
+    p_stream.add_argument("--superstep", type=int, default=None,
+                          metavar="S",
+                          help="chain S minibatch updates (E-step + "
+                               "lambda step + scoring) in ONE jitted "
+                               "dispatch, winners fetched once per "
+                               "superstep (pipeline.stream_superstep; "
+                               "0/1 = per-batch)")
+    p_stream.add_argument("--prefetch-depth", type=int, default=None,
+                          metavar="K",
+                          help="host pipeline depth: decode+convert up "
+                               "to K batches ahead of the device step "
+                               "(pipeline.stream_prefetch_depth)")
+    p_stream.add_argument("--prefetch-mode", default=None,
+                          choices=("auto", "thread", "process"),
+                          help="where the host stage runs; auto "
+                               "measures conversion wall vs pickle "
+                               "round-trip on the first batch "
+                               "(pipeline.stream_prefetch_mode)")
     p_stream.add_argument("--fault-plan", default=None, metavar="PLAN",
                           help="chaos drill: declarative fault plan, e.g. "
                                "'stream:batch@3=raise' (docs/ROBUSTNESS.md)")
@@ -230,6 +248,13 @@ def main(argv: list[str] | None = None) -> int:
         if args.fault_plan is not None:
             from onix.utils import faults
             faults.install_plan(args.fault_plan)
+        if args.superstep is not None:
+            cfg.pipeline.stream_superstep = args.superstep
+        if args.prefetch_depth is not None:
+            cfg.pipeline.stream_prefetch_depth = args.prefetch_depth
+        if args.prefetch_mode is not None:
+            cfg.pipeline.stream_prefetch_mode = args.prefetch_mode
+        cfg.validate()          # re-check: flags bypass load_config's pass
         from onix.pipelines.streaming import run_stream
         return run_stream(cfg, args.datatype, args.paths,
                           n_buckets=args.buckets, epochs=args.epochs)

@@ -49,6 +49,16 @@ class LDAConfig:
     # this. Converged batches stop in a handful of iterations instead of
     # always paying the svi_local_iters cap; 0 disables (fixed count).
     svi_meanchange_tol: float = 1e-3
+    # Warm/cold E-step split (r10 streaming fast path): run this many
+    # fixed-trip iterations over the full padded block, then COMPACT
+    # the still-unconverged docs' tokens into a pow2 bucket and run the
+    # extended while_loop only there (lda_svi._run_e_step). -1 = auto:
+    # OFF for the batch SVI engine (bit-preserves the r6 loop), 4 for
+    # the streaming scorer whose warm-started returning docs converge
+    # inside the short pass. 0 forces the legacy loop everywhere; >0
+    # forces the split at that warm length. Part of the streaming
+    # checkpoint fingerprint — it changes what the E-step computes.
+    svi_warm_iters: int = -1
     svi_max_epochs: int = 30    # batch-mode epoch cap (streaming: n/a)
     svi_epoch_tol: float = 1e-3  # stop when relative ll gain drops below
     checkpoint_every: int = 0   # sweeps between sampler checkpoints (0=off)
@@ -103,6 +113,8 @@ class LDAConfig:
             raise ValueError("svi_epoch_tol must be >= 0")
         if self.svi_meanchange_tol < 0:
             raise ValueError("svi_meanchange_tol must be >= 0")
+        if self.svi_warm_iters < -1:
+            raise ValueError("svi_warm_iters must be >= -1 (-1 = auto)")
         if self.checkpoint_every < 0:
             raise ValueError("checkpoint_every must be >= 0")
         if self.n_chains < 1:
@@ -169,6 +181,26 @@ class PipelineConfig:
     dupfactor: int = 1000       # analyst-labeled rows duplicated x this in corpus
     stream_max_docs: int = 0    # streaming doc-state bound (0 = unbounded):
     #                             LRU-evict idle IPs past this population
+    # Streaming supersteps: chain this many minibatch updates (E-step +
+    # λ-step + incremental scoring) inside ONE jitted program per
+    # dispatch, winners fetched once per superstep (streaming.py
+    # process_many; the SVI analog of lda.superstep). 0/1 = the
+    # per-batch path. Eviction and checkpointing move to superstep
+    # boundaries (the doc bound gains up to S batches of slack).
+    stream_superstep: int = 0
+    # Host ingest pipeline ahead of the device step: how many batches
+    # the ColumnPrefetcher decodes + converts ahead (bounded, in-order
+    # handoff), and where that work runs — "thread" | "process" |
+    # "auto" (auto measures the first batch's conversion wall against
+    # its pickle round-trip cost and picks; process sidesteps the GIL
+    # the pandas/string conversion holds).
+    stream_prefetch_depth: int = 2
+    stream_prefetch_mode: str = "auto"
+    # Cap on the streaming pad-shape lattice: once this many distinct
+    # (pad_to, pad_docs) pairs have compiled, new batches re-pad into a
+    # covering existing shape (or grow one ceiling shape) instead of
+    # silently compiling another program (streaming.py _pick_pad).
+    stream_max_shapes: int = 8
     columnar: str = "auto"      # day-read mode for `onix score`: "on" always
     #                             reads the store part-by-part into numeric
     #                             columns (the 10^8+-row path), "off" keeps
@@ -186,6 +218,16 @@ class PipelineConfig:
             raise ValueError("dupfactor must be >=1")
         if self.stream_max_docs < 0:
             raise ValueError("stream_max_docs must be >=0")
+        if self.stream_superstep < 0:
+            raise ValueError("stream_superstep must be >= 0 (0 = off)")
+        if self.stream_prefetch_depth < 1:
+            raise ValueError("stream_prefetch_depth must be >= 1")
+        if self.stream_prefetch_mode not in ("auto", "thread", "process"):
+            raise ValueError(
+                "pipeline.stream_prefetch_mode must be auto|thread|process, "
+                f"got {self.stream_prefetch_mode!r}")
+        if self.stream_max_shapes < 1:
+            raise ValueError("stream_max_shapes must be >= 1")
 
 
 @dataclass

@@ -10,6 +10,7 @@ property from HDFS staging files.
 
 from __future__ import annotations
 
+import dataclasses
 import pathlib
 
 import pandas as pd
@@ -56,6 +57,24 @@ def decode(datatype: str, path: str | pathlib.Path,
         from onix.ingest.parsers import parse_bluecoat
         return parse_bluecoat(path, strict=strict, salvage=salvage)
     raise ValueError(f"unknown datatype {datatype!r}")
+
+
+@dataclasses.dataclass(frozen=True)
+class DecodeItem:
+    """Picklable decode work unit: calling it decodes one raw file
+    (same contract as `decode`). A module-level dataclass — not a
+    closure — so the streaming prefetch pipeline can ship it to a
+    process-pool worker and run the whole file decode off the
+    consumer (streaming.ColumnPrefetcher; thread pools accept it
+    identically)."""
+
+    datatype: str
+    path: str
+    apply_sampling: bool = False
+
+    def __call__(self) -> pd.DataFrame:
+        return decode(self.datatype, self.path,
+                      apply_sampling=self.apply_sampling)
 
 
 def _day_of(datatype: str, table: pd.DataFrame) -> pd.Series:

@@ -54,13 +54,14 @@ class MiniBatch(NamedTuple):
     n_docs: int          # Bd (padded) — static
 
 
-def make_minibatch(doc_ids: np.ndarray, word_ids: np.ndarray,
-                   pad_to: int | None = None,
-                   pad_docs: int | None = None,
-                   weights: np.ndarray | None = None) -> MiniBatch:
-    """Densify document ids; pad tokens to `pad_to` and docs to
-    `pad_docs`. `weights` (float32 [T]) sets per-row multiplicities for
-    the deduped-pair path; default 1.0 per row."""
+def minibatch_arrays(doc_ids: np.ndarray, word_ids: np.ndarray,
+                     pad_to: int | None = None,
+                     pad_docs: int | None = None,
+                     weights: np.ndarray | None = None):
+    """Host half of make_minibatch: densify + pad, returning plain
+    NumPy arrays (doc_ids, word_ids, mask, doc_map, n_docs). The
+    streaming superstep stacks S of these before ONE device transfer,
+    so the per-batch jnp conversion must be separable."""
     uniq, local = np.unique(np.asarray(doc_ids), return_inverse=True)
     t = len(local)
     pad_to = t if pad_to is None else pad_to
@@ -76,15 +77,26 @@ def make_minibatch(doc_ids: np.ndarray, word_ids: np.ndarray,
          else np.asarray(weights, np.float32))
     if w.shape[0] != t:
         raise ValueError("weights must match the token count")
-    return MiniBatch(
-        doc_ids=jnp.asarray(np.concatenate([local.astype(np.int32),
-                                            np.zeros(rem, np.int32)])),
-        word_ids=jnp.asarray(np.concatenate([np.asarray(word_ids, np.int32),
-                                             np.zeros(rem, np.int32)])),
-        mask=jnp.asarray(np.concatenate([w, np.zeros(rem, np.float32)])),
-        doc_map=jnp.asarray(doc_map),
-        n_docs=int(n_docs),
-    )
+    return (np.concatenate([local.astype(np.int32), np.zeros(rem, np.int32)]),
+            np.concatenate([np.asarray(word_ids, np.int32),
+                            np.zeros(rem, np.int32)]),
+            np.concatenate([w, np.zeros(rem, np.float32)]),
+            doc_map, int(n_docs))
+
+
+def make_minibatch(doc_ids: np.ndarray, word_ids: np.ndarray,
+                   pad_to: int | None = None,
+                   pad_docs: int | None = None,
+                   weights: np.ndarray | None = None) -> MiniBatch:
+    """Densify document ids; pad tokens to `pad_to` and docs to
+    `pad_docs`. `weights` (float32 [T]) sets per-row multiplicities for
+    the deduped-pair path; default 1.0 per row."""
+    d, w_ids, m, doc_map, n_docs = minibatch_arrays(
+        doc_ids, word_ids, pad_to=pad_to, pad_docs=pad_docs,
+        weights=weights)
+    return MiniBatch(doc_ids=jnp.asarray(d), word_ids=jnp.asarray(w_ids),
+                     mask=jnp.asarray(m), doc_map=jnp.asarray(doc_map),
+                     n_docs=n_docs)
 
 
 def init_state(n_vocab: int, n_topics: int, seed: int = 0) -> SVIState:
@@ -96,6 +108,137 @@ def init_state(n_vocab: int, n_topics: int, seed: int = 0) -> SVIState:
 def _e_log_dirichlet(x: jax.Array, axis: int) -> jax.Array:
     return jax.scipy.special.digamma(x) - jax.scipy.special.digamma(
         x.sum(axis=axis, keepdims=True))
+
+
+def _active_ladder(t: int) -> list[int]:
+    """Pow2 bucket sizes for the compacted active-token block, largest
+    (the full pad) first. Capped at 4 rungs so the lax.switch compiles
+    a bounded number of while-loop branches per shape class."""
+    sizes = [t]
+    while len(sizes) < 4 and sizes[-1] > 64 and sizes[-1] % 2 == 0:
+        sizes.append(sizes[-1] // 2)
+    return sizes
+
+
+def _run_e_step(gamma0, elog_beta_t, doc_ids, mask, *, alpha: float,
+                local_iters: int, meanchange_tol: float,
+                warm_iters: int) -> jax.Array:
+    """The local E-step over one minibatch's tokens.
+
+    Three regimes, chosen statically:
+
+    * ``meanchange_tol == 0`` — the original fixed-count fori_loop.
+    * ``warm_iters == 0`` — the r6 per-document while_loop: the FULL
+      padded [T,K] block iterates until the slowest doc converges
+      (kept bit-identical: existing streaming checkpoints and the
+      batch SVI engine ride this path unchanged).
+    * ``warm_iters > 0`` — the r10 warm/cold split. Warm-started
+      returning docs (the stream's common case) converge within a
+      short fixed-trip pass over the full block; the unconverged
+      remainder is then COMPACTED — its docs' tokens gathered to the
+      front and sliced into the smallest pow2 bucket that fits
+      (`_active_ladder`) — and only that block runs the extended
+      while_loop. Converged docs' gamma is frozen at its warm-pass
+      value (each active doc keeps ALL its tokens, so its update is
+      exact); the per-document Hoffman stopping rule is unchanged.
+      Extended iterations therefore cost O(T_active · K), not
+      O(T · K) — the r6 loop charged every token until the SLOWEST
+      doc converged.
+    """
+    def e_step(gamma, d_ids, eb_t, m):
+        elog_theta = _e_log_dirichlet(gamma, axis=1)     # [Bd,K]
+        logp = elog_theta[d_ids] + eb_t                  # [T,K]
+        phi = jax.nn.softmax(logp, axis=-1) * m[:, None]
+        return alpha + jnp.zeros_like(gamma).at[d_ids].add(phi)
+
+    if meanchange_tol <= 0.0:
+        return jax.lax.fori_loop(
+            0, local_iters,
+            lambda _, g: e_step(g, doc_ids, elog_beta_t, mask), gamma0)
+
+    if warm_iters <= 0:
+        def body(carry):
+            gamma, _, i = carry
+            g2 = e_step(gamma, doc_ids, elog_beta_t, mask)
+            # Per-DOCUMENT convergence, as in Hoffman's rule: iterate
+            # until EVERY doc's mean |Δgamma| is under tol. A
+            # batch-global mean would let a majority of converged
+            # (warm-started, recurring) docs dilute away exactly the
+            # still-moving first-seen docs the rarity detector needs
+            # converged. Padding rows collapse to alpha after one
+            # iteration and stop contributing.
+            return g2, jnp.abs(g2 - gamma).mean(axis=1).max(), i + 1
+
+        def cond(carry):
+            _, delta, i = carry
+            return (i < local_iters) & (delta > meanchange_tol)
+
+        gamma, _, _ = jax.lax.while_loop(
+            cond, body, (gamma0, jnp.float32(jnp.inf), jnp.int32(0)))
+        return gamma
+
+    t = doc_ids.shape[0]
+    warm = min(int(warm_iters), int(local_iters))
+    rem_iters = int(local_iters) - warm
+
+    def warm_body(_, carry):
+        g, _ = carry
+        g2 = e_step(g, doc_ids, elog_beta_t, mask)
+        return g2, jnp.abs(g2 - g).mean(axis=1)
+
+    gamma, delta_d = jax.lax.fori_loop(
+        0, warm, warm_body,
+        (gamma0, jnp.full((gamma0.shape[0],), jnp.inf, jnp.float32)))
+    if rem_iters <= 0:
+        return gamma
+
+    active_d = delta_d > meanchange_tol              # [Bd]
+    act_tok = active_d[doc_ids] & (mask > 0.0)       # [T]
+    n_act = act_tok.sum()
+    # Stable compaction: active docs' tokens to the front, order kept.
+    perm = jnp.argsort(~act_tok, stable=True)
+    c_doc = doc_ids[perm]
+    c_eb = elog_beta_t[perm]
+    c_mask = jnp.where(act_tok, mask, 0.0)[perm]
+
+    def make_branch(size):
+        d_ids = jax.lax.slice_in_dim(c_doc, 0, size)
+        eb_t = jax.lax.slice_in_dim(c_eb, 0, size)
+        m = jax.lax.slice_in_dim(c_mask, 0, size)
+
+        def body(carry):
+            g, _, i = carry
+            g2 = e_step(g, d_ids, eb_t, m)
+            # Converged docs stay frozen; active docs' updates are
+            # exact (every token of an active doc sits inside the
+            # compacted slice — activity is per-doc, and the slice is
+            # chosen to cover n_act).
+            g2 = jnp.where(active_d[:, None], g2, g)
+            delta = jnp.where(active_d,
+                              jnp.abs(g2 - g).mean(axis=1), 0.0).max()
+            return g2, delta, i + 1
+
+        def cond(carry):
+            _, delta, i = carry
+            return (i < rem_iters) & (delta > meanchange_tol)
+
+        def branch(g):
+            g2, _, _ = jax.lax.while_loop(
+                cond, body,
+                # n_act == 0 skips the extended phase outright (the
+                # init delta fails cond on entry).
+                (g, jnp.where(n_act > 0, jnp.float32(jnp.inf),
+                              jnp.float32(0.0)), jnp.int32(0)))
+            return g2
+        return branch
+
+    sizes = _active_ladder(t)
+    # Smallest rung that still holds every active token (compaction
+    # preserves order, so the first n_act compacted slots are exactly
+    # the active tokens).
+    idx = sum((n_act <= jnp.int32(s)).astype(jnp.int32)
+              for s in sizes[1:]) if len(sizes) > 1 else jnp.int32(0)
+    return jax.lax.switch(idx, [make_branch(s) for s in sizes], gamma)
 
 
 def svi_step(
@@ -113,52 +256,31 @@ def svi_step(
     local_iters: int,
     batch_docs: int,         # static Bd for gamma shape
     meanchange_tol: float = 0.0,
+    warm_iters: int = 0,
 ) -> tuple[SVIState, jax.Array]:
     """One SVI update. Returns (new_state, gamma [Bd,K]) for scoring.
 
     The local E-step iterates to convergence (mean |Δgamma| under
     `meanchange_tol` — Hoffman's onlineldavb stopping rule) with
-    `local_iters` as the hard cap; tol 0 keeps the fixed-count loop.
-    Token weights ride `batch.mask` (MiniBatch docstring), so deduped
-    (doc, word) pairs update gamma and lambda exactly as their
-    multiplicity of identical tokens would. `gamma0` warm-starts the
-    fixed point (a streaming driver passes each returning doc's LAST
-    gamma — recurring docs then converge in a few iterations instead
-    of re-walking from the prior); None keeps the cold start."""
+    `local_iters` as the hard cap; tol 0 keeps the fixed-count loop,
+    and `warm_iters > 0` engages the warm/cold compacted split
+    (`_run_e_step` docstring). Token weights ride `batch.mask`
+    (MiniBatch docstring), so deduped (doc, word) pairs update gamma
+    and lambda exactly as their multiplicity of identical tokens
+    would. `gamma0` warm-starts the fixed point (a streaming driver
+    passes each returning doc's LAST gamma — recurring docs then
+    converge in a few iterations instead of re-walking from the
+    prior); None keeps the cold start."""
     k = state.lam.shape[1]
     elog_beta = _e_log_dirichlet(state.lam, axis=0)      # [V,K]
     elog_beta_t = elog_beta[batch.word_ids]              # [T,K]
 
-    def e_step(gamma):
-        elog_theta = _e_log_dirichlet(gamma, axis=1)     # [Bd,K]
-        logp = elog_theta[batch.doc_ids] + elog_beta_t   # [T,K]
-        phi = jax.nn.softmax(logp, axis=-1) * batch.mask[:, None]
-        return alpha + jnp.zeros_like(gamma).at[batch.doc_ids].add(phi)
-
     if gamma0 is None:
         gamma0 = jnp.full((batch_docs, k), alpha + 1.0, jnp.float32)
-    if meanchange_tol > 0.0:
-        def body(carry):
-            gamma, _, i = carry
-            g2 = e_step(gamma)
-            # Per-DOCUMENT convergence, as in Hoffman's rule: iterate
-            # until EVERY doc's mean |Δgamma| is under tol. A
-            # batch-global mean would let a majority of converged
-            # (warm-started, recurring) docs dilute away exactly the
-            # still-moving first-seen docs the rarity detector needs
-            # converged. Padding rows collapse to alpha after one
-            # iteration and stop contributing.
-            return g2, jnp.abs(g2 - gamma).mean(axis=1).max(), i + 1
-
-        def cond(carry):
-            _, delta, i = carry
-            return (i < local_iters) & (delta > meanchange_tol)
-
-        gamma, _, _ = jax.lax.while_loop(
-            cond, body, (gamma0, jnp.float32(jnp.inf), jnp.int32(0)))
-    else:
-        gamma = jax.lax.fori_loop(0, local_iters,
-                                  lambda _, g: e_step(g), gamma0)
+    gamma = _run_e_step(gamma0, elog_beta_t, batch.doc_ids, batch.mask,
+                        alpha=alpha, local_iters=local_iters,
+                        meanchange_tol=meanchange_tol,
+                        warm_iters=warm_iters)
 
     # Final responsibilities under converged gamma.
     elog_theta = _e_log_dirichlet(gamma, axis=1)
@@ -180,6 +302,97 @@ def phi_estimate(state: SVIState) -> jax.Array:
     return state.lam / state.lam.sum(axis=0, keepdims=True)
 
 
+class SuperBatch(NamedTuple):
+    """S stacked minibatches sharing one static (T, Bd) shape — the
+    unit the streaming superstep consumes. `doc_map` carries indices
+    into the superstep's UNION gamma store (not global doc ids): the
+    host maps each batch's global doc ids onto the sorted union of all
+    docs the S batches touch, so warm starts chain batch-to-batch on
+    device without any host round-trip. -1 marks padding doc rows."""
+    doc_ids: jax.Array    # int32 [S, T] local-dense doc index per token
+    word_ids: jax.Array   # int32 [S, T]
+    mask: jax.Array       # float32 [S, T] token multiplicity; 0 padding
+    doc_map: jax.Array    # int32 [S, Bd] local doc -> union row (-1 pad)
+    n_docs: int           # Bd (padded) — static
+
+
+def svi_superstep(
+    state: SVIState,
+    sb: SuperBatch,
+    gamma_union: jax.Array,   # [U_pad, K] union warm-start/store rows;
+    #                           the LAST row is a never-written dummy
+    #                           that padding doc rows gather (alpha+1)
+    corpus_docs: jax.Array,   # float32 [S] running-D per batch
+    *,
+    alpha: float,
+    eta: float,
+    tau0: float,
+    kappa: float,
+    local_iters: int,
+    batch_docs: int,
+    meanchange_tol: float = 0.0,
+    warm_iters: int = 0,
+) -> tuple[SVIState, jax.Array, jax.Array]:
+    """Chain S minibatch updates (E-step + natural-gradient λ-step +
+    incremental scoring) inside ONE jitted program — the streaming
+    analog of the r7 Gibbs fit supersteps. Each scan step is the exact
+    `svi_step` update followed by the exact per-batch scoring math the
+    per-batch path runs (theta rows from the batch's updated gamma,
+    phi from the updated lambda, `score_events` over the padded token
+    columns), with the union gamma store carrying warm starts across
+    the S batches. Per dispatch the host fetches ONE scores block
+    [S, T] plus the updated union rows — where the per-batch loop paid
+    ~3 dispatch syncs per batch, the superstep pays ~1 per S batches
+    (the 70 ms-RTT tunnel regime this collapses is docs/PERF.md's).
+
+    Returns (new_state, updated gamma_union, scores [S, T])."""
+    from onix.models.scoring import score_events
+
+    k = state.lam.shape[1]
+    dummy = gamma_union.shape[0] - 1
+
+    def step(carry, xs):
+        lam, stp, store = carry
+        d_ids, w_ids, m, dmu, cdocs = xs
+        real = dmu >= 0
+        g0 = store[jnp.where(real, dmu, dummy)]
+        elog_beta = _e_log_dirichlet(lam, axis=0)
+        elog_beta_t = elog_beta[w_ids]
+        gamma = _run_e_step(g0, elog_beta_t, d_ids, m, alpha=alpha,
+                            local_iters=local_iters,
+                            meanchange_tol=meanchange_tol,
+                            warm_iters=warm_iters)
+        elog_theta = _e_log_dirichlet(gamma, axis=1)
+        phi = jax.nn.softmax(elog_theta[d_ids] + elog_beta_t, axis=-1)
+        phi = phi * m[:, None]
+        n_real = real.sum().astype(jnp.float32)
+        scale = cdocs / jnp.maximum(n_real, 1.0)
+        lam_hat = eta + scale * jnp.zeros_like(lam).at[w_ids].add(phi)
+        rho = (tau0 + stp.astype(jnp.float32)) ** (-kappa)
+        lam2 = (1.0 - rho) * lam + rho * lam_hat
+        # Padding doc rows scatter nowhere: mode="drop" only drops
+        # indices OUT OF BOUNDS (negative indices WRAP — -1 would
+        # overwrite the dummy row), so padding maps past the store's
+        # end. Real rows land so the NEXT batch's warm start sees
+        # them.
+        store2 = store.at[jnp.where(real, dmu, store.shape[0])].set(
+            gamma, mode="drop")
+        # Incremental scoring under the updated model — the same
+        # theta/phi construction as the per-batch path (padding doc
+        # rows at the uniform prior).
+        theta = jnp.where(real[:, None],
+                          gamma / gamma.sum(axis=1, keepdims=True),
+                          1.0 / k)
+        phi_wk = lam2 / lam2.sum(axis=0, keepdims=True)
+        scores = score_events(theta, phi_wk, d_ids, w_ids)
+        return (lam2, stp + 1, store2), scores
+
+    (lam, stp, store), scores = jax.lax.scan(
+        step, (state.lam, state.step, gamma_union),
+        (sb.doc_ids, sb.word_ids, sb.mask, sb.doc_map, corpus_docs))
+    return SVIState(lam=lam, step=stp), store, scores
+
+
 class SVILda:
     """Driver for streaming fits over ingest minibatches."""
 
@@ -188,12 +401,22 @@ class SVILda:
         self.config = config
         self.n_vocab = n_vocab
         self.corpus_docs = corpus_docs
+        warm = max(config.svi_warm_iters, 0)
         self._step = jax.jit(functools.partial(
             svi_step,
             alpha=config.alpha, eta=config.eta,
             tau0=config.svi_tau0, kappa=config.svi_kappa,
             local_iters=config.svi_local_iters,
             meanchange_tol=config.svi_meanchange_tol,
+            warm_iters=warm,
+        ), static_argnames=("batch_docs",))
+        self._superstep = jax.jit(functools.partial(
+            svi_superstep,
+            alpha=config.alpha, eta=config.eta,
+            tau0=config.svi_tau0, kappa=config.svi_kappa,
+            local_iters=config.svi_local_iters,
+            meanchange_tol=config.svi_meanchange_tol,
+            warm_iters=warm,
         ), static_argnames=("batch_docs",))
 
     def init(self) -> SVIState:
@@ -207,3 +430,13 @@ class SVILda:
         E-step (svi_step docstring)."""
         d = float(self.corpus_docs if corpus_docs is None else corpus_docs)
         return self._step(state, batch, d, gamma0, batch_docs=batch.n_docs)
+
+    def update_superstep(self, state: SVIState, sb: SuperBatch,
+                         gamma_union, corpus_docs):
+        """S chained SVI updates + incremental scoring in one dispatch
+        (svi_superstep docstring). `gamma_union` is the [U_pad, K]
+        union warm-start store (last row a dummy for padding docs);
+        `corpus_docs` the per-batch running-D vector [S]."""
+        return self._superstep(state, sb, jnp.asarray(gamma_union),
+                               jnp.asarray(corpus_docs, jnp.float32),
+                               batch_docs=sb.n_docs)

@@ -51,6 +51,31 @@ Streaming-specific design (vs the batch path in pipelines/run.py):
   pairs run 4-5x below the token count). Scoring broadcasts the
   unique-pair scores back through the inverse index, so per-event
   scores and alerts are unchanged in meaning.
+- **Warm/cold compacted E-step (r10).** The local E-step runs a short
+  fixed-trip warm pass over the full padded block (returning docs —
+  the stream's common case — converge inside it thanks to the gamma
+  warm start), then COMPACTS the unconverged remainder's tokens into
+  the smallest pow2 bucket that fits and runs the extended
+  per-document while_loop only there (lda_svi._run_e_step): extended
+  iterations stop charging every token for the slowest doc.
+- **Minibatch supersteps (r10).** `process_many` with
+  pipeline.stream_superstep = S chains S batches' E-step +
+  natural-gradient λ-step + incremental scoring inside ONE jitted
+  program (lda_svi.svi_superstep), warm starts flowing batch-to-batch
+  through a device-resident union gamma store and the scores block
+  fetched once per superstep — ~1 dispatch sync per S batches where
+  the per-batch path pays ~2 per batch (plus words), the exact
+  dispatch-amortization move of the r7 Gibbs fit supersteps.
+- **Depth-k host pipeline (r10).** ColumnPrefetcher keeps up to k
+  future batches' file decode + frame→columns conversion in flight on
+  worker threads or a process pool (measured auto-pick; bounded,
+  in-order, backpressured), so the ~30% host slice of the batch wall
+  (docs/PERF.md r6) rides under the device step.
+- **Capped shape lattice (r10).** `_pick_pad` bounds the compiled
+  (pad_to, pad_docs) set: past `pipeline.stream_max_shapes`,
+  adversarial batch-size streams re-pad into covering shapes instead
+  of silently recompiling per batch; compiles and re-pads are counted
+  (shape_stats + stream.shape_* obs counters).
 - **Escape hatch.** ONIX_HOST_WORDS=1 pins the host reference path
   (word builders + host hash + undeduped E-step) — the cross-check arm
   measurements compare against. The host path also catches everything
@@ -61,8 +86,10 @@ Streaming-specific design (vs the batch path in pipelines/run.py):
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import hashlib
+import os
 import pathlib
 import time
 
@@ -208,6 +235,27 @@ class U32DocTable:
 
 
 @dataclasses.dataclass
+class _Prep:
+    """Host-prepared minibatch (output of StreamingScorer._prep_batch):
+    everything the device step and the emit tail need, shared by the
+    per-batch and superstep paths."""
+
+    table: pd.DataFrame
+    n_events: int
+    event_idx: np.ndarray
+    dev_flow: bool              # device flow [src|dst] token layout
+    did_b: np.ndarray           # batch doc ids (deduped rows)
+    wid_b: np.ndarray
+    weights: np.ndarray | None
+    inv: np.ndarray | None      # pair -> token inverse (None = undeduped)
+    t: int                      # raw token count
+    t_rows: int                 # deduped row count fed to the model
+    n_batch_docs: int
+    docs_before: int
+    n_docs_after: int
+
+
+@dataclasses.dataclass
 class BatchResult:
     """Incremental scoring output for one minibatch."""
 
@@ -243,8 +291,21 @@ class StreamingScorer:
         self.docs: U32DocTable | DocTable = U32DocTable()
         self.word_fn = WORD_FNS[datatype]
         self.edges: dict | None = None
-        self.model = SVILda(cfg.lda, n_buckets, corpus_docs=1)
+        # Effective model config: svi_warm_iters=-1 resolves to the
+        # streaming auto default (4 warm trips, then the compacted
+        # active-set extension — lda_svi._run_e_step). The EFFECTIVE
+        # value feeds the SVILda jits and the checkpoint fingerprint.
+        lda = cfg.lda
+        if lda.svi_warm_iters < 0:
+            lda = dataclasses.replace(lda, svi_warm_iters=4)
+        self._lda_eff = lda
+        self.model = SVILda(lda, n_buckets, corpus_docs=1)
         self.state: SVIState = self.model.init()
+        # Superstep size (pipeline.stream_superstep): S minibatch
+        # updates chained in one dispatch via process_many; <=1 keeps
+        # the per-batch path.
+        self.superstep = max(1, int(cfg.pipeline.stream_superstep))
+        self.max_shapes = max(1, int(cfg.pipeline.stream_max_shapes))
         k = cfg.lda.n_topics
         self._gamma = np.full((_next_pow2(1), k), cfg.lda.alpha, np.float32)
         # Eviction bound on per-doc state: a long-lived stream sees an
@@ -257,6 +318,9 @@ class StreamingScorer:
         self.max_docs = max_docs
         self._last_seen = np.zeros(self._gamma.shape[0], np.int64)
         self.pad_shapes: set[tuple[int, int]] = set()   # compile accounting
+        # Superstep program shapes (S, pad_to, pad_docs) — its own
+        # lattice dimension next to pad_shapes.
+        self.superstep_shapes: set[tuple[int, int, int]] = set()
         # Cumulative per-stage walls (seconds) — the r03 streaming rate
         # was 300x under the batch scan with the host path unprofiled
         # (VERDICT r03 weak #6); every artifact now carries the split.
@@ -270,6 +334,26 @@ class StreamingScorer:
         # Which word path each batch rode (device fused vs host
         # reference) — artifacts report it next to the stage walls.
         self.words_mode_batches = {"device": 0, "host": 0}
+        # Device dispatch syncs per program family — the number the
+        # superstep collapses (one svi_update+score dispatch per S
+        # batches instead of two per batch), tracked so artifacts and
+        # bench.py report it instead of inferring it.
+        self.dispatches = {"words": 0, "svi_update": 0, "score": 0,
+                           "superstep": 0}
+        # Shape-lattice accounting (_pick_pad): every NEW (pad_to,
+        # pad_docs) pair is a recompile of the svi/score programs;
+        # "repadded" counts batches folded into a covering shape once
+        # the lattice cap is reached.
+        self.shape_stats = {"compiled": 0, "repadded": 0}
+        # Deduped rows actually fed to the model (the roofline's item
+        # count) and raw events, accumulated per batch.
+        self.pair_rows = 0
+        self.events_seen = 0
+        # Prefetch pipeline accounting, filled by ColumnPrefetcher:
+        # depth/mode, queue occupancy at each handoff, worker busy
+        # seconds, and the thread-vs-process calibration that picked
+        # the mode.
+        self.prefetch_stats: dict = {}
         self._batch_no = 0
         self.checkpoint_dir = (pathlib.Path(checkpoint_dir)
                                if checkpoint_dir else None)
@@ -290,21 +374,24 @@ class StreamingScorer:
         # checkpoint.fingerprint's sampling fields are Gibbs-oriented;
         # the SVI schedule knobs change what this engine computes, so a
         # checkpoint under a different schedule must not be adopted.
-        lda = self.cfg.lda
-        # layout=3: word buckets hash the packed word_key (splitmix64),
-        # not the rendered string (blake2b) — a lambda trained under the
-        # old scheme addresses different buckets and must not be adopted.
+        lda = self._lda_eff
+        # layout=4: the E-step gained the warm/cold compacted split
+        # (svi_warm_iters joins the schedule identity — a lambda
+        # trained under a different local-iteration rule is a
+        # different model and must not be adopted). layout=3 hashed
+        # the packed word_key (splitmix64), not the rendered string.
         return ckpt.fingerprint(
             lda, 0, self.n_buckets, 0,
             extra={"stream_datatype": self.datatype,
                    "n_buckets": self.n_buckets,
                    # meanchange joined when the E-step gained the
-                   # convergence stop: a lambda trained under a
-                   # different local-iteration rule is a different
-                   # model and must not be adopted.
+                   # convergence stop; warm_iters (EFFECTIVE value,
+                   # after the -1 auto resolve) when it gained the
+                   # warm/cold split.
                    "svi": [lda.svi_tau0, lda.svi_kappa,
-                           lda.svi_local_iters, lda.svi_meanchange_tol],
-                   "layout": 3})
+                           lda.svi_local_iters, lda.svi_meanchange_tol,
+                           lda.svi_warm_iters],
+                   "layout": 4})
 
     def save_checkpoint(self) -> None:
         from onix import checkpoint as ckpt
@@ -406,6 +493,40 @@ class StreamingScorer:
         self._gamma, self._last_seen = gamma, seen
         return n - n_new
 
+    def _pick_pad(self, t_rows: int, n_docs: int) -> tuple[int, int]:
+        """Pad shape for one minibatch, with a CAPPED shape lattice.
+
+        The naive pow2 pair (pad_to, pad_docs) grows the compiled-
+        program set unboundedly on adversarial streams — every new
+        pair is a silent recompile (5-30 s each through the TPU
+        tunnel). Min-bucket floors (256 tokens / 64 docs) absorb small
+        batches; once `max_shapes` distinct pairs have compiled, a new
+        batch re-pads into the smallest EXISTING covering shape, and
+        if nothing covers it the lattice grows one ceiling shape that
+        covers everything seen so far (so post-cap growth is O(log
+        max_batch), not O(batches)). Every new pair increments
+        shape_stats["compiled"] + the stream.shape_compiles counter;
+        re-pads count too, so run summaries show both."""
+        need = (_next_pow2(t_rows), _next_pow2(n_docs, floor=64))
+        if need in self.pad_shapes:
+            return need
+        if len(self.pad_shapes) >= self.max_shapes:
+            covering = [s for s in self.pad_shapes
+                        if s[0] >= need[0] and s[1] >= need[1]]
+            if covering:
+                self.shape_stats["repadded"] += 1
+                counters.inc("stream.shape_repads")
+                return min(covering)
+            # Nothing covers this batch: escalate to one ceiling shape
+            # (covers every existing shape too, so the lattice can only
+            # grow again if a batch exceeds THIS).
+            need = (max(need[0], max(s[0] for s in self.pad_shapes)),
+                    max(need[1], max(s[1] for s in self.pad_shapes)))
+        self.pad_shapes.add(need)
+        self.shape_stats["compiled"] += 1
+        counters.inc("stream.shape_compiles")
+        return need
+
     # -- the streaming step -----------------------------------------------
 
     def convert_columns(self, table: pd.DataFrame) -> dict | None:
@@ -414,17 +535,12 @@ class StreamingScorer:
 
         Pure host work on an immutable frame with NO scorer state read
         or written (the columnar converters don't need the bin edges),
-        so it is safe to run on a prefetch thread while the previous
-        batch's device step occupies the main thread — ColumnPrefetcher
-        does exactly that and `process(table, cols=...)` consumes the
-        result without re-converting."""
-        from onix.pipelines import columnar
-
-        conv = columnar.FRAME_COLS[self.datatype]
-        try:
-            return conv(table)
-        except (ValueError, KeyError):
-            return None
+        so it is safe to run on a prefetch thread (or a process-pool
+        worker — `_convert_frame` is module-level for exactly that)
+        while the previous batch's device step occupies the main
+        thread; `process(table, cols=...)` consumes the result without
+        re-converting."""
+        return _convert_frame(self.datatype, table)
 
     def _words(self, table: pd.DataFrame, cols: dict | None = None):
         """One minibatch → WordTable, columnar-first.
@@ -520,27 +636,11 @@ class StreamingScorer:
                 and self.n_buckets & (self.n_buckets - 1) == 0
                 and not host_words_forced())
 
-    def process(self, table: pd.DataFrame,
-                cols: dict | None = None) -> BatchResult:
-        """Word-create, model-update, and score one minibatch.
-
-        `cols` takes a pre-converted column dict from convert_columns
-        (the ColumnPrefetcher hands it over) so the ~30%-of-batch-wall
-        frame→columns host conversion (docs/PERF.md r6) that already ran
-        under the previous batch's device step is not paid again.
-
-        Chaos hook: a `stream:batch` rule in the active fault plan
-        fires HERE, before any scorer state (model, doc table, gamma,
-        batch counter) is touched — so a caller that retries the batch
-        (run_stream does, bounded) replays it against unchanged state
-        and the stream's artifacts are identical to a fault-free run."""
-        from onix.utils import faults
-
-        faults.fire("stream", "batch")
-        n_events = len(table)
-        if n_events == 0:
-            return BatchResult(np.empty(0), table.iloc[0:0].copy(), 0, 0,
-                               int(self.state.step))
+    def _prep_batch(self, table: pd.DataFrame, cols: dict | None):
+        """Host half of one minibatch — word-create, doc ids, deduped
+        pair build — shared by process() and process_many() so the
+        per-batch and superstep arms cannot drift. Mutates scorer
+        state in stream order (edge freeze, doc-table growth)."""
         t_stage = time.perf_counter
         t0 = t_stage()
         dev = (self._device_words(table, cols)
@@ -549,6 +649,8 @@ class StreamingScorer:
             words = self._words(table, cols)
             if self.edges is None:
                 self.edges = words.edges   # frozen from the first batch on
+        else:
+            self.dispatches["words"] += 1
         self.words_mode_batches["host" if dev is None else "device"] += 1
         self.stage_walls["words"] += t_stage() - t0
 
@@ -597,11 +699,82 @@ class StreamingScorer:
         else:
             did_b, wid_b, weights, t_rows = did, wid, None, t
         n_batch_docs = len(np.unique(did_b))
-        pad_to = _next_pow2(t_rows)
-        pad_docs = _next_pow2(n_batch_docs, floor=64)
-        self.pad_shapes.add((pad_to, pad_docs))
-        batch = make_minibatch(did_b, wid_b, pad_to=pad_to,
-                               pad_docs=pad_docs, weights=weights)
+        self.pair_rows += t_rows
+        self.events_seen += len(table)
+        self.stage_walls["minibatch"] += t_stage() - t0
+        return _Prep(table=table, n_events=len(table),
+                     event_idx=event_idx,
+                     dev_flow=dev is not None and self.datatype == "flow",
+                     did_b=did_b, wid_b=wid_b, weights=weights, inv=inv,
+                     t=t, t_rows=t_rows, n_batch_docs=n_batch_docs,
+                     docs_before=docs_before,
+                     n_docs_after=self.docs.n_docs)
+
+    def _emit(self, p: "_Prep", tok_scores: np.ndarray,
+              evict: bool = True) -> BatchResult:
+        """Per-event reduce + alert rows + batch bookkeeping for one
+        prepared minibatch (shared tail of both paths)."""
+        t0 = time.perf_counter()
+        n_events = p.n_events
+        if p.dev_flow:
+            # Device flow layout is [src|dst] tokens of the same events
+            # in order: the event min is one elementwise minimum, not an
+            # unbuffered scatter.
+            ev_scores = np.minimum(tok_scores[:n_events],
+                                   tok_scores[n_events:]).astype(np.float64)
+        else:
+            ev_scores = np.full(n_events, np.inf, np.float64)
+            np.minimum.at(ev_scores, p.event_idx, tok_scores)
+
+        tol = self.cfg.pipeline.tol
+        hit = np.flatnonzero(ev_scores < tol)
+        hit = hit[np.argsort(ev_scores[hit], kind="stable")]
+        hit = hit[: self.cfg.pipeline.max_results]
+        alerts = p.table.iloc[hit].copy()
+        alerts.insert(0, "score", ev_scores[hit])
+        alerts.insert(1, "event_idx", hit)
+
+        self._batch_no += 1
+        if evict:
+            self._maybe_evict()
+        n_after = self.docs.n_docs if evict else p.n_docs_after
+        self.stage_walls["emit"] += time.perf_counter() - t0
+        return BatchResult(scores=ev_scores, alerts=alerts,
+                           n_events=n_events,
+                           n_new_docs=n_after - p.docs_before,
+                           step=int(self.state.step))
+
+    def process(self, table: pd.DataFrame,
+                cols: dict | None = None) -> BatchResult:
+        """Word-create, model-update, and score one minibatch.
+
+        `cols` takes a pre-converted column dict from convert_columns
+        (the ColumnPrefetcher hands it over) so the ~30%-of-batch-wall
+        frame→columns host conversion (docs/PERF.md r6) that already ran
+        under the previous batch's device step is not paid again.
+
+        Chaos hook: a `stream:batch` rule in the active fault plan
+        fires HERE, before any scorer state (model, doc table, gamma,
+        batch counter) is touched — so a caller that retries the batch
+        (run_stream does, bounded) replays it against unchanged state
+        and the stream's artifacts are identical to a fault-free run."""
+        from onix.utils import faults
+
+        faults.fire("stream", "batch")
+        return self._process_one(table, cols)
+
+    def _process_one(self, table: pd.DataFrame,
+                     cols: dict | None) -> BatchResult:
+        n_events = len(table)
+        if n_events == 0:
+            return BatchResult(np.empty(0), table.iloc[0:0].copy(), 0, 0,
+                               int(self.state.step))
+        p = self._prep_batch(table, cols)
+        t_stage = time.perf_counter
+        t0 = t_stage()
+        pad_to, pad_docs = self._pick_pad(p.t_rows, p.n_batch_docs)
+        batch = make_minibatch(p.did_b, p.wid_b, pad_to=pad_to,
+                               pad_docs=pad_docs, weights=p.weights)
         dm = np.asarray(batch.doc_map)
         real = dm >= 0
         # Warm-start the E-step from each returning doc's LAST gamma —
@@ -612,7 +785,7 @@ class StreamingScorer:
         g0 = np.full((batch.n_docs, k), self.cfg.lda.alpha + 1.0,
                      np.float32)
         prev = real.copy()
-        prev[real] = dm[real] < docs_before
+        prev[real] = dm[real] < p.docs_before
         g0[prev] = self._gamma[dm[prev]]
         self.stage_walls["minibatch"] += t_stage() - t0
 
@@ -623,6 +796,7 @@ class StreamingScorer:
             self.state, batch, corpus_docs=max(self.docs.n_docs, 2),
             gamma0=g0)
         gm = np.asarray(gamma)
+        self.dispatches["svi_update"] += 1
         self.stage_walls["svi_update"] += t_stage() - t0
         self._gamma[dm[real]] = gm[real]
         self._last_seen[dm[real]] = self._batch_no + 1
@@ -641,11 +815,10 @@ class StreamingScorer:
         # them; no second unique pass over the tokens.
         t0 = t_stage()
         uniq_d = dm[real]
-        k = self._gamma.shape[1]
         theta_b = np.full((pad_docs, k), 1.0 / k, np.float32)
         rows = self._gamma[uniq_d]
         theta_b[:len(uniq_d)] = rows / rows.sum(1, keepdims=True)
-        if inv is not None:
+        if p.inv is not None:
             # One fused gather-dot program over the unique pairs, then
             # broadcast through the inverse — identical event scores at
             # a fraction of the gathered rows. phi stays device-side.
@@ -654,101 +827,386 @@ class StreamingScorer:
             from onix.models.scoring import _score_events_jit
             pair_scores = np.asarray(_score_events_jit(
                 jnp.asarray(theta_b), phi_estimate(self.state),
-                batch.doc_ids, batch.word_ids))[:t_rows]
-            tok_scores = pair_scores[inv]
+                batch.doc_ids, batch.word_ids))[:p.t_rows]
+            tok_scores = pair_scores[p.inv]
         else:
             phi = np.asarray(phi_estimate(self.state))
             tok_scores = score_all(theta_b, phi, np.asarray(batch.doc_ids),
                                    np.asarray(batch.word_ids),
-                                   chunk=pad_to)[:t]
+                                   chunk=pad_to)[:p.t]
+        self.dispatches["score"] += 1
         self.stage_walls["score"] += t_stage() - t0
 
-        t0 = t_stage()
-        if dev is not None and self.datatype == "flow":
-            # Device flow layout is [src|dst] tokens of the same events
-            # in order: the event min is one elementwise minimum, not an
-            # unbuffered scatter.
-            ev_scores = np.minimum(tok_scores[:n_events],
-                                   tok_scores[n_events:]).astype(np.float64)
-        else:
-            ev_scores = np.full(n_events, np.inf, np.float64)
-            np.minimum.at(ev_scores, event_idx, tok_scores)
-
-        tol = self.cfg.pipeline.tol
-        hit = np.flatnonzero(ev_scores < tol)
-        hit = hit[np.argsort(ev_scores[hit], kind="stable")]
-        hit = hit[: self.cfg.pipeline.max_results]
-        alerts = table.iloc[hit].copy()
-        alerts.insert(0, "score", ev_scores[hit])
-        alerts.insert(1, "event_idx", hit)
-
-        self._batch_no += 1
-        self._maybe_evict()
-        self.stage_walls["emit"] += t_stage() - t0
+        res = self._emit(p, tok_scores, evict=True)
         every = self.cfg.lda.checkpoint_every
         if (self.checkpoint_dir is not None and every > 0
                 and self._batch_no % every == 0):
             self.save_checkpoint()
+        return res
 
-        return BatchResult(scores=ev_scores, alerts=alerts,
-                           n_events=n_events,
-                           n_new_docs=self.docs.n_docs - docs_before,
-                           step=int(self.state.step))
+    def process_many(self, batches: list,
+                     superstep: int | None = None) -> list[BatchResult]:
+        """Process a list of (table, cols) minibatches in stream order.
+
+        With superstep S > 1 (pipeline.stream_superstep, or the
+        explicit override), every group of S batches is ONE fused
+        device dispatch: E-step + natural-gradient λ-step +
+        incremental scoring for all S batches chained inside one
+        jitted program (lda_svi.svi_superstep), warm starts flowing
+        batch-to-batch through a device-resident union gamma store,
+        and the scores block fetched ONCE per group. S <= 1 degrades
+        to per-batch process() calls.
+
+        Semantics vs the per-batch path: identical E-step/λ-step/
+        scoring math per batch (winner-set parity asserted in tests);
+        eviction and checkpointing land on superstep boundaries, so
+        with max_docs set the doc bound gains up to S batches of
+        slack before the LRU sweep."""
+        s = self.superstep if superstep is None else max(1, superstep)
+        if s <= 1:
+            return [self.process(t, cols=c) for t, c in batches]
+        out: list[BatchResult] = []
+        for i in range(0, len(batches), s):
+            out.extend(self._process_superstep(batches[i:i + s]))
+        return out
+
+    def _process_superstep(self, group: list) -> list[BatchResult]:
+        from onix.utils import faults
+
+        # All fault hooks fire BEFORE any scorer state mutates, so a
+        # caller retrying the group (run_stream does) replays it
+        # against unchanged state — same contract as process().
+        for _ in group:
+            faults.fire("stream", "batch")
+        results: list[BatchResult | None] = [None] * len(group)
+        live = []
+        for gi, (table, _) in enumerate(group):
+            if len(table) == 0:
+                results[gi] = BatchResult(np.empty(0),
+                                          table.iloc[0:0].copy(), 0, 0,
+                                          int(self.state.step))
+            else:
+                live.append(gi)
+        if not live:
+            return results
+        if len(live) == 1:
+            gi = live[0]
+            results[gi] = self._process_one(*group[gi])
+            return results
+
+        import jax.numpy as jnp
+
+        from onix.models.lda_svi import SuperBatch, minibatch_arrays
+
+        preps = [self._prep_batch(*group[gi]) for gi in live]
+        t_stage = time.perf_counter
+        t0 = t_stage()
+        # One shared static shape for the whole group (the stream's
+        # equal-size batches land on one (pad_to, pad_docs) anyway).
+        pad_to, pad_docs = self._pick_pad(
+            max(p.t_rows for p in preps),
+            max(p.n_batch_docs for p in preps))
+        self.superstep_shapes.add((len(preps), pad_to, pad_docs))
+        k = self._gamma.shape[1]
+        arrs = [minibatch_arrays(p.did_b, p.wid_b, pad_to=pad_to,
+                                 pad_docs=pad_docs, weights=p.weights)
+                for p in preps]
+        doc_maps = [a[3] for a in arrs]
+        # Union of every global doc the group touches → the device
+        # warm-start store. Docs that existed before the superstep
+        # start from their live gamma; docs first seen inside the
+        # group start cold (alpha+1) exactly as the per-batch g0
+        # does — their creating batch is their first toucher, and
+        # later batches in the group warm-start from the store row
+        # that batch wrote on device.
+        union = np.unique(np.concatenate([dm[dm >= 0]
+                                          for dm in doc_maps]))
+        u = len(union)
+        u_pad = _next_pow2(u + 1, floor=64)   # +1: last row = pad dummy
+        gamma_union = np.full((u_pad, k), self.cfg.lda.alpha + 1.0,
+                              np.float32)
+        pre = union < preps[0].docs_before
+        gamma_union[:u][pre] = self._gamma[union[pre]]
+        dmu = np.full((len(live), pad_docs), -1, np.int32)
+        for i, dm in enumerate(doc_maps):
+            r = dm >= 0
+            dmu[i][r] = np.searchsorted(union, dm[r]).astype(np.int32)
+        sb = SuperBatch(
+            doc_ids=jnp.asarray(np.stack([a[0] for a in arrs])),
+            word_ids=jnp.asarray(np.stack([a[1] for a in arrs])),
+            mask=jnp.asarray(np.stack([a[2] for a in arrs])),
+            doc_map=jnp.asarray(dmu),
+            n_docs=pad_docs)
+        corpus = np.maximum(
+            np.asarray([p.n_docs_after for p in preps], np.float32), 2.0)
+        self.stage_walls["minibatch"] += t_stage() - t0
+
+        t0 = t_stage()
+        self.state, store, scores = self.model.update_superstep(
+            self.state, sb, gamma_union, corpus)
+        scores_h = np.asarray(scores)     # THE one fetch per superstep
+        store_h = np.asarray(store)
+        self.dispatches["superstep"] += 1
+        self.stage_walls["svi_update"] += t_stage() - t0
+        self._gamma[union] = store_h[:u]
+
+        bno_before = self._batch_no
+        for i, gi in enumerate(live):
+            p = preps[i]
+            dm = doc_maps[i]
+            r = dm >= 0
+            self._last_seen[dm[r]] = self._batch_no + 1
+            tok = scores_h[i][:p.t_rows]
+            if p.inv is not None:
+                tok = tok[p.inv]
+            results[gi] = self._emit(p, tok, evict=False)
+        self._maybe_evict()
+        every = self.cfg.lda.checkpoint_every
+        if (self.checkpoint_dir is not None and every > 0
+                and self._batch_no // every != bno_before // every):
+            self.save_checkpoint()
+        return results
+
+
+def _convert_frame(datatype: str, table: pd.DataFrame) -> dict | None:
+    """frame → numeric columns, or None for frames the converter
+    rejects (those ride the string word path). Module-level so a
+    process-pool prefetch worker can run it without pickling a
+    scorer."""
+    from onix.pipelines import columnar
+
+    conv = columnar.FRAME_COLS[datatype]
+    try:
+        return conv(table)
+    except (ValueError, KeyError):
+        return None
+
+
+def _produce_item(datatype: str, item):
+    """Worker-side unit of the prefetch pipeline: materialize the
+    frame (callable items run their decode HERE) and convert it.
+    Returns (table, cols, produce_wall_s, counter_deltas) — the
+    counter deltas exist because a process-pool worker's obs counters
+    are process-local and its salvage/skip tallies would otherwise
+    vanish; the consumer merges them (process mode only — thread
+    workers already increment the shared registry)."""
+    before = counters.snapshot()
+    t0 = time.perf_counter()
+    table = item() if callable(item) else item
+    cols = _convert_frame(datatype, table)
+    wall = time.perf_counter() - t0
+    delta = {k: v - before.get(k, 0) for k, v in counters.snapshot().items()
+             if v != before.get(k, 0)}
+    return table, cols, wall, delta
 
 
 class ColumnPrefetcher:
-    """One-deep prefetch of the frame→columns host conversion.
+    """Depth-k bounded prefetch pipeline for the streaming host stage.
 
     The steady-state streaming batch spends ~30% of its wall in the
-    frame→columns conversion (docs/PERF.md r6) — pure host string/array
-    work that needs no scorer state — while the SVI/scoring step holds
-    the device. This iterator runs the NEXT batch's conversion (and,
-    when the source items are callables, its decode too) on a single
-    worker thread while the caller processes the current one, mirroring
-    the double-buffered `device_put` chunk staging in scale.py's
-    _stream_score. One-deep by design: peak memory stays at two frames.
+    frame→columns conversion (docs/PERF.md r6) — pure host
+    string/array work that needs no scorer state — and, through
+    run_stream, the file decode ahead of it. This iterator runs up to
+    `depth` future batches' decode+conversion on worker threads OR
+    process-pool workers while the caller processes the current one:
 
-    `items` yields either DataFrames or zero-arg callables returning
-    DataFrames (the callable form moves file decode into the worker).
-    Yields (table, cols) pairs for `scorer.process(table, cols=cols)`;
-    cols is None for frames the converter rejects (the host word path
-    picks those up exactly as before). Overlap accounting lands in
-    scorer.stage_walls: "prefetch_overlap" is conversion wall hidden
-    under the previous batch, "prefetch_wait" the residual blocked on.
-    """
+    * **bounded + in-order**: at most `depth` items are in flight
+      (backpressure — a slow device stage never piles frames up), and
+      handoff is strictly submission-ordered, so scorer state mutates
+      in stream order exactly as serial process() calls would.
+    * **thread-vs-process auto-pick** (mode="auto", the default): the
+      FIRST item is produced inline and timed, its pickle round-trip
+      cost measured, and the pipeline picks the process pool only when
+      the measured produce wall clears 2× the IPC cost on a multi-core
+      host (the pandas/string conversion holds the GIL — threads only
+      overlap it where NumPy releases; a worker process sidesteps the
+      GIL at the price of shipping the frame). The calibration lands
+      in scorer.prefetch_stats. An active fault plan pins the thread
+      arm (rule state is process-local; a drill's injected decode
+      faults must be marked consumed in the parent).
+    * **failure transparency**: a worker exception re-raises at the
+      consumer's next handoff (never a hang), and early exit from the
+      consuming loop cancels pending work and shuts the pool down.
 
-    def __init__(self, scorer: StreamingScorer, items):
+    `items` yields DataFrames or zero-arg callables returning
+    DataFrames (run_stream passes picklable `DecodeItem`s so decode
+    rides the worker in either mode). Yields (table, cols) pairs for
+    `scorer.process(table, cols=cols)`; cols is None for frames the
+    converter rejects. Accounting: stage_walls["prefetch_wait"] is the
+    seconds the CONSUMER actually blocked (the only prefetch time that
+    extends the pipeline wall — the stage-sum identity tests rely on
+    this); "prefetch_overlap" is worker produce wall that ran hidden
+    under the device step (informational — with depth > 1 workers also
+    overlap each other); queue occupancy and worker busy seconds land
+    in scorer.prefetch_stats."""
+
+    def __init__(self, scorer: StreamingScorer, items,
+                 depth: int | None = None, mode: str | None = None):
+        cfg = scorer.cfg.pipeline
         self.scorer = scorer
         self.items = items
+        env_depth = os.environ.get("ONIX_PREFETCH_DEPTH")
+        self.depth = max(1, int(
+            depth if depth is not None
+            else env_depth if env_depth else cfg.stream_prefetch_depth))
+        self.mode = (mode or os.environ.get("ONIX_PREFETCH_MODE")
+                     or cfg.stream_prefetch_mode)
+        if self.mode not in ("auto", "thread", "process"):
+            raise ValueError(f"prefetch mode must be auto|thread|process,"
+                             f" got {self.mode!r}")
+
+    def _calibrate(self, produced, item0, stats) -> str:
+        """Measured thread-vs-process pick from the first item."""
+        import pickle
+
+        table, cols, wall, _ = produced
+        try:
+            t0 = time.perf_counter()
+            blob = pickle.dumps((table, cols),
+                                protocol=pickle.HIGHEST_PROTOCOL)
+            pickle.loads(blob)
+            ipc = time.perf_counter() - t0
+            if callable(item0):
+                # Callable items (decode specs) ship cheaply INTO the
+                # pool; only the result pays IPC — but the item must
+                # actually pickle (a closure cannot).
+                pickle.dumps(item0, protocol=pickle.HIGHEST_PROTOCOL)
+            else:
+                ipc *= 2.0      # DataFrame items also ship in
+        except Exception:       # noqa: BLE001 — unpicklable item/frame
+            counters.inc("stream.prefetch_unpicklable")
+            stats["calibration"] = {"picked": "thread",
+                                    "reason": "unpicklable item"}
+            return "thread"
+        multi = (os.cpu_count() or 1) > 1
+        # Two gates: the produce wall must clear its own IPC cost by
+        # 2x, AND be big enough in absolute terms (250 ms/batch —
+        # production-scale decode+convert measures 0.3-0.5 s) that the
+        # spawn pool's per-worker startup (re-import of the consumer's
+        # modules, ~5-10 s) can amortize over the stream. Small-file
+        # streams stay on threads.
+        picked = ("process" if (multi and wall > 2.0 * ipc
+                                and wall > 0.25) else "thread")
+        stats["calibration"] = {"produce_wall_s": round(wall, 4),
+                                "pickle_roundtrip_s": round(ipc, 4),
+                                "picked": picked}
+        return picked
 
     def __iter__(self):
         import concurrent.futures as cf
+        # Explicit import: `cf.process` is a lazily-populated
+        # submodule — referencing it in an except clause from thread
+        # mode would itself AttributeError and mask the worker's real
+        # exception.
+        from concurrent.futures.process import BrokenProcessPool
 
-        def produce(item):
-            table = item() if callable(item) else item
-            t0 = time.perf_counter()
-            cols = self.scorer.convert_columns(table)
-            return table, cols, time.perf_counter() - t0
+        from onix.utils import faults
 
-        with cf.ThreadPoolExecutor(
-                max_workers=1, thread_name_prefix="onix-prefetch") as pool:
-            fut = None
-            for item in self.items:
-                nxt = pool.submit(produce, item)
-                if fut is not None:
-                    yield self._resolve(fut)
-                fut = nxt
-            if fut is not None:
-                yield self._resolve(fut)
-
-    def _resolve(self, fut):
-        t0 = time.perf_counter()
-        table, cols, conv_wall = fut.result()
-        wait = time.perf_counter() - t0
+        dt = self.scorer.datatype
+        stats = {"depth": self.depth, "resolves": 0, "occupancy_sum": 0,
+                 "occupancy_max": 0, "worker_busy_s": 0.0}
+        self.scorer.prefetch_stats = stats
         walls = self.scorer.stage_walls
-        walls["prefetch_wait"] += wait
-        walls["prefetch_overlap"] += max(conv_wall - wait, 0.0)
-        return table, cols
+
+        it = iter(self.items)
+        mode = self.mode
+        first = None
+        if mode == "auto":
+            try:
+                item0 = next(it)
+            except StopIteration:
+                stats["mode"] = "thread"
+                return
+            first = _produce_item(dt, item0)
+            mode = self._calibrate(first, item0, stats)
+        if mode == "process" and faults.active_plan() is not None:
+            mode = "thread"
+            stats["mode_forced_by_fault_plan"] = True
+        if mode == "process":
+            # Spawned workers re-import the __main__ module from its
+            # file; a consumer with no real one (stdin, python -c,
+            # interactive) cannot host a spawn pool at all.
+            import __main__
+            if not getattr(__main__, "__file__", None):
+                mode = "thread"
+                stats["mode_forced_no_main_file"] = True
+        stats["mode"] = mode
+
+        def make_pool(m):
+            if m == "process":
+                import multiprocessing
+
+                workers = min(self.depth,
+                              max(1, (os.cpu_count() or 2) - 1))
+                # Spawn, not fork: the consumer process runs JAX,
+                # whose background threads make fork-inherited lock
+                # state a deadlock hazard. Spawned workers re-import
+                # (one-time, amortized over the stream's life by pool
+                # persistence).
+                return cf.ProcessPoolExecutor(
+                    max_workers=workers,
+                    mp_context=multiprocessing.get_context("spawn"))
+            return cf.ThreadPoolExecutor(
+                max_workers=self.depth, thread_name_prefix="onix-prefetch")
+
+        pool = make_pool(mode)
+        # (item, future) pairs: decode+convert are pure reads, so a
+        # broken process pool can resubmit its in-flight items to a
+        # replacement thread pool instead of failing the stream.
+        pending: collections.deque = collections.deque()
+        try:
+            if first is not None:
+                # The calibration item ran inline: its wall blocked the
+                # consumer, so it is wait, not overlap.
+                table, cols, wall, _ = first
+                walls["prefetch_wait"] += wall
+                stats["worker_busy_s"] += wall
+                yield table, cols
+            while True:
+                while len(pending) < self.depth:
+                    try:
+                        item = next(it)
+                    except StopIteration:
+                        break
+                    pending.append((item, pool.submit(_produce_item,
+                                                      dt, item)))
+                if not pending:
+                    break
+                item, fut = pending.popleft()
+                stats["resolves"] += 1
+                occ = len(pending) + 1
+                stats["occupancy_sum"] += occ
+                stats["occupancy_max"] = max(stats["occupancy_max"], occ)
+                t0 = time.perf_counter()
+                try:
+                    table, cols, wall, delta = fut.result()
+                except BrokenProcessPool:
+                    # A worker died (OOM, spawn failure mid-stream).
+                    # Degrade to threads and replay the in-flight
+                    # items — pure work, exactly-once handoff intact.
+                    counters.inc("stream.prefetch_pool_broken")
+                    stats["pool_broken"] = True
+                    stats["mode"] = mode = "thread"
+                    pool.shutdown(wait=False, cancel_futures=True)
+                    pool = make_pool("thread")
+                    redo = [item] + [i for i, _ in pending]
+                    pending = collections.deque(
+                        (i, pool.submit(_produce_item, dt, i))
+                        for i in redo)
+                    item, fut = pending.popleft()
+                    table, cols, wall, delta = fut.result()
+                wait = time.perf_counter() - t0
+                walls["prefetch_wait"] += wait
+                walls["prefetch_overlap"] += max(wall - wait, 0.0)
+                stats["worker_busy_s"] += wall
+                if mode == "process" and delta:
+                    for name, n in delta.items():
+                        counters.inc(name, n)
+                yield table, cols
+        finally:
+            for _, fut in pending:
+                fut.cancel()
+            pool.shutdown(wait=True, cancel_futures=True)
 
 
 def run_stream(cfg: OnixConfig, datatype: str, paths: list[str],
@@ -760,7 +1218,7 @@ def run_stream(cfg: OnixConfig, datatype: str, paths: list[str],
 
     `epochs > 1` replays the file list (useful to burn in a model before
     leaving it running on live data)."""
-    from onix.ingest.run import decode
+    from onix.ingest.run import DecodeItem
     from onix.store import results_path
 
     ck_dir = None
@@ -780,9 +1238,10 @@ def run_stream(cfg: OnixConfig, datatype: str, paths: list[str],
         print(f"stream resume: skipping {done} already-processed batches")
 
     def batches():
-        """(epoch, path, decode-thunk) for every batch left to process;
-        the thunk runs on the prefetch worker, so file decode rides
-        under the previous batch's step too."""
+        """(epoch, path, DecodeItem) for every batch left to process;
+        the item runs on a prefetch worker (thread or process pool —
+        DecodeItem is picklable), so file decode AND frame→columns
+        conversion ride under earlier batches' device steps."""
         batch_idx = 0
         for epoch in range(epochs):
             for p in paths:
@@ -790,48 +1249,80 @@ def run_stream(cfg: OnixConfig, datatype: str, paths: list[str],
                 if batch_idx <= done:
                     continue
                 yield (epoch, p,
-                       lambda p=p: decode(
-                           datatype, p,
-                           apply_sampling=cfg.ingest.apply_sampling))
+                       DecodeItem(datatype, str(p),
+                                  apply_sampling=cfg.ingest
+                                  .apply_sampling))
 
     todo = list(batches())
-    prefetched = ColumnPrefetcher(scorer, (thunk for _, _, thunk in todo))
+    prefetched = ColumnPrefetcher(scorer, (item for _, _, item in todo))
     # Injected batch faults (the chaos drill) are retried under the
     # shared bounded policy. The retry is restricted to InjectedFault
-    # BY DESIGN: the fault hook fires at process() entry before any
-    # scorer state mutates, so a replay is exact — whereas an arbitrary
-    # mid-process error (device OOM during the SVI step) could land
-    # after the model/doc-table updates and a blind replay would
-    # double-train the batch. Real errors propagate: streams fail
-    # loudly, they neither skip telemetry nor double-apply it.
+    # BY DESIGN: the fault hook fires at process()/process_many()
+    # entry before any scorer state mutates, so a replay is exact —
+    # whereas an arbitrary mid-process error (device OOM during the
+    # SVI step) could land after the model/doc-table updates and a
+    # blind replay would double-train the batch. Real errors
+    # propagate: streams fail loudly, they neither skip telemetry nor
+    # double-apply it.
     from onix.utils.faults import InjectedFault
     batch_policy = resilience.RetryPolicy(max_attempts=3,
                                           base_backoff_s=0.05,
                                           max_backoff_s=2.0,
                                           salvage_on_final=False)
-    for (epoch, p, _), (table, cols) in zip(todo, prefetched):
-        res = resilience.retry_call(
-            lambda strict: scorer.process(table, cols=cols),
+
+    def consume(meta, data):
+        nonlocal total_events, total_alerts
+        results = resilience.retry_call(
+            lambda strict: scorer.process_many(data),
             policy=batch_policy, counter_prefix="stream.batch",
             retry_on=InjectedFault)
-        total_events += res.n_events
-        if epoch == epochs - 1 and len(res.alerts):
-            # Alerts land in per-day files keyed like batch results.
-            from onix.ingest.run import _day_of
-            for date, rows in res.alerts.groupby(
-                    _day_of(datatype, res.alerts)):
-                out = results_path(cfg.store.results_dir, datatype,
-                                   str(date))
-                out = out.with_name(f"{datatype}_streaming.csv")
-                out.parent.mkdir(parents=True, exist_ok=True)
-                rows.to_csv(out, mode="a", index=False,
-                            header=not out.exists())
-                total_alerts += len(rows)
-        print(f"[epoch {epoch}] {p}: {res.n_events} events, "
-              f"{len(res.alerts)} alerts, {res.n_new_docs} new docs, "
-              f"svi step {res.step}")
+        for (epoch, p), res in zip(meta, results):
+            total_events += res.n_events
+            if epoch == epochs - 1 and len(res.alerts):
+                # Alerts land in per-day files keyed like batch results.
+                from onix.ingest.run import _day_of
+                for date, rows in res.alerts.groupby(
+                        _day_of(datatype, res.alerts)):
+                    out = results_path(cfg.store.results_dir, datatype,
+                                       str(date))
+                    out = out.with_name(f"{datatype}_streaming.csv")
+                    out.parent.mkdir(parents=True, exist_ok=True)
+                    rows.to_csv(out, mode="a", index=False,
+                                header=not out.exists())
+                    total_alerts += len(rows)
+            print(f"[epoch {epoch}] {p}: {res.n_events} events, "
+                  f"{len(res.alerts)} alerts, {res.n_new_docs} new docs, "
+                  f"svi step {res.step}")
+
+    # Superstep grouping: S prefetched batches go through ONE fused
+    # dispatch (process_many). S=1 keeps the per-batch path; either
+    # way batches are consumed strictly in stream order.
+    group_size = scorer.superstep
+    meta_buf: list = []
+    data_buf: list = []
+    for (epoch, p, _), (table, cols) in zip(todo, prefetched):
+        meta_buf.append((epoch, p))
+        data_buf.append((table, cols))
+        if len(data_buf) >= group_size:
+            consume(meta_buf, data_buf)
+            meta_buf, data_buf = [], []
+    if data_buf:
+        consume(meta_buf, data_buf)
+    sh = scorer.shape_stats
     print(f"stream done: {total_events} events, {total_alerts} alerts, "
-          f"{len(scorer.pad_shapes)} compiled shapes")
+          f"{len(scorer.pad_shapes)} compiled shapes "
+          f"({sh['compiled']} compiles, {sh['repadded']} re-padded), "
+          f"dispatches {scorer.dispatches}")
+    ps = scorer.prefetch_stats
+    if ps.get("resolves"):
+        print(f"stream prefetch: mode={ps.get('mode')} "
+              f"depth={ps['depth']} "
+              f"occupancy mean "
+              f"{ps['occupancy_sum'] / max(ps['resolves'], 1):.1f}"
+              f"/max {ps['occupancy_max']}, "
+              f"worker busy {ps['worker_busy_s']:.2f}s, "
+              f"wait {scorer.stage_walls['prefetch_wait']:.2f}s, "
+              f"overlap {scorer.stage_walls['prefetch_overlap']:.2f}s")
     resil = {**counters.snapshot("stream.batch"),
              **counters.snapshot("faults"),
              **counters.snapshot("salvage")}

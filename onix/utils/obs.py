@@ -234,6 +234,21 @@ def gibbs_pallas_bytes_per_token(k_topics: int, n_rows: int,
             + n_rows * k_topics * 4 / max(block_size, 1))
 
 
+def svi_estep_bytes_per_pair(k_topics: int, iters: float) -> float:
+    """Modeled memory traffic per deduped (doc, bucket) pair of the
+    streaming SVI step (bench.py `streaming` roofline; docs/PERF.md
+    r10): per local E-step iteration, the gamma-row gather for
+    elog_theta (K·4 B), the cached elog_beta row read (K·4 B), and the
+    phi scatter-add back into gamma (K·4 B) — 3·K·4 B/iteration — plus
+    the one-time elog_beta row materialization and the scoring
+    gather-dot + score write (2·K·4 + 4 B). `iters` is the modeled
+    iteration count; artifacts pass the warm-pass length
+    (svi_warm_iters) as the floor every pair pays, so the fraction is
+    a LOWER bound on achieved traffic (compacted extended iterations
+    move less than the model charges full-block)."""
+    return iters * 3 * k_topics * 4 + 2 * k_topics * 4 + 4
+
+
 def roofline(n_items: int, wall_s: float, bytes_per_item: float,
              peak_bytes_per_s: float | None) -> dict:
     """One component's roofline entry: achieved bytes/s from the

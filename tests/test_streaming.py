@@ -405,6 +405,225 @@ def test_prefetcher_decodes_callables_on_worker():
         assert t is c and cols is not None
 
 
+def test_prefetcher_depth_k_preserves_order():
+    """Depth>1 with deliberately inverted per-item produce times must
+    still hand batches over in submission order (scorer state mutates
+    in stream order), with occupancy bounded by the depth."""
+    import time as _t
+
+    from onix.pipelines.streaming import ColumnPrefetcher
+
+    table, _ = synth_flow_day(n_events=600, n_hosts=40, n_anomalies=2,
+                              seed=5)
+    chunks = [table.iloc[i * 150:(i + 1) * 150].reset_index(drop=True)
+              for i in range(4)]
+    # First item slowest, last fastest: an unordered pipeline would
+    # yield them inverted.
+    delays = [0.2, 0.1, 0.05, 0.0]
+
+    def make(i):
+        def produce():
+            _t.sleep(delays[i])
+            return chunks[i]
+        return produce
+
+    sc = StreamingScorer(_cfg(), "flow", n_buckets=1 << 10)
+    got = [t for t, _ in ColumnPrefetcher(sc, [make(i) for i in range(4)],
+                                          depth=3, mode="thread")]
+    assert len(got) == 4
+    for g, c in zip(got, chunks):
+        assert g is c
+    stats = sc.prefetch_stats
+    assert stats["mode"] == "thread" and stats["depth"] == 3
+    assert 1 <= stats["occupancy_max"] <= 3
+
+
+def test_prefetcher_worker_exception_propagates():
+    """A worker exception must surface at the consumer's next handoff —
+    never hang the pipeline, never be swallowed — and the pool must
+    shut down cleanly afterwards."""
+    import pytest
+
+    from onix.pipelines.streaming import ColumnPrefetcher
+
+    table, _ = synth_flow_day(n_events=300, n_hosts=30, n_anomalies=2,
+                              seed=6)
+
+    def boom():
+        raise RuntimeError("poison decode")
+
+    sc = StreamingScorer(_cfg(), "flow", n_buckets=1 << 10)
+    items = [table, boom, table]
+    it = iter(ColumnPrefetcher(sc, items, depth=2, mode="thread"))
+    first, _ = next(it)
+    assert first is table
+    with pytest.raises(RuntimeError, match="poison decode"):
+        for _ in it:
+            pass
+
+
+def test_prefetcher_backpressure_bounds_inflight():
+    """When the consumer (device stage) is the bottleneck, the pipeline
+    must not run ahead of depth: at any point the source has been
+    pulled at most (yielded + depth) items — peak memory stays at
+    depth+1 frames no matter how long the stream."""
+    from onix.pipelines.streaming import ColumnPrefetcher
+
+    table, _ = synth_flow_day(n_events=400, n_hosts=30, n_anomalies=2,
+                              seed=7)
+    chunk = table.iloc[:100].reset_index(drop=True)
+    pulled = 0
+
+    def source():
+        nonlocal pulled
+        for _ in range(8):
+            pulled += 1
+            yield chunk
+
+    sc = StreamingScorer(_cfg(), "flow", n_buckets=1 << 10)
+    it = iter(ColumnPrefetcher(sc, source(), depth=2, mode="thread"))
+    seen = 0
+    for _tbl, _cols in it:
+        seen += 1
+        assert pulled <= seen + 2, (
+            f"prefetcher ran {pulled - seen} items ahead (depth 2)")
+    assert seen == 8 and pulled == 8
+
+
+def test_prefetcher_clean_shutdown_on_early_exit():
+    """Breaking out of the consuming loop mid-stream must cancel the
+    pipeline promptly: the source is never drained and the test (and
+    interpreter) does not hang on pool teardown."""
+    from onix.pipelines.streaming import ColumnPrefetcher
+
+    table, _ = synth_flow_day(n_events=300, n_hosts=30, n_anomalies=2,
+                              seed=8)
+    chunk = table.iloc[:100].reset_index(drop=True)
+    pulled = 0
+
+    def source():
+        nonlocal pulled
+        for _ in range(100):
+            pulled += 1
+            yield chunk
+
+    sc = StreamingScorer(_cfg(), "flow", n_buckets=1 << 10)
+    it = iter(ColumnPrefetcher(sc, source(), depth=3, mode="thread"))
+    next(it)
+    it.close()          # early exit — GeneratorExit runs the cleanup
+    assert pulled <= 1 + 3, "early exit kept draining the source"
+
+
+def test_prefetcher_process_mode_matches_thread(monkeypatch):
+    """The process-pool arm must be a pure transport change: identical
+    (table, cols) handoffs and identical downstream scores. Counter
+    deltas tallied in a worker process (e.g. salvage) merge back into
+    the parent registry."""
+    monkeypatch.delenv("ONIX_PREFETCH_MODE", raising=False)
+    from onix.pipelines.streaming import ColumnPrefetcher
+
+    table, _ = synth_flow_day(n_events=600, n_hosts=40, n_anomalies=3,
+                              seed=9)
+    chunks = [table.iloc[i * 300:(i + 1) * 300].reset_index(drop=True)
+              for i in range(2)]
+
+    ref = StreamingScorer(_cfg(), "flow", n_buckets=1 << 10)
+    ref_scores = [ref.process(c).scores for c in chunks]
+
+    sc = StreamingScorer(_cfg(), "flow", n_buckets=1 << 10)
+    got = []
+    for tbl, cols in ColumnPrefetcher(sc, chunks, depth=1,
+                                      mode="process"):
+        assert cols is not None
+        got.append(sc.process(tbl, cols=cols).scores)
+    assert sc.prefetch_stats["mode"] == "process"
+    for a, b in zip(ref_scores, got):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_prefetcher_auto_pins_thread_under_fault_plan(monkeypatch):
+    """Chaos drills must never route decode through a process pool —
+    fault-plan rule state (one-shot marks) is process-local, so a
+    pool worker's injected fault could not be marked consumed."""
+    from onix.pipelines.streaming import ColumnPrefetcher
+    from onix.utils import faults
+
+    monkeypatch.delenv("ONIX_PREFETCH_MODE", raising=False)
+    table, _ = synth_flow_day(n_events=300, n_hosts=30, n_anomalies=2,
+                              seed=4)
+    faults.install_plan("stream:batch@999=raise")
+    try:
+        sc = StreamingScorer(_cfg(), "flow", n_buckets=1 << 10)
+        out = list(ColumnPrefetcher(sc, [table, table], depth=2,
+                                    mode="process"))
+        assert len(out) == 2
+        assert sc.prefetch_stats["mode"] == "thread"
+        assert sc.prefetch_stats.get("mode_forced_by_fault_plan")
+    finally:
+        faults.reset()
+
+
+def test_pick_pad_caps_shape_lattice():
+    """Adversarial batch-size streams must not grow the compiled-shape
+    set unboundedly: past stream_max_shapes, batches re-pad into a
+    covering shape; a batch nothing covers escalates ONE ceiling
+    shape. Compiles and re-pads are counted."""
+    import dataclasses as dc
+
+    cfg = _cfg()
+    cfg = dc.replace(cfg, pipeline=dc.replace(cfg.pipeline,
+                                              stream_max_shapes=3))
+    sc = StreamingScorer(cfg, "flow", n_buckets=1 << 10)
+    assert sc._pick_pad(100, 10) == (256, 64)
+    assert sc._pick_pad(300, 10) == (512, 64)
+    assert sc._pick_pad(1000, 100) == (1024, 128)
+    assert sc.shape_stats == {"compiled": 3, "repadded": 0}
+    # Lattice full: a coverable new pair re-pads into the smallest
+    # covering member instead of compiling a fourth program.
+    assert sc._pick_pad(400, 100) == (1024, 128)
+    assert sc.shape_stats["repadded"] == 1
+    assert len(sc.pad_shapes) == 3
+    # Nothing covers 5000 tokens: ONE ceiling shape joins the lattice,
+    # and covers every later oddball too.
+    big = sc._pick_pad(5000, 20)
+    assert big == (8192, 128)
+    assert sc._pick_pad(3000, 90) == big
+    assert sc.shape_stats["compiled"] == 4
+    assert len(sc.pad_shapes) == 4
+
+
+def test_stage_walls_account_total_wall():
+    """Under the depth-k prefetcher, the consumer-side stage walls
+    (including prefetch_wait — the only prefetch time that blocks the
+    pipeline) must sum to ≈ the measured loop wall: no double-counted
+    hidden host time, no silently dropped stage."""
+    import time as _t
+
+    from onix.pipelines.streaming import ColumnPrefetcher
+
+    table, _ = synth_flow_day(n_events=8000, n_hosts=80, n_anomalies=4,
+                              seed=12)
+    chunks = [table.iloc[i * 2000:(i + 1) * 2000].reset_index(drop=True)
+              for i in range(4)]
+    sc = StreamingScorer(_cfg(), "flow", n_buckets=1 << 11)
+    t0 = _t.perf_counter()
+    for tbl, cols in ColumnPrefetcher(sc, chunks, depth=2,
+                                      mode="thread"):
+        sc.process(tbl, cols=cols)
+    wall = _t.perf_counter() - t0
+    accounted = sum(v for k, v in sc.stage_walls.items()
+                    if k != "prefetch_overlap")
+    # Accounted stages can never exceed the wall (they are disjoint
+    # consumer-side intervals), and must cover most of it (the rest is
+    # python glue). Generous bounds — this is a structural identity,
+    # not a performance assertion.
+    assert accounted <= wall + 0.05, (sc.stage_walls, wall)
+    assert accounted >= 0.5 * wall, (sc.stage_walls, wall)
+    # The overlap metric is informational and non-additive — it must
+    # not have been folded into the accounted sum.
+    assert sc.stage_walls["prefetch_overlap"] >= 0.0
+
+
 def test_streaming_device_mode_non_pow2_buckets_falls_back():
     """A non-power-of-two bucket count cannot use the device low-bits
     mod — every batch stays on the host path, results stay sane."""

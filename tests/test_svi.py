@@ -94,6 +94,135 @@ def test_meanchange_stop_matches_converged_fixed_count():
                                atol=5e-3, rtol=1e-3)
 
 
+def test_active_ladder_buckets():
+    from onix.models.lda_svi import _active_ladder
+    assert _active_ladder(2048) == [2048, 1024, 512, 256]
+    assert _active_ladder(256) == [256, 128, 64]
+    assert _active_ladder(64) == [64]
+
+
+def test_warm_compacted_estep_matches_legacy_loop():
+    """The warm/cold compacted E-step (svi_warm_iters > 0) must land on
+    the same converged gamma and lambda as the r6 full-block
+    while_loop, within the stopping tolerance — the compaction is a
+    cost lever, not a model change."""
+    rng = np.random.default_rng(11)
+    d = rng.integers(0, 16, 600).astype(np.int32)
+    w = rng.integers(0, 40, 600).astype(np.int32)
+    batch = make_minibatch(d, w, pad_to=1024, pad_docs=32)
+    legacy = SVILda(LDAConfig(n_topics=4, svi_meanchange_tol=1e-4,
+                              svi_local_iters=100, svi_warm_iters=0,
+                              seed=1), 40, 100)
+    compact = SVILda(LDAConfig(n_topics=4, svi_meanchange_tol=1e-4,
+                               svi_local_iters=100, svi_warm_iters=3,
+                               seed=1), 40, 100)
+    s_l, g_l = legacy.update(legacy.init(), batch)
+    s_c, g_c = compact.update(compact.init(), batch)
+    np.testing.assert_allclose(np.asarray(g_c), np.asarray(g_l),
+                               atol=5e-3, rtol=1e-2)
+    np.testing.assert_allclose(np.asarray(s_c.lam), np.asarray(s_l.lam),
+                               rtol=1e-3)
+
+
+def test_warm_compacted_estep_warm_docs_frozen_cold_docs_converge():
+    """A batch mixing pre-converged (warm-started) docs with cold ones
+    must still converge the cold docs fully: the compacted extension
+    may freeze only docs whose warm-pass delta is already under tol."""
+    rng = np.random.default_rng(13)
+    d = rng.integers(0, 8, 400).astype(np.int32)
+    w = rng.integers(0, 40, 400).astype(np.int32)
+    batch = make_minibatch(d, w, pad_to=512, pad_docs=16)
+    model = SVILda(LDAConfig(n_topics=4, svi_meanchange_tol=1e-5,
+                             svi_local_iters=200, svi_warm_iters=2,
+                             seed=1), 40, 100)
+    s0 = model.init()
+    _, g_ref = model.update(s0, batch)          # all-cold reference
+    # Warm start HALF the docs at the converged point, leave the rest
+    # at a far-off state: the far-off docs must still converge.
+    g0 = np.asarray(g_ref).copy()
+    g0[4:] = 50.0
+    _, g_mix = model.update(s0, batch, gamma0=g0)
+    np.testing.assert_allclose(np.asarray(g_mix)[:8],
+                               np.asarray(g_ref)[:8],
+                               atol=5e-3, rtol=2e-2)
+
+
+def test_superstep_matches_sequential_updates():
+    """svi_superstep (S chained updates + scoring in one program) must
+    reproduce the sequential svi_step chain: same final lambda, same
+    per-batch gamma in the union store, same per-token scores."""
+    import jax.numpy as jnp
+
+    from onix.models.lda_svi import (SuperBatch, minibatch_arrays,
+                                     svi_superstep)
+    from onix.models.scoring import score_events
+
+    rng = np.random.default_rng(17)
+    cfg = LDAConfig(n_topics=4, svi_meanchange_tol=1e-4,
+                    svi_local_iters=30, svi_warm_iters=2, seed=3)
+    model = SVILda(cfg, n_vocab=50, corpus_docs=100)
+    state = model.init()
+
+    # Three batches over overlapping global doc ids 0..11.
+    gds = [rng.integers(0, 12, 200).astype(np.int32) for _ in range(3)]
+    gws = [rng.integers(0, 50, 200).astype(np.int32) for _ in range(3)]
+    pad_to, pad_docs = 256, 16
+    arrs = [minibatch_arrays(d, w, pad_to=pad_to, pad_docs=pad_docs)
+            for d, w in zip(gds, gws)]
+    union = np.unique(np.concatenate([a[3][a[3] >= 0] for a in arrs]))
+    u = len(union)
+    u_pad = 32
+    store0 = np.full((u_pad, 4), cfg.alpha + 1.0, np.float32)
+    dmu = np.full((3, pad_docs), -1, np.int32)
+    for i, a in enumerate(arrs):
+        r = a[3] >= 0
+        dmu[i][r] = np.searchsorted(union, a[3][r]).astype(np.int32)
+    corpus = np.asarray([12.0, 12.0, 12.0], np.float32)
+
+    # Sequential reference: svi_step per batch, host-carried store.
+    seq_state = state
+    store_ref = store0.copy()
+    seq_scores = []
+    for i, a in enumerate(arrs):
+        batch = make_minibatch(gds[i], gws[i], pad_to=pad_to,
+                               pad_docs=pad_docs)
+        dm = a[3]
+        r = dm >= 0
+        g0 = np.full((pad_docs, 4), cfg.alpha + 1.0, np.float32)
+        g0[r] = store_ref[dmu[i][r]]
+        seq_state, gamma = model.update(seq_state, batch,
+                                        corpus_docs=12.0, gamma0=g0)
+        gm = np.asarray(gamma)
+        store_ref[dmu[i][r]] = gm[r]
+        theta = np.where(r[:, None], gm / gm.sum(1, keepdims=True),
+                         0.25).astype(np.float32)
+        phi = seq_state.lam / seq_state.lam.sum(0, keepdims=True)
+        seq_scores.append(np.asarray(score_events(
+            jnp.asarray(theta), phi, batch.doc_ids, batch.word_ids)))
+
+    sb = SuperBatch(
+        doc_ids=jnp.asarray(np.stack([a[0] for a in arrs])),
+        word_ids=jnp.asarray(np.stack([a[1] for a in arrs])),
+        mask=jnp.asarray(np.stack([a[2] for a in arrs])),
+        doc_map=jnp.asarray(dmu), n_docs=pad_docs)
+    new_state, store, scores = svi_superstep(
+        state, sb, jnp.asarray(store0), jnp.asarray(corpus),
+        alpha=cfg.alpha, eta=cfg.eta, tau0=cfg.svi_tau0,
+        kappa=cfg.svi_kappa, local_iters=cfg.svi_local_iters,
+        batch_docs=pad_docs, meanchange_tol=cfg.svi_meanchange_tol,
+        warm_iters=cfg.svi_warm_iters)
+
+    assert int(new_state.step) == int(seq_state.step)
+    np.testing.assert_allclose(np.asarray(new_state.lam),
+                               np.asarray(seq_state.lam), rtol=1e-5,
+                               atol=1e-6)
+    np.testing.assert_allclose(np.asarray(store)[:u], store_ref[:u],
+                               rtol=1e-4, atol=1e-5)
+    for i in range(3):
+        np.testing.assert_allclose(np.asarray(scores)[i], seq_scores[i],
+                                   rtol=1e-5, atol=1e-7)
+
+
 def test_warm_start_gamma_converges_to_same_fixed_point():
     """A warm-started E-step (returning docs' prior gamma) lands on the
     same converged gamma as the cold start — the warm start is a speed
