@@ -97,6 +97,40 @@ class LDAConfig:
     # this knob is pure performance — it is NOT part of the checkpoint
     # fingerprint and may change across a resume.
     nwk_form: str = "auto"
+    # Gibbs sampler form: "dense" keeps the O(K)-per-token block
+    # sampler (every arm of the nwk gate); "sparse" engages the r11
+    # O(K_active) arm — per-document top-A active-topic sets compacted
+    # into a static pow2 block, the dense-phi remainder proposed from
+    # stale F+-tree-style CDF tables rebuilt each sweep, corrected by
+    # Metropolis–Hastings acceptance so the stationary distribution of
+    # the blocked chain is exact (lda_gibbs.select_sampler_form /
+    # make_sparse_sweep). "auto" defers to the measured per-backend
+    # _SAMPLER_SPARSE_MIN_K crossover tables (empty entries keep dense,
+    # so defaults are unchanged until a platform is measured);
+    # ONIX_SAMPLER_FORM overrides for experiments. UNLIKE nwk_form the
+    # sparse arm is a different MCMC chain (same stationary
+    # distribution, different draws), so the RESOLVED form is part of
+    # the checkpoint fingerprint: a resume across an arm change is
+    # refused, never silently different.
+    sampler_form: str = "auto"
+    # Static width A of the sparse arm's per-doc active-topic block
+    # (topics beyond the stale top-A stay reachable through the
+    # dense-phi proposal branch; MH keeps the chain exact either way).
+    # 0 = auto: the smallest pow2 >= max(8, K/16), capped at K —
+    # occupancy-driven, so cost tracks topics touched as K grows.
+    sparse_active: int = 0
+    # Metropolis–Hastings proposals per token per sweep for the sparse
+    # arm (LightLDA-style cycle length). More proposals mix faster per
+    # sweep at linearly more per-token cost.
+    sparse_mh: int = 2
+    # Streaming local-update family: "svi" (Hoffman's uncollapsed
+    # variational E-step — the default, unchanged) or "scvb0" (the
+    # SCVB0 collapsed zeroth-order minibatch arm, arxiv 1305.2452 —
+    # no digammas, linear-space count responsibilities) riding the
+    # same superstep + union gamma store machinery. A different
+    # estimator: winner-set-parity discipline, part of the streaming
+    # checkpoint fingerprint.
+    stream_estep: str = "svi"
 
     def validate(self) -> None:
         if self.n_topics < 2:
@@ -127,6 +161,18 @@ class LDAConfig:
             raise ValueError(
                 "lda.nwk_form must be auto|scatter|matmul|pallas, "
                 f"got {self.nwk_form!r}")
+        if self.sampler_form not in ("auto", "dense", "sparse"):
+            raise ValueError(
+                "lda.sampler_form must be auto|dense|sparse, "
+                f"got {self.sampler_form!r}")
+        if self.sparse_active < 0:
+            raise ValueError("sparse_active must be >= 0 (0 = auto)")
+        if self.sparse_mh < 1:
+            raise ValueError("sparse_mh must be >= 1")
+        if self.stream_estep not in ("svi", "scvb0"):
+            raise ValueError(
+                "lda.stream_estep must be svi|scvb0, "
+                f"got {self.stream_estep!r}")
 
 
 @dataclass

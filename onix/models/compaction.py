@@ -1,0 +1,62 @@
+"""Shared pow2 active-set compaction helpers (r10/r11).
+
+Two engines exploit the same structural sparsity — most of a batch's
+work concentrates on a small *active* subset of a statically-padded
+axis — and both need static shapes under jit:
+
+* the r10 SVI E-step (`lda_svi._run_e_step`): unconverged docs' tokens
+  are compacted to the front of the padded token axis and only the
+  smallest pow2 bucket that holds them runs the extended while_loop;
+* the r11 sparse Gibbs arm (`lda_gibbs` sampler_form="sparse"):
+  per-document active-topic sets are compacted into a static pow2
+  block (top-A stale counts per doc), so per-token work scales with
+  topics *touched*, not topics allocated.
+
+The idiom is one trick: pick a pow2 ladder of static sizes up front,
+move the active entries to the front (stable, order-preserving), and
+branch (lax.switch) or slice to the smallest rung that covers them.
+These helpers are the single home of that trick; `lda_svi` re-exports
+`pow2_ladder` as its original `_active_ladder` name and is
+bit-preserved (tests/test_svi.py runs unmodified against the hoist).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def pow2_ladder(t: int, max_rungs: int = 4, floor: int = 64) -> list[int]:
+    """Pow2 bucket sizes for a compacted active block, largest (the
+    full pad `t`) first. Capped at `max_rungs` so a lax.switch over
+    the ladder compiles a bounded number of branches per shape class;
+    `floor` stops the descent where smaller buckets stop paying."""
+    sizes = [t]
+    while len(sizes) < max_rungs and sizes[-1] > floor and sizes[-1] % 2 == 0:
+        sizes.append(sizes[-1] // 2)
+    return sizes
+
+
+def ladder_index(n_active: jax.Array, sizes: list[int]) -> jax.Array:
+    """Index (int32) of the SMALLEST rung in `sizes` (descending, as
+    produced by pow2_ladder) that still holds `n_active` entries —
+    the lax.switch branch selector. sizes[0] always fits (it is the
+    full pad), so the result is in [0, len(sizes))."""
+    if len(sizes) <= 1:
+        return jnp.int32(0)
+    return sum((n_active <= jnp.int32(s)).astype(jnp.int32)
+               for s in sizes[1:])
+
+
+def compact_front(active: jax.Array) -> jax.Array:
+    """Stable permutation moving True entries of `active` to the
+    front, original order preserved on both sides — the gather
+    indices of the compaction (perm[i] = source index of slot i)."""
+    return jnp.argsort(~active, stable=True)
+
+
+def pow2_bucket(n: int, floor: int = 8) -> int:
+    """Smallest power of two >= max(n, floor) — the static width of a
+    compacted active block whose realized occupancy is at most `n`."""
+    n = max(int(n), int(floor), 1)
+    return 1 << (n - 1).bit_length()

@@ -242,6 +242,401 @@ def select_nwk_form(*, backend: str, block_size: int, n_rows: int,
     return "scatter"
 
 
+# ---------------------------------------------------------------------------
+# Sampler-form gate (r11): dense O(K) block sampler vs the sparse
+# O(K_active) arm.
+#
+# Every arm of the n_wk gate above still pays O(K) per token in three
+# places — the [B,K] probability block, the K-argmax, and the one-hot
+# delta — so a K=256 per-tenant model pays for every topic ALLOCATED
+# even when each document touches a handful. The sparse arm (Sparse
+# Partially Collapsed MCMC, arxiv 1506.03784; LightLDA-style alias/MH
+# cycling) replaces all three with work that scales with topics
+# TOUCHED: per-document top-A active-topic blocks (static pow2 width,
+# onix/models/compaction.py), a stale F+-tree-style CDF proposal for
+# the dense-phi remainder (O(log K) bisection, tables rebuilt from the
+# sweep-start counts), Metropolis–Hastings acceptance against the
+# FRESH blocked target so the stationary distribution is exactly the
+# dense arm's blocked-chain target, and rank-1 count scatters. Same
+# key-stream discipline as every other arm (the carry key splits once
+# per block), so accepted states replay deterministically — but the
+# DRAWS differ from the dense arm: this is a different chain with the
+# same stationary distribution, tested under winner-parity +
+# perplexity-band (tests/test_sparse_gibbs.py), NOT bit-identity.
+#
+# Crossover tables follow the measured-platforms-only policy of the
+# n_wk gate: auto engages the sparse arm only where a committed
+# measurement says it wins, keyed by K (the axis the win scales with).
+#   * cpu — K >= 64: measured on this 2-core host
+#     (docs/SPARSE_r11_cpu.json, exp_fit_gap 2e6 --k-sweep {16,64,256}):
+#     sparse/dense per-token fit cost 0.87x at K=16 (A=8), 1.80x at
+#     K=64 (A=8), 4.46x at K=256 (A=16, mh=2); 2.80x at K=256 on the
+#     bench shape (docs/SPARSE_r11_bench_cpu.json). 64 is the LOWEST
+#     MEASURED K where the sparse arm wins (the true crossover sits
+#     somewhere in (16, 64), unmeasured). The crossover sits above the
+#     judged K=20 pipelines — defaults there are unchanged.
+#   * tpu — NO entry until the queued crossover lands
+#     (docs/TPU_QUEUE.json `sparse_sampler_tpu`): the dense arm's
+#     [B,K] blocks ride the VPU lanes that gathers do not, so the CPU
+#     crossover must not be assumed to transfer.
+_SAMPLER_SPARSE_MIN_K: dict[str, float] = {"cpu": 64.0}
+
+
+def env_sampler_form() -> str | None:
+    """Resolve the ONIX_SAMPLER_FORM experiment override. "auto" (and
+    empty) mean None — defer to the measured gate — mirroring
+    env_nwk_form. Engines read this ONCE at construction: the resolved
+    form joins the checkpoint fingerprint, so the compiled sampler and
+    the resume identity can never disagree."""
+    import os
+    env = os.environ.get("ONIX_SAMPLER_FORM")
+    if not env or env == "auto":
+        return None
+    return env
+
+
+def select_sampler_form(*, backend: str, k_topics: int,
+                        sampler_form: str | None = None) -> str:
+    """Trace-time decision for the sampler form ("dense" | "sparse") —
+    the gate shared by GibbsLDA and ShardedGibbsLDA, mirroring
+    select_nwk_form. Priority: explicit form, then the measured
+    per-backend K crossover (_SAMPLER_SPARSE_MIN_K; unmeasured
+    platforms keep dense). An explicit "sparse" is honored at ANY K —
+    at tiny K the top-A block simply saturates (A == K)."""
+    if sampler_form is not None:
+        if sampler_form not in ("dense", "sparse"):
+            raise ValueError(
+                f"sampler_form must be dense|sparse, got {sampler_form!r}")
+        return sampler_form
+    min_k = _SAMPLER_SPARSE_MIN_K.get(backend)
+    if min_k is not None and k_topics >= min_k:
+        return "sparse"
+    return "dense"
+
+
+def sampler_fingerprint(form: str, sparse_active: int,
+                        sparse_mh: int) -> dict:
+    """Checkpoint-identity entry for the RESOLVED sampler form (shared
+    by GibbsLDA and ShardedGibbsLDA fit). Dense contributes NOTHING:
+    the dense chain is bit-identical to the pre-r11 code, so pre-r11
+    dense checkpoints keep resuming. The sparse arm adds the form plus
+    its live knobs (A and the MH cycle length change what the chain
+    samples) — which is also what refuses a resume across an arm
+    change in either direction."""
+    if form != "sparse":
+        return {}
+    return {"sampler": form,
+            "sparse": [int(sparse_active), int(sparse_mh)]}
+
+
+def _resolved_sampler_form(sampler_form: str | None, *, k_topics: int,
+                           pinned: bool) -> str:
+    """The ONE deference chain behind every sampler-form decision —
+    explicit form, then ONIX_SAMPLER_FORM, then dense when a
+    dense-only knob is pinned (an n_wk form or a block-sampler draw
+    form, argument or ONIX_NWK_FORM: the sparse arm has neither knob,
+    so auto stealing a pinned run would silently mislabel that
+    experiment), then the measured gate. Shared by resolve_sampler
+    (both engines) and make_sweep_kernel (standalone callers) so a
+    policy change can never make them resolve different arms for the
+    same config/env."""
+    form = sampler_form
+    if form is None:
+        form = env_sampler_form()
+    if form is None and (pinned or env_nwk_form() is not None):
+        form = "dense"
+    return select_sampler_form(backend=jax.default_backend(),
+                               k_topics=k_topics, sampler_form=form)
+
+
+def resolve_sampler(config, *, k_topics: int,
+                    nwk_form: str | None = None) -> tuple[str, int, dict]:
+    """The ONE construction-time sampler resolution shared by GibbsLDA
+    and ShardedGibbsLDA: config (explicit lda.sampler_form beats all),
+    then ONIX_SAMPLER_FORM, then — only for the measured auto gate —
+    deference to an explicit n_wk pin (a user who pinned
+    nwk_form=matmul/pallas is running an n_wk experiment; the sparse
+    arm has no n_wk form, so auto silently stealing the run would
+    mislabel their measurement — auto stays dense instead; an explicit
+    sampler_form/env still wins), then _SAMPLER_SPARSE_MIN_K. Returns
+    (form, resolved_active, kwargs-for-make_sweep_kernel); the form
+    feeds both the compiled programs and the checkpoint fingerprint,
+    so keeping this in one place is what keeps the two engines from
+    ever resolving different arms for the same config."""
+    sform = (None if config.sampler_form == "auto"
+             else config.sampler_form)
+    form = _resolved_sampler_form(sform, k_topics=k_topics,
+                                  pinned=nwk_form is not None)
+    active = resolve_sparse_active(k_topics, config.sparse_active)
+    return form, active, dict(sampler_form=form, sparse_active=active,
+                              sparse_mh=config.sparse_mh)
+
+
+def resolve_sparse_active(k_topics: int, sparse_active: int = 0) -> int:
+    """Static width A of the per-doc active-topic block. 0 = auto: the
+    smallest pow2 >= max(8, K/16), capped at K — sized to realistic
+    per-doc topic occupancy so cost tracks topics touched; truncation
+    below a doc's true active count costs proposal quality only (the
+    dense-phi branch keeps every topic reachable and MH keeps the
+    chain exact)."""
+    from onix.models.compaction import pow2_bucket
+    if sparse_active > 0:
+        return min(int(k_topics), int(sparse_active))
+    return min(int(k_topics), pow2_bucket(max(8, k_topics // 16)))
+
+
+class SparseTables(NamedTuple):
+    """Stale proposal tables for the sparse arm, a pure function of the
+    sweep-start counts (rebuilt each sweep inside the fused superstep,
+    so the sampled chain is independent of the superstep size S — the
+    same S-invariance every other arm has).
+
+    act_ids/act_cnt: per-doc top-A stale topics and their counts
+    (zero-count slots carry no proposal mass). phi_cdf: row cumsum of
+    the stale phi-hat (n_wk+eta)/(n_k+V*eta) — the F+-tree the dense
+    branch bisects; its last column is the row total Q_w, and its f32
+    interval widths are the REALIZED dense-branch proposal densities
+    the acceptance ratio charges. nwk/nk are the raw stale counts for
+    O(A) phi-hat evaluation over each token's active block."""
+
+    act_ids: jax.Array   # int32  [D, A]
+    act_cnt: jax.Array   # float32 [D, A] stale n_dk at act_ids
+    phi_cdf: jax.Array   # float32 [V, K]
+    nwk: jax.Array       # int32  [V, K] sweep-start snapshot
+    nk: jax.Array        # int32  [K]
+
+
+def build_sparse_tables(n_dk: jax.Array, n_wk: jax.Array, n_k: jax.Array,
+                        *, eta: float, v_eta: float,
+                        n_active: int) -> SparseTables:
+    vals, ids = jax.lax.top_k(n_dk, n_active)
+    phi = ((n_wk.astype(jnp.float32) + eta)
+           / (n_k.astype(jnp.float32)[None, :] + v_eta))
+    return SparseTables(act_ids=ids.astype(jnp.int32),
+                        act_cnt=vals.astype(jnp.float32),
+                        phi_cdf=jnp.cumsum(phi, axis=1),
+                        nwk=n_wk, nk=n_k)
+
+
+def cdf_lower_bound(cdf_flat: jax.Array, row: jax.Array, t: jax.Array,
+                    k: int) -> jax.Array:
+    """Vectorized lower_bound over rows of a flattened [*, k] CDF
+    table: the count of entries cdf[row, :] < t, in [0, k] — the
+    F+-tree-style bisection of the dense-phi proposal branch. log2(k)
+    scalar-gather rounds per element instead of gathering the whole
+    [B, K] row block (which would re-pay the O(K) the sparse arm
+    exists to avoid). Matches np.searchsorted(cdf[row], t, 'left')
+    exactly (tests/test_sparse_gibbs.py hypothesis property)."""
+    pos = jnp.zeros(row.shape, jnp.int32)
+    base = row.astype(jnp.int32) * k
+    s = 1 << max(0, int(k).bit_length() - 1)   # largest pow2 <= k
+    while s:
+        cand = pos + s
+        # Safe gather index (cand can momentarily exceed k); the move
+        # condition re-checks the bound.
+        val = jnp.take(cdf_flat, base + jnp.minimum(cand, k) - 1)
+        pos = jnp.where((cand <= k) & (val < t), cand, pos)
+        s >>= 1
+    return pos
+
+
+# Weight of the uniform escape branch in the sparse arm's proposal
+# mixture, as a fraction of the (doc block + dense CDF) mass. It buys
+# two guarantees the two main branches cannot give in f32: (i) every
+# topic has NONZERO realized proposal probability even when its CDF
+# interval rounds to zero width (a linear f32 cumsum makes draws of
+# topics below ~2^-24 of the row total exactly impossible — the same
+# failure mode the dense sampler's race replaced inverse-CDF over),
+# so the chain's support is the full target support; (ii) a state
+# outside both branches' realized support can still be LEFT (its
+# proposal density q(z) >= u_mass/K > 0 keeps the acceptance ratio
+# finite and the realized-width correction honest). 1/64 costs <2% of
+# proposal draws; the MH correction absorbs the quality loss.
+_SPARSE_UNIFORM_FRAC = 1.0 / 64.0
+
+
+def make_sparse_block_step(*, alpha: float, eta: float, v_eta: float,
+                           k_topics: int, n_mh: int,
+                           tables: SparseTables):
+    """The sparse-arm block step: for each token, `n_mh` independence-
+    sampler MH moves whose proposal mixes (i) the doc's stale top-A
+    active-topic mass — (n_dk-ish) x phi-stale over the compacted
+    block, O(A) — (ii) the dense-phi remainder alpha * phi-stale drawn
+    by CDF bisection, O(log K), and (iii) a thin uniform escape branch
+    (_SPARSE_UNIFORM_FRAC) that keeps every topic reachable under f32;
+    acceptance evaluates the FRESH blocked target at just the two
+    topics involved, O(1) gathers. The acceptance ratio uses the
+    REALIZED f32 proposal densities — the exact cumsum interval widths
+    the inverse-CDF draws land in, not the ideal per-topic masses — so
+    q() in the ratio is the distribution the sampler actually draws
+    from and the corrected chain's stationary distribution matches the
+    dense arm's block-stale conditional (counts exclude the token's
+    own sweep-start assignment, stale w.r.t. block-mates) up to the
+    uniform-draw quantization every sampler shares. Count updates are
+    rank-1 scalar scatters — O(1) per token, not a [B,K] one-hot."""
+    k = k_topics
+    a_width = tables.act_ids.shape[1]
+    cdf_flat = tables.phi_cdf.reshape(-1)
+    nwk_stale = tables.nwk.reshape(-1).astype(jnp.float32)
+    nk_stale = tables.nk.astype(jnp.float32)
+
+    def block_step(carry, xs):
+        n_dk, n_wk, n_k, key = carry
+        d, w, m, z_old = xs
+        key, skey = jax.random.split(key)   # same carry key stream as
+        #                                     the dense arm
+        b = d.shape[0]
+        u = jax.random.uniform(skey, (n_mh, b, 3), dtype=jnp.float32,
+                               minval=1e-38)
+        valid = m > 0.0
+        zf = jnp.where(valid, z_old, 0)     # gather-safe padding index
+
+        # Per-token stale doc-side block: top-A ids/counts + their
+        # stale phi values — the O(A) "topics touched" work. The
+        # REALIZED per-slot proposal masses are the f32 cumsum interval
+        # widths (exact subtractions), which is what the inverse-CDF
+        # draw below actually samples; they are what q() must charge.
+        a_ids = tables.act_ids[d]                       # [B, A]
+        a_cnt = tables.act_cnt[d]                       # [B, A]
+        phi_a = ((jnp.take(nwk_stale, w[:, None] * k + a_ids) + eta)
+                 / (jnp.take(nk_stale, a_ids) + v_eta))
+        s_cum = jnp.cumsum(a_cnt * phi_a, axis=1)
+        s_width = jnp.diff(s_cum, axis=1,
+                           prepend=jnp.zeros((b, 1), jnp.float32))
+        s_mass = s_cum[:, -1]                           # [B]
+        q_w = jnp.take(cdf_flat, w * k + (k - 1))       # row total
+        dense_mass = alpha * q_w
+        u_mass = jnp.float32(_SPARSE_UNIFORM_FRAC) * (s_mass + dense_mass)
+        tot_mass = s_mass + dense_mass + u_mass
+
+        # Fresh target (counts exclude the token's own sweep-start
+        # assignment z_old — the same exclusion the dense arm applies
+        # via its one-hot subtraction), evaluated at single topics.
+        # Gather int32 FIRST, convert the [B]-sized result: casting the
+        # live [D,K]/[V,K] here would materialize full f32 copies every
+        # block, swamping the arm's O(K_active)-per-token traffic.
+        ndk_flat = n_dk.reshape(-1)
+        nwk_flat = n_wk.reshape(-1)
+
+        def target(kk):
+            e = (kk == zf).astype(jnp.int32)
+            ndk = (jnp.take(ndk_flat, d * k + kk) - e).astype(jnp.float32)
+            nwk = (jnp.take(nwk_flat, w * k + kk) - e).astype(jnp.float32)
+            nk = (jnp.take(n_k, kk) - e).astype(jnp.float32)
+            return ((ndk + alpha) * jnp.maximum(nwk + eta, 1e-10)
+                    / (nk + v_eta))
+
+        def proposal_weight(kk):
+            """REALIZED unnormalized mixture density at kk: the f32
+            interval widths the three branches actually draw — doc
+            block slots matching kk (zero-count slots have exactly
+            zero width), the word's CDF row interval at kk, and the
+            uniform escape floor. Always >= u_mass/K > 0."""
+            hit = a_ids == kk[:, None]
+            doc_term = jnp.sum(jnp.where(hit, s_width, 0.0), axis=1)
+            hi = jnp.take(cdf_flat, w * k + kk)
+            lo = jnp.where(kk > 0,
+                           jnp.take(cdf_flat, w * k
+                                    + jnp.maximum(kk - 1, 0)), 0.0)
+            return doc_term + alpha * (hi - lo) + u_mass / k
+
+        def mh_step(i, carry_z):
+            z_cur, t_cur, q_cur = carry_z
+            u_sel, u_pos, u_acc = u[i, :, 0], u[i, :, 1], u[i, :, 2]
+            # Branch pick + draw. Doc branch: inverse-CDF over the
+            # [B, A] compacted block. Dense branch: bisect the word's
+            # stale CDF row. Uniform branch: floor(u*K).
+            t_s = u_pos * s_mass
+            j = jnp.sum((s_cum < t_s[:, None]).astype(jnp.int32), axis=1)
+            j = jnp.minimum(j, a_width - 1)
+            k_sparse = jnp.take_along_axis(a_ids, j[:, None], axis=1)[:, 0]
+            pos = cdf_lower_bound(cdf_flat, w, u_pos * q_w, k)
+            k_dense = jnp.minimum(pos, k - 1)
+            k_unif = jnp.minimum((u_pos * k).astype(jnp.int32), k - 1)
+            t_sel = u_sel * tot_mass
+            k_prop = jnp.where(t_sel < s_mass, k_sparse,
+                               jnp.where(t_sel < s_mass + dense_mass,
+                                         k_dense, k_unif))
+            # Independence-sampler acceptance: pi(k')q(z) / pi(z)q(k').
+            # target/proposal of the CURRENT state ride the loop carry
+            # (counts are frozen for the token's whole MH cycle, so the
+            # carried values are bit-identical to recomputation at half
+            # the gather traffic of this gather-bound arm).
+            t_p, q_p = target(k_prop), proposal_weight(k_prop)
+            ratio = t_p * q_cur / jnp.maximum(t_cur * q_p, 1e-38)
+            acc = u_acc < ratio
+            return (jnp.where(acc, k_prop, z_cur),
+                    jnp.where(acc, t_p, t_cur),
+                    jnp.where(acc, q_p, q_cur))
+
+        z_cur, _, _ = jax.lax.fori_loop(
+            0, n_mh, mh_step, (zf, target(zf), proposal_weight(zf)))
+        z_new = jnp.where(valid, z_cur, z_old)   # padding keeps sentinel
+
+        # Rank-1 exact int32 updates; padding (index K) drops out of
+        # bounds. Collisions within the block serialize inside the
+        # scatter exactly as the dense delta's row updates do.
+        one = jnp.ones_like(z_new)
+        n_dk = (n_dk.at[d, z_new].add(one, mode="drop")
+                     .at[d, z_old].add(-one, mode="drop"))
+        n_wk = (n_wk.at[w, z_new].add(one, mode="drop")
+                     .at[w, z_old].add(-one, mode="drop"))
+        n_k = (n_k.at[z_new].add(one, mode="drop")
+                   .at[z_old].add(-one, mode="drop"))
+        return (n_dk, n_wk, n_k, key), z_new
+
+    return block_step
+
+
+def make_sweep_kernel(*, alpha: float, eta: float, n_vocab: int,
+                      k_topics: int, nwk_form: str | None = None,
+                      nwk_matmul: bool | None = None,
+                      sampler_form: str | None = None,
+                      sparse_active: int = 0, sparse_mh: int = 2,
+                      sampler: str | None = None):
+    """One FULL sweep over blocked tokens with the sampler-form gate
+    applied — the shared kernel behind sweep(), the sharded engine's
+    per-device sweep, and the dp=1 fast path, so the gate can never
+    diverge between engines.
+
+    Returns fn(z, n_dk, n_wk, n_k, key, docs, words, mask) ->
+    (z, n_dk, n_wk, n_k, key). The sparse form rebuilds its stale
+    proposal tables from the sweep-start counts on every call (table
+    freshness is a per-sweep property, independent of how many sweeps
+    a dispatch fuses)."""
+    form = _resolved_sampler_form(
+        sampler_form, k_topics=k_topics,
+        pinned=(nwk_form is not None or nwk_matmul is not None
+                or sampler is not None))
+    if form == "dense":
+        block_step = make_block_step(alpha=alpha, eta=eta,
+                                     n_vocab=n_vocab, k_topics=k_topics,
+                                     nwk_form=nwk_form,
+                                     nwk_matmul=nwk_matmul,
+                                     sampler=sampler)
+
+        def kernel(z, n_dk, n_wk, n_k, key, docs, words, mask):
+            (n_dk, n_wk, n_k, key), z = jax.lax.scan(
+                block_step, (n_dk, n_wk, n_k, key),
+                (docs, words, mask, z))
+            return z, n_dk, n_wk, n_k, key
+        return kernel
+
+    a = resolve_sparse_active(k_topics, sparse_active)
+    v_eta = n_vocab * eta
+
+    def kernel(z, n_dk, n_wk, n_k, key, docs, words, mask):
+        tables = build_sparse_tables(n_dk, n_wk, n_k, eta=eta,
+                                     v_eta=v_eta, n_active=a)
+        block_step = make_sparse_block_step(
+            alpha=alpha, eta=eta, v_eta=v_eta, k_topics=k_topics,
+            n_mh=sparse_mh, tables=tables)
+        (n_dk, n_wk, n_k, key), z = jax.lax.scan(
+            block_step, (n_dk, n_wk, n_k, key), (docs, words, mask, z))
+        return z, n_dk, n_wk, n_k, key
+    return kernel
+
+
 def make_block_step(*, alpha: float, eta: float, n_vocab: int,
                     k_topics: int, nwk_matmul: bool | None = None,
                     nwk_form: str | None = None,
@@ -389,6 +784,9 @@ def sweep(
     n_vocab: int,
     accumulate,
     nwk_form: str | None = None,
+    sampler_form: str | None = None,
+    sparse_active: int = 0,
+    sparse_mh: int = 2,
 ) -> GibbsState:
     """One full Gibbs sweep over all token blocks (jit-friendly).
 
@@ -396,16 +794,21 @@ def sweep(
     superstep derives it from the sweep counter on device. Both forms
     produce bit-identical updates: the accumulate fold is `acc + a * n`
     with a in {0.0, 1.0} and n >= 0, so a=0 adds an exact +0.0 whether
-    or not XLA can constant-fold it away."""
-    k_topics = state.n_dk.shape[1]
-    block_step = make_block_step(alpha=alpha, eta=eta, n_vocab=n_vocab,
-                                 k_topics=k_topics, nwk_form=nwk_form)
+    or not XLA can constant-fold it away.
 
-    (n_dk, n_wk, n_k, key), z = jax.lax.scan(
-        block_step,
-        (state.n_dk, state.n_wk, state.n_k, state.key),
-        (doc_blocks, word_blocks, mask_blocks, state.z),
-    )
+    `sampler_form`/`sparse_active`/`sparse_mh` gate the r11 sparse
+    O(K_active) arm (make_sweep_kernel); None defers to the measured
+    per-backend _SAMPLER_SPARSE_MIN_K gate (dense on unmeasured
+    platforms and everywhere below the crossover)."""
+    k_topics = state.n_dk.shape[1]
+    kernel = make_sweep_kernel(alpha=alpha, eta=eta, n_vocab=n_vocab,
+                               k_topics=k_topics, nwk_form=nwk_form,
+                               sampler_form=sampler_form,
+                               sparse_active=sparse_active,
+                               sparse_mh=sparse_mh)
+    z, n_dk, n_wk, n_k, key = kernel(
+        state.z, state.n_dk, state.n_wk, state.n_k, state.key,
+        doc_blocks, word_blocks, mask_blocks)
     do_acc = jnp.asarray(accumulate, jnp.float32)
     return GibbsState(
         z=z, n_dk=n_dk, n_wk=n_wk, n_k=n_k, key=key,
@@ -437,6 +840,9 @@ def superstep(
     start_sweep,
     n_steps: int,
     nwk_form: str | None = None,
+    sampler_form: str | None = None,
+    sparse_active: int = 0,
+    sparse_mh: int = 2,
 ) -> GibbsState:
     """Chain `n_steps` full sweeps inside ONE lax.scan — one dispatch,
     one compiled program per distinct n_steps (static), any start sweep
@@ -444,14 +850,19 @@ def superstep(
     carry: sweep start_sweep + i accumulates iff it is past burn_in,
     decided on device, so the posterior-mean sums never leave the chip
     between sweeps. Bit-identical to n_steps sequential sweep()
-    dispatches under the same key stream (tests/test_gibbs.py)."""
+    dispatches under the same key stream (tests/test_gibbs.py) — for
+    the sparse arm too: its stale proposal tables are rebuilt per
+    SWEEP inside the fused program (sweep() calls make_sweep_kernel),
+    so the chain is independent of the superstep size S."""
     start_sweep = jnp.asarray(start_sweep, jnp.int32)
 
     def one(st, i):
         return sweep(st, doc_blocks, word_blocks, mask_blocks,
                      alpha=alpha, eta=eta, n_vocab=n_vocab,
                      accumulate=start_sweep + i >= burn_in,
-                     nwk_form=nwk_form), None
+                     nwk_form=nwk_form, sampler_form=sampler_form,
+                     sparse_active=sparse_active,
+                     sparse_mh=sparse_mh), None
 
     state, _ = jax.lax.scan(one, state,
                             jnp.arange(n_steps, dtype=jnp.int32))
@@ -569,6 +980,33 @@ def log_likelihood(
     return total / jnp.maximum(n, 1.0)
 
 
+# Relative predictive-ll band within which the sparse arm must land on
+# the dense arm — the gate-arm parity contract asserted by BOTH
+# decision harnesses (bench.gibbs_sweep_sparse and exp_fit_gap
+# --k-sweep), shared so the committed decision tables and the per-run
+# bench assertion can never measure different contracts.
+LL_PARITY_BAND = 0.05
+
+
+def counts_log_likelihood(
+    n_dk: jax.Array, n_wk: jax.Array, n_k: jax.Array,
+    doc_blocks: jax.Array, word_blocks: jax.Array, mask_blocks: jax.Array,
+    *, alpha: float, eta: float,
+) -> float:
+    """Mean per-token log p(w|d) straight from instantaneous raw counts
+    — the smoothing formula of posterior_estimates without the
+    accumulator plumbing, for harnesses that time raw sweep kernels and
+    hold (n_dk, n_wk, n_k) rather than a GibbsState."""
+    ndk = n_dk.astype(jnp.float32)
+    nwk = n_wk.astype(jnp.float32)
+    theta = (ndk + alpha) / (ndk.sum(-1, keepdims=True)
+                             + ndk.shape[1] * alpha)
+    phi = (nwk + eta) / (n_k.astype(jnp.float32)[None, :]
+                         + nwk.shape[0] * eta)
+    return float(log_likelihood(theta, phi, doc_blocks, word_blocks,
+                                mask_blocks))
+
+
 class GibbsLDA:
     """Host-side driver around the functional kernel.
 
@@ -586,12 +1024,23 @@ class GibbsLDA:
         # "auto" defers to the measured per-backend gate at trace time;
         # an explicit config form pins it (select_nwk_form validates).
         form = None if config.nwk_form == "auto" else config.nwk_form
+        # Sampler form resolves ONCE here (resolve_sampler: config,
+        # then ONIX_SAMPLER_FORM, then nwk-pin deference, then the
+        # measured gate) — the RESOLVED value feeds both the compiled
+        # programs and the checkpoint fingerprint, so the two can never
+        # disagree and a resume across an arm change is refused (the
+        # sparse arm is a different chain, not a bit-identical form
+        # like nwk).
+        self.sampler_form, self.sparse_active, sampler_kw = \
+            resolve_sampler(config, k_topics=config.n_topics,
+                            nwk_form=form)
         base_sweep = functools.partial(
             sweep, alpha=config.alpha, eta=config.eta, n_vocab=n_vocab,
-            nwk_form=form)
+            nwk_form=form, **sampler_kw)
         base_super = functools.partial(
             superstep, alpha=config.alpha, eta=config.eta,
-            n_vocab=n_vocab, burn_in=config.burn_in, nwk_form=form)
+            n_vocab=n_vocab, burn_in=config.burn_in, nwk_form=form,
+            **sampler_kw)
         base_est = functools.partial(
             posterior_estimates, alpha=config.alpha, eta=config.eta)
         # donate_argnums=(0,): the incoming GibbsState's buffers are
@@ -719,8 +1168,16 @@ class GibbsLDA:
         n_sweeps = cfg.n_sweeps if n_sweeps is None else n_sweeps
         S = cfg.superstep or SUPERSTEP_DEFAULT
         docs, words, mask = self.prepare(corpus)
+        # The RESOLVED sparse arm joins the identity (an auto gate
+        # flipping arms between runs — new measured table, different
+        # backend — must refuse the resume, not continue a dense chain
+        # with sparse draws); dense contributes nothing, so pre-r11
+        # dense checkpoints keep resuming.
         fp = ckpt.fingerprint(cfg, self.n_docs, self.n_vocab,
-                              corpus.n_tokens, superstep=S)
+                              corpus.n_tokens, superstep=S,
+                              extra=sampler_fingerprint(
+                                  self.sampler_form, self.sparse_active,
+                                  cfg.sparse_mh))
         # Per-fingerprint subdir: checkpoints of runs with a different
         # identity can neither be adopted nor pruned by this run.
         if checkpoint_dir is not None:

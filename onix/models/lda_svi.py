@@ -110,22 +110,32 @@ def _e_log_dirichlet(x: jax.Array, axis: int) -> jax.Array:
         x.sum(axis=axis, keepdims=True))
 
 
-def _active_ladder(t: int) -> list[int]:
-    """Pow2 bucket sizes for the compacted active-token block, largest
-    (the full pad) first. Capped at 4 rungs so the lax.switch compiles
-    a bounded number of while-loop branches per shape class."""
-    sizes = [t]
-    while len(sizes) < 4 and sizes[-1] > 64 and sizes[-1] % 2 == 0:
-        sizes.append(sizes[-1] // 2)
-    return sizes
+# Hoisted to onix/models/compaction.py (r11): the pow2 active-set
+# compaction idiom is shared with the sparse Gibbs arm. Re-exported
+# under the original name; the E-step below is bit-preserved.
+from onix.models.compaction import (compact_front, ladder_index,  # noqa: E402
+                                    pow2_ladder as _active_ladder)
 
 
 def _run_e_step(gamma0, elog_beta_t, doc_ids, mask, *, alpha: float,
                 local_iters: int, meanchange_tol: float,
-                warm_iters: int) -> jax.Array:
+                warm_iters: int, estep_form: str = "svi") -> jax.Array:
     """The local E-step over one minibatch's tokens.
 
-    Three regimes, chosen statically:
+    `estep_form` picks the update family (static):
+
+    * ``"svi"`` — Hoffman's uncollapsed variational update: token
+      responsibilities from exp(E[log theta] + E[log beta]) under the
+      Dirichlet variational posteriors (digamma terms).
+    * ``"scvb0"`` — the SCVB0 zeroth-order collapsed update
+      (arxiv 1305.2452): responsibilities directly proportional to
+      (N_theta[d,k] + alpha) · phi_hat[w,k] — no digammas, plain
+      linear-space counts. The caller passes log(phi_hat) rows as
+      `elog_beta_t` and the gamma store carries alpha + N_theta, so
+      the same store/scoring machinery (theta = gamma / sum gamma)
+      serves both forms.
+
+    Three iteration regimes, chosen statically:
 
     * ``meanchange_tol == 0`` — the original fixed-count fori_loop.
     * ``warm_iters == 0`` — the r6 per-document while_loop: the FULL
@@ -146,7 +156,14 @@ def _run_e_step(gamma0, elog_beta_t, doc_ids, mask, *, alpha: float,
       doc converged.
     """
     def e_step(gamma, d_ids, eb_t, m):
-        elog_theta = _e_log_dirichlet(gamma, axis=1)     # [Bd,K]
+        if estep_form == "scvb0":
+            # Collapsed zeroth-order responsibilities: gamma holds
+            # alpha + N_theta (> 0 always), eb_t holds log(phi_hat)
+            # rows, so softmax(log gamma + log phi_hat) is exactly the
+            # normalized (N_theta + alpha) · phi_hat of SCVB0.
+            elog_theta = jnp.log(gamma)                  # [Bd,K]
+        else:
+            elog_theta = _e_log_dirichlet(gamma, axis=1)  # [Bd,K]
         logp = elog_theta[d_ids] + eb_t                  # [T,K]
         phi = jax.nn.softmax(logp, axis=-1) * m[:, None]
         return alpha + jnp.zeros_like(gamma).at[d_ids].add(phi)
@@ -196,7 +213,7 @@ def _run_e_step(gamma0, elog_beta_t, doc_ids, mask, *, alpha: float,
     act_tok = active_d[doc_ids] & (mask > 0.0)       # [T]
     n_act = act_tok.sum()
     # Stable compaction: active docs' tokens to the front, order kept.
-    perm = jnp.argsort(~act_tok, stable=True)
+    perm = compact_front(act_tok)
     c_doc = doc_ids[perm]
     c_eb = elog_beta_t[perm]
     c_mask = jnp.where(act_tok, mask, 0.0)[perm]
@@ -236,8 +253,7 @@ def _run_e_step(gamma0, elog_beta_t, doc_ids, mask, *, alpha: float,
     # Smallest rung that still holds every active token (compaction
     # preserves order, so the first n_act compacted slots are exactly
     # the active tokens).
-    idx = sum((n_act <= jnp.int32(s)).astype(jnp.int32)
-              for s in sizes[1:]) if len(sizes) > 1 else jnp.int32(0)
+    idx = ladder_index(n_act, sizes)
     return jax.lax.switch(idx, [make_branch(s) for s in sizes], gamma)
 
 
@@ -257,8 +273,20 @@ def svi_step(
     batch_docs: int,         # static Bd for gamma shape
     meanchange_tol: float = 0.0,
     warm_iters: int = 0,
+    estep_form: str = "svi",
 ) -> tuple[SVIState, jax.Array]:
     """One SVI update. Returns (new_state, gamma [Bd,K]) for scoring.
+
+    `estep_form` ("svi" | "scvb0", static) picks the local-update
+    family (_run_e_step docstring). The scvb0 arm is the SCVB0
+    minibatch estimator of arxiv 1305.2452 riding the SAME schedule
+    machinery: the lambda step below is unchanged (lambda = eta +
+    N_phi, so the natural-gradient averaging IS the SCVB0 online
+    average of the expected topic-word counts), with the minibatch
+    scaled by documents rather than the paper's tokens — the scale
+    the streaming driver already tracks. A different estimator, NOT
+    bit-comparable to the svi arm; parity is winner-set discipline
+    (tests/test_scvb0.py).
 
     The local E-step iterates to convergence (mean |Δgamma| under
     `meanchange_tol` — Hoffman's onlineldavb stopping rule) with
@@ -272,7 +300,13 @@ def svi_step(
     converge in a few iterations instead of re-walking from the
     prior); None keeps the cold start."""
     k = state.lam.shape[1]
-    elog_beta = _e_log_dirichlet(state.lam, axis=0)      # [V,K]
+    if estep_form == "scvb0":
+        # log phi_hat rows: the collapsed arm's word term (log space so
+        # the shared softmax form serves both arms).
+        elog_beta = jnp.log(state.lam / state.lam.sum(axis=0,
+                                                      keepdims=True))
+    else:
+        elog_beta = _e_log_dirichlet(state.lam, axis=0)  # [V,K]
     elog_beta_t = elog_beta[batch.word_ids]              # [T,K]
 
     if gamma0 is None:
@@ -280,10 +314,13 @@ def svi_step(
     gamma = _run_e_step(gamma0, elog_beta_t, batch.doc_ids, batch.mask,
                         alpha=alpha, local_iters=local_iters,
                         meanchange_tol=meanchange_tol,
-                        warm_iters=warm_iters)
+                        warm_iters=warm_iters, estep_form=estep_form)
 
     # Final responsibilities under converged gamma.
-    elog_theta = _e_log_dirichlet(gamma, axis=1)
+    if estep_form == "scvb0":
+        elog_theta = jnp.log(gamma)
+    else:
+        elog_theta = _e_log_dirichlet(gamma, axis=1)
     phi = jax.nn.softmax(elog_theta[batch.doc_ids] + elog_beta_t, axis=-1)
     phi = phi * batch.mask[:, None]
 
@@ -332,6 +369,7 @@ def svi_superstep(
     batch_docs: int,
     meanchange_tol: float = 0.0,
     warm_iters: int = 0,
+    estep_form: str = "svi",
 ) -> tuple[SVIState, jax.Array, jax.Array]:
     """Chain S minibatch updates (E-step + natural-gradient λ-step +
     incremental scoring) inside ONE jitted program — the streaming
@@ -356,13 +394,20 @@ def svi_superstep(
         d_ids, w_ids, m, dmu, cdocs = xs
         real = dmu >= 0
         g0 = store[jnp.where(real, dmu, dummy)]
-        elog_beta = _e_log_dirichlet(lam, axis=0)
+        if estep_form == "scvb0":
+            elog_beta = jnp.log(lam / lam.sum(axis=0, keepdims=True))
+        else:
+            elog_beta = _e_log_dirichlet(lam, axis=0)
         elog_beta_t = elog_beta[w_ids]
         gamma = _run_e_step(g0, elog_beta_t, d_ids, m, alpha=alpha,
                             local_iters=local_iters,
                             meanchange_tol=meanchange_tol,
-                            warm_iters=warm_iters)
-        elog_theta = _e_log_dirichlet(gamma, axis=1)
+                            warm_iters=warm_iters,
+                            estep_form=estep_form)
+        if estep_form == "scvb0":
+            elog_theta = jnp.log(gamma)
+        else:
+            elog_theta = _e_log_dirichlet(gamma, axis=1)
         phi = jax.nn.softmax(elog_theta[d_ids] + elog_beta_t, axis=-1)
         phi = phi * m[:, None]
         n_real = real.sum().astype(jnp.float32)
@@ -402,13 +447,17 @@ class SVILda:
         self.n_vocab = n_vocab
         self.corpus_docs = corpus_docs
         warm = max(config.svi_warm_iters, 0)
+        # lda.stream_estep gates the local-update family: "svi" (the
+        # default, unchanged) or the SCVB0 collapsed minibatch arm
+        # (svi_step docstring). Static — one compiled program per form.
+        estep = config.stream_estep
         self._step = jax.jit(functools.partial(
             svi_step,
             alpha=config.alpha, eta=config.eta,
             tau0=config.svi_tau0, kappa=config.svi_kappa,
             local_iters=config.svi_local_iters,
             meanchange_tol=config.svi_meanchange_tol,
-            warm_iters=warm,
+            warm_iters=warm, estep_form=estep,
         ), static_argnames=("batch_docs",))
         self._superstep = jax.jit(functools.partial(
             svi_superstep,
@@ -416,7 +465,7 @@ class SVILda:
             tau0=config.svi_tau0, kappa=config.svi_kappa,
             local_iters=config.svi_local_iters,
             meanchange_tol=config.svi_meanchange_tol,
-            warm_iters=warm,
+            warm_iters=warm, estep_form=estep,
         ), static_argnames=("batch_docs",))
 
     def init(self) -> SVIState:

@@ -184,19 +184,24 @@ class ShardedGibbsState(NamedTuple):
 
 
 def _local_sweep(z, n_dk, n_wk, n_k, key, docs, words, mask, *,
-                 alpha, eta, n_vocab, k_topics, nwk_form=None):
-    """The per-device sweep body — the single-device engine's block_step,
-    shared via lda_gibbs.make_block_step so the math stays identical.
-    `n_wk` may be a vocabulary CHUNK with local word ids; the
-    denominator terms (n_k + V*eta) stay global. The n_wk count-update
-    form (scatter | matmul | pallas) gates on the LOCAL chunk width —
-    under mp sharding each chunk's collision density is what matters."""
-    block_step = lda_gibbs.make_block_step(
+                 alpha, eta, n_vocab, k_topics, nwk_form=None,
+                 sampler_form=None, sparse_active=0, sparse_mh=2):
+    """The per-device sweep body — the single-device engine's sweep
+    kernel, shared via lda_gibbs.make_sweep_kernel so the math (and
+    the sampler-form gate) stays identical. `n_wk` may be a vocabulary
+    CHUNK with local word ids; the denominator terms (n_k + V*eta)
+    stay global. The n_wk count-update form (scatter | matmul |
+    pallas) gates on the LOCAL chunk width — under mp sharding each
+    chunk's collision density is what matters. The sparse sampler arm
+    is chunk-clean too: its stale proposal tables are built from this
+    device's local rows (doc-sharded n_dk, the local n_wk chunk) and
+    every per-token gather is a local-row gather, so mp sharding needs
+    no global rebuild."""
+    kernel = lda_gibbs.make_sweep_kernel(
         alpha=alpha, eta=eta, n_vocab=n_vocab, k_topics=k_topics,
-        nwk_form=nwk_form)
-    (n_dk, n_wk, n_k, key), z = jax.lax.scan(
-        block_step, (n_dk, n_wk, n_k, key), (docs, words, mask, z))
-    return z, n_dk, n_wk, n_k, key
+        nwk_form=nwk_form, sampler_form=sampler_form,
+        sparse_active=sparse_active, sparse_mh=sparse_mh)
+    return kernel(z, n_dk, n_wk, n_k, key, docs, words, mask)
 
 
 class ShardedGibbsLDA:
@@ -238,6 +243,18 @@ class ShardedGibbsLDA:
         nwk_form = (None if config.nwk_form == "auto" else config.nwk_form)
         if nwk_form is None:
             nwk_form = lda_gibbs.env_nwk_form()
+        # Sampler form: resolved ONCE at construction via the shared
+        # lda_gibbs.resolve_sampler (config, then ONIX_SAMPLER_FORM,
+        # then nwk-pin deference, then the measured gate) — the
+        # resolved value feeds every compiled sweep AND the checkpoint
+        # fingerprint, and sharing the resolver with GibbsLDA is what
+        # keeps the two engines from ever resolving different arms for
+        # the same config. The sparse arm is a different chain, so a
+        # resume across an arm change must be refused, not silently
+        # continued.
+        self.sampler_form, self.sparse_active, sampler_kw = \
+            lda_gibbs.resolve_sampler(config, k_topics=k,
+                                      nwk_form=nwk_form)
         # shard_map has no replication rule for pallas_call, so the
         # sweep-carrying shard regions must drop the static replication
         # check whenever the Pallas form CAN be traced (explicitly
@@ -281,7 +298,8 @@ class ShardedGibbsLDA:
                     return _local_sweep(
                         zc, ndkc, nwkc, nkc, keyc, dg, wg, mg,
                         alpha=config.alpha, eta=config.eta,
-                        n_vocab=n_vocab, k_topics=k, nwk_form=nwk_form)
+                        n_vocab=n_vocab, k_topics=k, nwk_form=nwk_form,
+                        **sampler_kw)
 
                 z_new, ndk_new, nwk_new, nk_new, key_new = \
                     jax.vmap(one_chain)(zg, ndk_v, nwk_v, nk_v, key_c)
@@ -483,18 +501,16 @@ class ShardedGibbsLDA:
                                           state.n_k, d0, w0, m0,
                                           jnp.float32(0))
                 ll0 = (sm0 / jnp.maximum(t0, 1.0)).mean()
-            block_step = lda_gibbs.make_block_step(
+            sweep_kernel = lda_gibbs.make_sweep_kernel(
                 alpha=config.alpha, eta=config.eta, n_vocab=n_vocab,
-                k_topics=k, nwk_form=nwk_form)
+                k_topics=k, nwk_form=nwk_form, **sampler_kw)
 
             def one_sweep(carry, i):
                 z, ndk, nwk, nk, keys, ad, aw, na = carry
 
                 def one_chain(zc, ndkc, nwkc, nkc, keyc):
-                    (ndkc, nwkc, nkc, keyc), zc = jax.lax.scan(
-                        block_step, (ndkc, nwkc, nkc, keyc),
-                        (d0, w0, m0, zc))
-                    return zc, ndkc, nwkc, nkc, keyc
+                    return sweep_kernel(zc, ndkc, nwkc, nkc, keyc,
+                                        d0, w0, m0)
 
                 z, ndk, nwk, nk, keys = jax.vmap(one_chain)(
                     z, ndk, nwk, nk, keys)
@@ -699,7 +715,14 @@ class ShardedGibbsLDA:
                               sc.doc_map.shape[0] * sc.n_docs_local,
                               sc.n_vocab, corpus.n_tokens,
                               extra={"mesh": list(self.mesh.shape.values()),
-                                     "layout": 4},
+                                     "layout": 4,
+                                     # RESOLVED sampler arm: a resume
+                                     # across an arm change is refused
+                                     # (GibbsLDA.fit has the same rule).
+                                     **lda_gibbs.sampler_fingerprint(
+                                         self.sampler_form,
+                                         self.sparse_active,
+                                         cfg.sparse_mh)},
                               superstep=S_step)
         if checkpoint_dir is not None:
             import pathlib

@@ -375,11 +375,13 @@ class StreamingScorer:
         # the SVI schedule knobs change what this engine computes, so a
         # checkpoint under a different schedule must not be adopted.
         lda = self._lda_eff
-        # layout=4: the E-step gained the warm/cold compacted split
-        # (svi_warm_iters joins the schedule identity — a lambda
-        # trained under a different local-iteration rule is a
-        # different model and must not be adopted). layout=3 hashed
-        # the packed word_key (splitmix64), not the rendered string.
+        # layout=5: the local update gained the SCVB0 arm
+        # (lda.stream_estep joins the schedule identity — a lambda
+        # trained under the collapsed estimator is a different model
+        # and must not be adopted by the svi arm, or vice versa).
+        # layout=4 added the warm/cold compacted split (svi_warm_iters);
+        # layout=3 hashed the packed word_key (splitmix64), not the
+        # rendered string.
         return ckpt.fingerprint(
             lda, 0, self.n_buckets, 0,
             extra={"stream_datatype": self.datatype,
@@ -387,11 +389,12 @@ class StreamingScorer:
                    # meanchange joined when the E-step gained the
                    # convergence stop; warm_iters (EFFECTIVE value,
                    # after the -1 auto resolve) when it gained the
-                   # warm/cold split.
+                   # warm/cold split; estep_form when the SCVB0 arm
+                   # landed.
                    "svi": [lda.svi_tau0, lda.svi_kappa,
                            lda.svi_local_iters, lda.svi_meanchange_tol,
-                           lda.svi_warm_iters],
-                   "layout": 4})
+                           lda.svi_warm_iters, lda.stream_estep],
+                   "layout": 5})
 
     def save_checkpoint(self) -> None:
         from onix import checkpoint as ckpt

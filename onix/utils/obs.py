@@ -234,6 +234,35 @@ def gibbs_pallas_bytes_per_token(k_topics: int, n_rows: int,
             + n_rows * k_topics * 4 / max(block_size, 1))
 
 
+def gibbs_sparse_bytes_per_token(k_topics: int, n_active: int,
+                                 mh_steps: int, n_docs: int = 0,
+                                 n_vocab: int = 0,
+                                 sweep_tokens: int = 0) -> float:
+    """Modeled memory traffic per token for the r11 sparse O(K_active)
+    sampler arm (lda_gibbs sampler_form="sparse"; docs/PERF.md "sparse
+    sampler family"): the per-doc active block gathers (ids + counts +
+    stale-phi values: 3·A·4 B), per MH proposal the F+-tree bisection
+    (ceil(log2 K) scalar CDF gathers) plus ~10 scalar target/proposal
+    gathers and 12 B of uniforms, the six rank-1 count scatters
+    (read+write: 48 B), and the token stream (16 B). When the sweep
+    shape is given, the per-sweep stale-table rebuild (top-A over
+    [D,K] + the [V,K] CDF: read + write) is amortized over the sweep's
+    tokens — the honest charge for the table freshness the MH
+    correction leans on. The whole point vs gibbs_sweep_bytes_per_token
+    (4·K·4 + 12): traffic scales with A + mh·log K, not K."""
+    import math
+    log_k = math.ceil(math.log2(max(k_topics, 2)))
+    per_token = (3 * n_active * 4
+                 + mh_steps * ((log_k + 10) * 4 + 12)
+                 + 48 + 16)
+    if n_docs and n_vocab and sweep_tokens:
+        build = (n_docs * k_topics * 4            # top_k read of n_dk
+                 + 2 * n_docs * n_active * 4      # act tables write
+                 + 3 * n_vocab * k_topics * 4)    # phi read + cdf r/w
+        per_token += build / sweep_tokens
+    return per_token
+
+
 def svi_estep_bytes_per_pair(k_topics: int, iters: float) -> float:
     """Modeled memory traffic per deduped (doc, bucket) pair of the
     streaming SVI step (bench.py `streaming` roofline; docs/PERF.md

@@ -21,10 +21,20 @@ each isolated here on the real corpus shape:
      lda_gibbs._NWK_MATMUL_MIN_DENSITY and _NWK_PALLAS_MIN_DENSITY
      decision tables (docs/PERF.md; queued TPU run: docs/TPU_QUEUE.json
      `fitgap_tpu`), bit-identity asserted across all three forms.
+  F. sampler form (r11) — the dense O(K)-per-token block sampler vs
+     the sparse O(K_active) arm (top-A active sets + stale F+-tree
+     proposals + MH correction) swept over K (--k-sweep, default
+     16,64,256): the `sampler_k_sweep` rows ARE the decision table
+     behind lda_gibbs._SAMPLER_SPARSE_MIN_K (docs/SPARSE_r11_*.json;
+     TPU row queued as `sparse_sampler_tpu`). Interleaved best-of
+     timing, per-K perplexity-band parity ASSERTED (the sparse arm is
+     a different chain with the same stationary distribution, so the
+     gate-arm contract is an ll band, not bit-identity).
 
 Run on the TPU host:  python scripts/exp_fit_gap.py [n_tokens]
 Tiny tier-1 smoke (so this harness cannot rot between TPU windows):
-  python scripts/exp_fit_gap.py 4000 --hosts 200 --sweeps 2 --block 512
+  python scripts/exp_fit_gap.py 4000 --hosts 200 --sweeps 2 --block 512 \
+      --k-sweep 4,8
 Emits one JSON block; safe to rerun (compile cache persists).
 """
 
@@ -47,6 +57,12 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--block", type=int, default=1 << 17)
     ap.add_argument("--out", default=None,
                     help="also write the JSON block to this path")
+    ap.add_argument("--k-sweep", default="",
+                    help="comma-separated K values for the sampler-form "
+                         "arms (dense vs sparse, interleaved best-of); "
+                         "empty (the default) skips them so existing "
+                         "callers — the fitgap_tpu queue entry included "
+                         "— don't silently inherit the expensive sweep")
     args = ap.parse_args(argv)
     n_events = int(args.n_events)
     n_sweeps = int(args.sweeps)
@@ -255,6 +271,78 @@ def main(argv: list[str] | None = None) -> int:
         np.testing.assert_array_equal(finals["scatter"][1],
                                       finals[form][1])
     out["nwk_forms_bit_identical"] = True
+
+    # F: sampler form over K — the r11 sparse O(K_active) arm vs the
+    # dense block sampler, raw chained sweeps on the SAME corpus
+    # tokens at each K. Interleaved best-of-2 (same weather for both
+    # arms, like the A/D pair above); per-K parity is the
+    # perplexity-band contract: both arms' post-sweep predictive ll
+    # from identical inits must land within 5% of each other.
+    from onix.models.lda_gibbs import (LL_PARITY_BAND,
+                                       counts_log_likelihood,
+                                       make_sweep_kernel,
+                                       resolve_sparse_active)
+
+    k_list = [int(s) for s in args.k_sweep.split(",") if s.strip()]
+    if k_list:
+        import jax.numpy as jnp  # noqa: F811 (also imported above)
+
+        k_rows = {}
+        for k_topics in k_list:
+            def run_form(form):
+                kern = make_sweep_kernel(
+                    alpha=cfg.alpha, eta=cfg.eta, n_vocab=corpus.n_vocab,
+                    k_topics=k_topics, sampler_form=form)
+
+                @jax.jit
+                def sweepsN(z, ndk, nwk, nk, key):
+                    def one(c, _):
+                        return kern(*c, docs, words, mask), None
+                    (z, ndk, nwk, nk, key), _ = jax.lax.scan(
+                        one, (z, ndk, nwk, nk, key),
+                        jnp.arange(n_sweeps))
+                    return z, ndk, nwk, nk, key
+
+                st = init_state(docs, words, mask, corpus.n_docs,
+                                corpus.n_vocab, k_topics, cfg.seed)
+                return sweepsN, (st.z, st.n_dk, st.n_wk, st.n_k, st.key)
+
+            arms = {f: run_form(f) for f in ("dense", "sparse")}
+            best = {f: float("inf") for f in arms}
+            states = {}
+            for f, (fn, carry) in arms.items():
+                states[f] = fn(*carry)          # compile + warm
+                jax.block_until_ready(states[f][1])
+            for _ in range(2):
+                for f, (fn, _) in arms.items():
+                    t0 = time.monotonic()
+                    states[f] = fn(*states[f])
+                    jax.block_until_ready(states[f][1])
+                    best[f] = min(best[f], time.monotonic() - t0)
+
+            def counts_ll(stf):
+                _, ndk, nwk, nk, _ = stf
+                return counts_log_likelihood(ndk, nwk, nk, docs, words,
+                                             mask, alpha=cfg.alpha,
+                                             eta=cfg.eta)
+
+            lls = {f: counts_ll(states[f]) for f in arms}
+            band = LL_PARITY_BAND * abs(lls["dense"])
+            assert abs(lls["sparse"] - lls["dense"]) < band, (
+                f"sampler parity broken at K={k_topics}: {lls}")
+            row = {"n_active": resolve_sparse_active(k_topics),
+                   "ll_dense": round(lls["dense"], 4),
+                   "ll_sparse": round(lls["sparse"], 4)}
+            for f in arms:
+                row[f"{f}_wall_s"] = round(best[f], 2)
+                row[f"{f}_mtok_per_s"] = round(
+                    n_sweeps * corpus.n_tokens / best[f] / 1e6, 2)
+            row["sparse_speedup"] = round(best["dense"] / best["sparse"],
+                                          3)
+            k_rows[str(k_topics)] = row
+            print(f"sampler_k_sweep K={k_topics}:", row, flush=True)
+        out["sampler_k_sweep"] = k_rows
+        out["sampler_parity_ll_band"] = True
 
     text = json.dumps(out)
     print(text)

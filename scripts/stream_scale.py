@@ -111,6 +111,11 @@ def main() -> int:
     ap.add_argument("--warm-iters", type=int, default=None,
                     help="lda.svi_warm_iters override (the warm/cold "
                          "E-step split; -1 auto = 4 for streaming)")
+    ap.add_argument("--set", action="append", default=[],
+                    dest="overrides", metavar="KEY=VALUE",
+                    help="extra dotted-path config overrides, e.g. "
+                         "--set lda.stream_estep=scvb0 (the r11 SCVB0 "
+                         "arm; repeatable)")
     ap.add_argument("--out", default="docs/STREAM_r10.json")
     args = ap.parse_args()
 
@@ -142,6 +147,7 @@ def main() -> int:
             f"pipeline.stream_prefetch_mode={args.prefetch_mode}")
     if args.warm_iters is not None:
         overrides.append(f"lda.svi_warm_iters={args.warm_iters}")
+    overrides.extend(args.overrides)
     cfg = load_config(None, overrides)
     scorer = StreamingScorer(cfg, args.datatype, checkpoint_dir=ck_root,
                              max_docs=args.max_docs)

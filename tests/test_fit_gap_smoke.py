@@ -17,7 +17,8 @@ def test_exp_fit_gap_tiny_shape_runs_all_arms(tmp_path):
 
     out_path = tmp_path / "fitgap.json"
     rc = main(["4000", "--hosts", "200", "--sweeps", "2",
-               "--block", "512", "--out", str(out_path)])
+               "--block", "512", "--k-sweep", "4,8",
+               "--out", str(out_path)])
     assert rc == 0
     doc = json.loads(out_path.read_text())
     # Tiny shape, as specified: ~200 docs, small product vocabulary.
@@ -34,3 +35,11 @@ def test_exp_fit_gap_tiny_shape_runs_all_arms(tmp_path):
     # The three count-update forms were asserted bit-identical at this
     # run's shape inside the script.
     assert doc["nwk_forms_bit_identical"] is True
+    # The r11 sampler-form arms ran at every requested K, emitted both
+    # rates, and held the perplexity-band parity (asserted in-script).
+    assert set(doc["sampler_k_sweep"]) == {"4", "8"}
+    for row in doc["sampler_k_sweep"].values():
+        assert row["dense_mtok_per_s"] > 0
+        assert row["sparse_mtok_per_s"] > 0
+        assert row["n_active"] >= 1
+    assert doc["sampler_parity_ll_band"] is True
