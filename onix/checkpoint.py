@@ -139,6 +139,129 @@ def load_latest(ckpt_dir: str | pathlib.Path) -> Checkpoint | None:
     return None
 
 
+# ---------------------------------------------------------------------------
+# Fitted-model persistence (r12 model bank, onix/serving/).
+#
+# A checkpoint is resumable sampler STATE; a model is the finished
+# (θ, φ) PRODUCT a serving bank loads. Same file discipline as
+# checkpoints — one npz + one json meta, atomic rename, sha256 stamped
+# and verified — but keyed by a tenant NAME (slash-separated, e.g.
+# "flow/20160708" from store.model_name) instead of a sweep number.
+# `load_models` is the bank-aware bulk path: it returns HOST arrays
+# for many tenants in one call so the bank can stack them and ship ONE
+# device_put per table family (model_bank._ensure_resident), not B
+# round-trips.
+# ---------------------------------------------------------------------------
+
+
+class ModelIntegrityError(RuntimeError):
+    """A stored model's npz fails its sha256 digest — refuse to serve
+    from it (counted under `ckpt.model_digest_mismatch`; the serving
+    layer surfaces the refusal, docs/ROBUSTNESS.md)."""
+
+
+def model_path(models_dir: str | pathlib.Path, name: str) -> pathlib.Path:
+    """<models_dir>/<name>.npz, with the path-traversal guard the name
+    (client-supplied through /score) requires."""
+    root = pathlib.Path(models_dir).resolve()
+    target = (root / f"{name}.npz").resolve()
+    if root != target and root not in target.parents:
+        raise ValueError(f"model name escapes the models dir: {name!r}")
+    return target
+
+
+def save_model(models_dir: str | pathlib.Path, name: str,
+               theta, arrays_phi_wk, meta: dict | None = None) -> pathlib.Path:
+    """Atomically persist one tenant's fitted tables (npz + sha256'd
+    json meta, the checkpoint discipline)."""
+    npz_path = model_path(models_dir, name)
+    npz_path.parent.mkdir(parents=True, exist_ok=True)
+    theta = np.asarray(theta, np.float32)
+    phi_wk = np.asarray(arrays_phi_wk, np.float32)
+    tmp = npz_path.with_suffix(".npz.tmp")
+    with open(tmp, "wb") as f:
+        np.savez(f, theta=theta, phi_wk=phi_wk)
+    h = hashlib.sha256()
+    with open(tmp, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 22), b""):
+            h.update(chunk)
+    meta = dict(meta or {}, name=name,
+                n_docs=int(theta.shape[-2]), n_vocab=int(phi_wk.shape[-2]),
+                n_topics=int(theta.shape[-1]),
+                npz_sha256=h.hexdigest(), model_format=1)
+    # Stage BOTH tmp files before either final rename, so the
+    # npz/json-mismatch window on a re-save is just the two adjacent
+    # replaces (a crash between them leaves a digest mismatch, which
+    # load_model refuses — fail-closed, repaired by re-saving).
+    tmp_j = npz_path.with_suffix(".json.tmp")
+    tmp_j.write_text(json.dumps(meta, indent=2))
+    tmp.replace(npz_path)
+    tmp_j.replace(npz_path.with_suffix(".json"))
+    return npz_path
+
+
+def load_model(models_dir: str | pathlib.Path, name: str) -> Checkpoint | None:
+    """One tenant's model as a Checkpoint (arrays: theta, phi_wk), or
+    None when absent. Digest mismatches REFUSE (ModelIntegrityError) —
+    a serving bank must never score against silently-rotted tables."""
+    from onix.utils.obs import counters
+
+    npz_path = model_path(models_dir, name)
+    json_path = npz_path.with_suffix(".json")
+    if not (npz_path.exists() and json_path.exists()):
+        return None
+    # Two reads on mismatch: a concurrent re-save replaces npz then
+    # json (save_model), so a first read can catch new-npz/old-json;
+    # the re-read sees the settled pair. A PERSISTENT mismatch (crash
+    # mid-save, bit rot) still refuses.
+    for attempt in range(2):
+        meta = json.loads(json_path.read_text())
+        want = meta.get("npz_sha256")
+        if want is None:
+            break
+        h = hashlib.sha256()
+        with open(npz_path, "rb") as f:
+            for chunk in iter(lambda: f.read(1 << 22), b""):
+                h.update(chunk)
+        if h.hexdigest() == want:
+            break
+        if attempt:
+            counters.inc("ckpt.model_digest_mismatch")
+            raise ModelIntegrityError(
+                f"model {name!r} fails its sha256 digest — refusing to "
+                "serve from it")
+    with np.load(npz_path) as z:
+        arrays = {k: z[k] for k in z.files}
+    return Checkpoint(arrays=arrays, meta=meta)
+
+
+def load_models(models_dir: str | pathlib.Path,
+                names: list[str]) -> dict[str, Checkpoint]:
+    """Bulk host-side load of many tenants' models (missing names are
+    simply absent from the result; integrity failures still raise).
+    The caller stacks these and ships one device_put per table family
+    — the whole point of loading in bulk."""
+    out = {}
+    for name in names:
+        m = load_model(models_dir, name)
+        if m is not None:
+            out[name] = m
+    return out
+
+
+def list_models(models_dir: str | pathlib.Path) -> list[str]:
+    """Tenant names with a complete (npz + json) model under
+    models_dir, sorted — what /bank/stats and the CLI enumerate."""
+    root = pathlib.Path(models_dir)
+    if not root.exists():
+        return []
+    out = []
+    for p in root.rglob("*.npz"):
+        if p.with_suffix(".json").exists():
+            out.append(str(p.relative_to(root))[:-len(".npz")])
+    return sorted(out)
+
+
 # The LDAConfig fields that actually change what a Gibbs sweep computes.
 # Deliberately NOT the whole config: raising n_sweeps to extend a run, or
 # tweaking checkpoint_every / svi_* knobs the sampler never reads, must

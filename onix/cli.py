@@ -124,6 +124,16 @@ def build_parser() -> argparse.ArgumentParser:
     _add_common(p_serve)
     p_serve.add_argument("--port", type=int, default=8889)
     p_serve.add_argument("--host", default="127.0.0.1")
+    p_serve.add_argument("--models-dir", default=None,
+                         help="fitted-model bank root for the /score "
+                              "endpoint (serving.models_dir; default "
+                              "<store.root>/models — populate with "
+                              "`onix score ... -s serving.save_fitted"
+                              "=true`)")
+    p_serve.add_argument("--bank-capacity", type=int, default=None,
+                         help="resident tenants per bank shape class; "
+                              "larger banks LRU-evict at request "
+                              "boundaries (serving.bank_capacity)")
 
     p_label = sub.add_parser(
         "label", help="label OA results by rank (headless analyst feedback; "
@@ -264,6 +274,11 @@ def main(argv: list[str] | None = None) -> int:
         return run_oa(cfg, args.date, args.datatype)
 
     if args.command == "serve":
+        if args.models_dir is not None:
+            cfg.serving.models_dir = args.models_dir
+        if args.bank_capacity is not None:
+            cfg.serving.bank_capacity = args.bank_capacity
+        cfg.validate()          # re-check: flags bypass load_config's pass
         from onix.oa.serve import run_serve
         return run_serve(cfg, port=args.port, host=args.host)
 

@@ -313,6 +313,58 @@ class StoreConfig:
 
 
 @dataclass
+class ServingConfig:
+    """Model-bank serving (r12, `onix/serving/`): many tenants'
+    (θ, φ) tables resident on device as stacked bank arrays, scored
+    through one batched program per request batch (docs/PERF.md
+    "model bank"). Consumed by the `/score` endpoint on `onix serve`
+    and by the load harness."""
+
+    # Empty means "derive from store.root" (<root>/models) at
+    # validate() time — where run_scoring persists fitted models
+    # (save_fitted) and where the serve layer's bank loads from.
+    models_dir: str = ""
+    # Resident tenants per shape class (tenants bucket by pow2-padded
+    # (D_pad, V_pad, K)). Banks larger than this LRU-evict at request
+    # batch boundaries; winners stay identical (model_bank.py).
+    bank_capacity: int = 64
+    # Batched scoring form: "vmap" | "gather" | "auto" (the measured
+    # per-backend crossover table model_bank._BANK_GATHER_MIN_EVENTS;
+    # ONIX_BANK_FORM overrides for experiments). Bit-identical forms —
+    # pure performance.
+    bank_form: str = "auto"
+    # Requests per batched dispatch at the service layer; the bank
+    # further splits a batch that exceeds bank_capacity distinct
+    # tenants in one shape class.
+    max_batch_requests: int = 64
+    # Per-(tenant, window) winner cache entries kept by the service.
+    winner_cache_size: int = 4096
+    # run_scoring persists the fitted (θ, φ) under models_dir as
+    # <datatype>/<yyyymmdd> so `onix serve` can score against it.
+    save_fitted: bool = False
+    # Loader-backed models kept in the HOST registry (0 = unbounded).
+    # Device residency is bank_capacity; this bounds host RAM on a
+    # long-lived server walking many (datatype, day, tenant) models —
+    # past it the LRU re-fetchable, non-resident host copy is dropped
+    # (bank.host_evict) and reloads from models_dir on next reference.
+    host_model_cache: int = 1024
+
+    def validate(self) -> None:
+        if self.bank_capacity < 1:
+            raise ValueError("serving.bank_capacity must be >= 1")
+        if self.host_model_cache < 0:
+            raise ValueError("serving.host_model_cache must be >= 0")
+        if self.bank_form not in ("auto", "vmap", "gather"):
+            raise ValueError(
+                "serving.bank_form must be auto|vmap|gather, "
+                f"got {self.bank_form!r}")
+        if self.max_batch_requests < 1:
+            raise ValueError("serving.max_batch_requests must be >= 1")
+        if self.winner_cache_size < 0:
+            raise ValueError("serving.winner_cache_size must be >= 0")
+
+
+@dataclass
 class OAConfig:
     """Operational Analytics (SURVEY.md §2.1 #12-#13): enrichment inputs
     and the per-date UI data directory the dashboards read."""
@@ -337,11 +389,13 @@ class OnixConfig:
     ingest: IngestConfig = field(default_factory=IngestConfig)
     store: StoreConfig = field(default_factory=StoreConfig)
     oa: OAConfig = field(default_factory=OAConfig)
+    serving: ServingConfig = field(default_factory=ServingConfig)
 
     def validate(self) -> "OnixConfig":
         self.lda.validate()
         self.mesh.validate()
         self.pipeline.validate()
+        self.serving.validate()
         root = pathlib.Path(self.store.root)
         for attr, sub in (("feedback_dir", "feedback"),
                           ("results_dir", "results"),
@@ -350,6 +404,8 @@ class OnixConfig:
                 setattr(self.store, attr, str(root / sub))
         if not self.oa.data_dir:
             self.oa.data_dir = str(root / "oa")
+        if not self.serving.models_dir:
+            self.serving.models_dir = str(root / "models")
         return self
 
     # -- serialization ----------------------------------------------------
@@ -416,6 +472,7 @@ _NESTED = {
     (OnixConfig, "ingest"): IngestConfig,
     (OnixConfig, "store"): StoreConfig,
     (OnixConfig, "oa"): OAConfig,
+    (OnixConfig, "serving"): ServingConfig,
 }
 
 

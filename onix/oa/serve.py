@@ -14,6 +14,7 @@ only dynamic endpoint is the feedback write.
 from __future__ import annotations
 
 import json
+import math
 import os
 import pathlib
 import threading
@@ -92,6 +93,8 @@ class OAHandler(SimpleHTTPRequestHandler):
 
     def do_GET(self):
         path = self.path.split("?", 1)[0].split("#", 1)[0]
+        if path == "/bank/stats":
+            return self._bank_stats()
         # Editable notebook source (the in-dashboard editor's read
         # path): the installed per-datatype .ipynb as JSON.
         if path.startswith("/notebooks/") and path.endswith(".json"):
@@ -231,6 +234,8 @@ class OAHandler(SimpleHTTPRequestHandler):
             return self._kernel_control()
         if path == "/notebooks/kernel/exec":
             return self._kernel_exec()
+        if path == "/score":
+            return self._score()
         if path != "/feedback":
             self.send_error(404)
             return
@@ -254,6 +259,86 @@ class OAHandler(SimpleHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(payload)
 
+
+    # -- model-bank scoring (r12, onix/serving/) --------------------------
+    #
+    # The serving tentpole's HTTP face: mixed-tenant request batches
+    # scored through the device-resident bank in ONE batched dispatch
+    # per wave, with per-(tenant, window) winner caching. Tenants are
+    # the fitted models under serving.models_dir (store.model_name
+    # keys, persisted by run_scoring when serving.save_fitted is on).
+    # Same cross-site guard as /feedback; scoring is read-only w.r.t.
+    # models, so it keeps the wider (non-loopback) policy.
+
+    def _score(self):
+        if self._reject_cross_site():
+            return
+        from onix.serving.model_bank import BankRefusal, ScoreRequest
+        try:
+            body = self._read_json_body()
+            raw = body["requests"]
+            if not (isinstance(raw, list) and raw):
+                raise ValueError("requests must be a non-empty list")
+            import numpy as np
+            reqs = []
+            for r in raw:
+                if not isinstance(r, dict):
+                    raise ValueError("each request must be an object")
+                win = r.get("window")
+                reqs.append(ScoreRequest(
+                    tenant=str(r["tenant"]),
+                    doc_ids=np.asarray(r["doc_ids"], np.int32),
+                    word_ids=np.asarray(r["word_ids"], np.int32),
+                    window=None if win is None else str(win)))
+            tol = float(body.get("tol", self.cfg.pipeline.tol))
+            max_results = int(body.get("max_results",
+                                       self.cfg.pipeline.max_results))
+            if not 1 <= max_results <= 100_000:
+                raise ValueError(f"bad max_results {max_results}")
+        except (KeyError, TypeError, ValueError,
+                json.JSONDecodeError) as e:
+            self.send_error(400, f"bad request: {e}")
+            return
+        from onix.checkpoint import ModelIntegrityError
+        service = self.server.bank_service(self.cfg)
+        try:
+            # One writer at a time: residency + cache bookkeeping are
+            # host-side state shared across handler threads.
+            with self.server.bank_lock:
+                results = service.score(reqs, tol=tol,
+                                        max_results=max_results)
+        except (BankRefusal, ModelIntegrityError) as e:
+            # Refusal semantics (docs/ROBUSTNESS.md): unknown tenant,
+            # out-of-range ids, rotted model — rejected before any
+            # device work, never scored against wrong tables.
+            self._send_json(404, {"ok": False, "error": str(e)})
+            return
+        # Unfilled TopK slots (index -1) carry +inf scores; json.dumps
+        # would emit the non-standard token `Infinity` (invalid per RFC
+        # 8259 — JSON.parse in a browser throws). Null them instead.
+        self._send_json(200, {"ok": True, "results": [
+            {"tenant": req.tenant, "window": req.window,
+             "cached": res.cached,
+             "scores": [s if math.isfinite(s) else None
+                        for s in np.asarray(res.topk.scores).tolist()],
+             "indices": np.asarray(res.topk.indices).tolist()}
+            for req, res in zip(reqs, results)]})
+
+    def _bank_stats(self):
+        from onix.checkpoint import list_models
+        from onix.utils.obs import counters
+        service = self.server.bank_service(self.cfg)
+        with self.server.bank_lock:
+            stats = {
+                "tenants_registered": len(service.bank.tenants()),
+                "models_on_disk": len(list_models(
+                    self.cfg.serving.models_dir)),
+                "dispatches": service.bank.dispatches,
+                "compiled_shapes": len(service.bank.compiled_shapes),
+                "cache": service.cache_stats(),
+                "counters": counters.snapshot("bank"),
+            }
+        self._send_json(200, stats)
 
     def _run_notebook(self):
         """Execute the datatype's investigation notebook against the
@@ -426,6 +511,53 @@ class OAServer(ThreadingHTTPServer):
         from onix.oa.kernel import KernelManager
         super().__init__(*args, **kw)
         self.kernels = KernelManager()
+        self.bank_lock = threading.Lock()
+        self._bank_service = None
+
+    def bank_service(self, cfg: OnixConfig):
+        """The per-server BankService, created on first /score — jax
+        and the bank arrays never load for a dashboards-only server.
+        The loader pulls fitted models from serving.models_dir on
+        first reference (checkpoint.load_model — digest-verified)."""
+        with self.bank_lock:
+            if self._bank_service is None:
+                from onix.checkpoint import load_models
+                from onix.serving.model_bank import (BankRefusal,
+                                                     BankService, ModelBank,
+                                                     TenantModel)
+
+                def _as_tenant_model(name: str, m) -> TenantModel:
+                    if m.arrays["theta"].ndim != 2:
+                        raise BankRefusal(
+                            f"model {name!r} is multi-chain "
+                            f"({m.arrays['theta'].shape}); combine "
+                            "chains upstream before banking")
+                    return TenantModel(m.arrays["theta"],
+                                       m.arrays["phi_wk"])
+
+                def bulk_loader(names: list[str]) -> dict[str, TenantModel]:
+                    # ONE host-side pass over the misses
+                    # (checkpoint.load_models); absent names simply
+                    # missing from the result -> BankRefusal upstream.
+                    try:
+                        loaded = load_models(cfg.serving.models_dir, names)
+                    except ValueError as e:     # path traversal attempt
+                        raise BankRefusal(str(e)) from e
+                    return {name: _as_tenant_model(name, m)
+                            for name, m in loaded.items()}
+
+                def loader(tenant: str) -> TenantModel | None:
+                    return bulk_loader([tenant]).get(tenant)
+
+                bank = ModelBank(capacity=cfg.serving.bank_capacity,
+                                 form=cfg.serving.bank_form,
+                                 loader=loader, bulk_loader=bulk_loader,
+                                 host_capacity=cfg.serving.host_model_cache)
+                self._bank_service = BankService(
+                    bank,
+                    max_batch_requests=cfg.serving.max_batch_requests,
+                    cache_size=cfg.serving.winner_cache_size)
+            return self._bank_service
 
     def server_close(self):
         self.kernels.close_all()

@@ -211,6 +211,21 @@ def run_scoring(cfg: OnixConfig, engine: str = "gibbs",
     for s, ll in fit["ll_history"]:
         log.emit("likelihood", sweep=int(s), ll=float(ll))
 
+    # Serving handoff (r12 model bank): persist the fitted tables under
+    # serving.models_dir keyed store.model_name(datatype, date), so
+    # `onix serve`'s /score endpoint can bank this day's model
+    # alongside every other tenant's (digest-stamped npz,
+    # checkpoint.save_model).
+    model_saved = None
+    if cfg.serving.save_fitted:
+        from onix.checkpoint import save_model
+        from onix.store import model_name
+        model_saved = str(save_model(
+            cfg.serving.models_dir, model_name(datatype, date),
+            fit["theta"], fit["phi_wk"],
+            meta={"engine": engine, "config_hash": cfg.config_hash}))
+        log.emit("model_saved", path=model_saved)
+
     # Score REAL tokens only (feedback duplicates are training-only).
     meter = Meter()
     with log.stage("scoring"), trace_scope("onix.score"):
@@ -308,6 +323,8 @@ def run_scoring(cfg: OnixConfig, engine: str = "gibbs",
         "bin_edges": {k: (v if isinstance(v, list) else np.asarray(v).tolist())
                       for k, v in words.edges.items()},
     }
+    if model_saved is not None:
+        manifest["model_saved"] = model_saved
     # Resilience events tallied during this run (salvage skips, injected
     # faults, checkpoint digest mismatches) — absent on a clean run.
     from onix.utils.obs import counters as _counters

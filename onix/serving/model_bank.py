@@ -1,0 +1,563 @@
+"""Device-resident model bank: one batched program scores N tenants.
+
+The single-tenant scoring path (`onix/models/scoring.py`, `oa/serve.py`)
+costs N separate dispatches, N H2D transfers, and N compiled-program
+round-trips for N tenants — fatal at "millions of users" where the
+model axis is per-datatype × per-day × per-tenant. The bank makes the
+per-model axis a batched ARRAY dimension instead of a host-side loop
+(the AD-LDA decomposition argument, arxiv 0909.4603, applied to
+serving): tenants' (θ, φ) tables are stacked/padded into bank-shaped
+device arrays
+
+    theta_bank [B, D_pad, K]      phi_bank [B, V_pad, K]
+
+grouped by a pow2 pad ladder (`onix/models/compaction.pow2_bucket`) so
+tenants of similar size share one compiled shape class, and a
+mixed-tenant request batch is scored by ONE jitted program: a
+tenant-slot gather feeding the exact chunked bottom-M machinery of
+`scoring._scan_bottom_k`, so per-tenant winners are bit-identical to
+the single-tenant `top_suspicious` path (asserted in
+tests/test_model_bank.py and per-run in bench.py's `model_bank`
+component).
+
+Two batched forms, gated like the n_wk count-update forms:
+
+* ``vmap``   — `jax.vmap` over the request axis; each lane slices its
+  tenant's tables out of the bank (`theta_bank[slot]`) and runs the
+  shared scan. The bank axis rides XLA's batched gather.
+* ``gather`` — the bank flattens to [(B·D_pad), K] and every EVENT
+  gathers through a flat tenant-composed index `slot·D_pad + d`; one
+  fused stream scores all requests, then the same bottom-M machinery
+  selects per request row. No per-request table slice ever
+  materializes.
+
+Both forms compute `score_events`' exact gather-dot, so winners are
+bit-identical between forms AND against the single-tenant scan; the
+choice is pure performance. `_BANK_GATHER_MIN_EVENTS` is the measured
+per-backend crossover (events per dispatch), `ONIX_BANK_FORM` pins a
+form for experiments, and unmeasured backends keep the vmap default
+(docs/BANK_r12_cpu.json; TPU rows queued in docs/TPU_QUEUE.json).
+
+Residency: each shape class holds a fixed number of resident slots
+(`capacity`). Admission stages ALL newly-needed tenants of a request
+batch host-side and ships ONE `device_put` per table family (not
+per-tenant round-trips); eviction is LRU and happens ONLY at request
+batch boundaries — a tenant's tables can never change mid-scan, so a
+capped bank's winners are identical to an uncapped run (tested, and
+proven at harness scale in scripts/exp_model_bank.py). Admits, evicts,
+hits, H2D bytes/transfers, and dispatches are all counted in
+`onix.utils.obs.counters` under ``bank.*``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import os
+from collections import OrderedDict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from onix.models.compaction import pow2_bucket
+from onix.models.scoring import TopK, _scan_bottom_k, _subscan_scores, score_events
+from onix.utils.obs import counters
+
+# Pad floors for the bank shape ladder: smallest [D_pad]/[V_pad] a
+# tenant occupies. Low floors would mint a compiled shape class per
+# tiny tenant; high floors waste bank HBM on padding. 256 keeps the
+# ladder at most log2(D_max/256) classes deep.
+BANK_DOC_FLOOR = 256
+BANK_VOCAB_FLOOR = 256
+# Pow2 floor for the per-request event axis (requests pad up to the
+# smallest covering pow2 so the jit cache stays bounded).
+BANK_EVENTS_FLOOR = 64
+
+# Measured crossover: total (padded) events per dispatch above which
+# the flat tenant-gather form beats the vmap form. Keyed by backend
+# like lda_gibbs._NWK_MATMUL_MIN_DENSITY; an ABSENT backend keeps the
+# vmap default (never an unmeasured guess). cpu: 0 — the gather form
+# won at EVERY dispatch size measured on this host (1.5k..512k events
+# per dispatch, bank sizes 4..64: 1.7-6x over vmap; the vmap lanes
+# batch-gather whole [D_pad, K] table slices where the flat form
+# gathers exactly the 2K-float rows each event touches —
+# docs/BANK_r12_cpu.json `bank_size_ladder`). tpu: ABSENT until the
+# queued crossover lands (docs/TPU_QUEUE.json `model_bank_tpu`) — the
+# vmap default rides XLA's batched gather there, and the CPU result
+# must not be assumed to transfer.
+_BANK_GATHER_MIN_EVENTS = {
+    "cpu": 0,
+}
+
+
+def select_bank_form(form: str, n_requests: int, n_pad: int,
+                     backend: str | None = None) -> str:
+    """Resolve the batched scoring form for one dispatch.
+
+    Priority: ONIX_BANK_FORM env override > explicit config form >
+    the measured `_BANK_GATHER_MIN_EVENTS` table for this backend >
+    vmap. Mirrors `lda_gibbs.select_nwk_form`'s gate discipline: the
+    forms are bit-identical, so this is pure performance and safe to
+    flip between dispatches."""
+    env = os.environ.get("ONIX_BANK_FORM", "")
+    if env in ("vmap", "gather"):
+        return env
+    if form in ("vmap", "gather"):
+        return form
+    if form != "auto":
+        raise ValueError(f"bank form must be auto|vmap|gather, got {form!r}")
+    if backend is None:
+        backend = jax.default_backend()
+    min_events = _BANK_GATHER_MIN_EVENTS.get(backend)
+    if min_events is not None and n_requests * n_pad >= min_events:
+        return "gather"
+    return "vmap"
+
+
+class BankRefusal(ValueError):
+    """A request the bank refuses to score (unknown tenant, out-of-range
+    token ids, or a single batch needing more distinct tenants than the
+    residency capacity). Refusal semantics: the request is REJECTED
+    before any device work — never scored against wrong or padded
+    tables (docs/ROBUSTNESS.md "model bank refusals")."""
+
+
+@dataclasses.dataclass(frozen=True)
+class TenantModel:
+    """One tenant's fitted tables, host-side (f32 [D,K] / [V,K])."""
+    theta: np.ndarray
+    phi_wk: np.ndarray
+
+    @property
+    def n_docs(self) -> int:
+        return int(self.theta.shape[0])
+
+    @property
+    def n_vocab(self) -> int:
+        return int(self.phi_wk.shape[0])
+
+    @property
+    def n_topics(self) -> int:
+        return int(self.theta.shape[1])
+
+
+@dataclasses.dataclass
+class ScoreRequest:
+    """One (tenant, window) scoring request: bottom-`max_results`
+    suspicious events among the request's (doc, word) tokens, exactly
+    the single-tenant `top_suspicious` contract. `window` identifies an
+    immutable replay window for the serve layer's winner cache; None
+    disables caching for the request."""
+    tenant: str
+    doc_ids: np.ndarray
+    word_ids: np.ndarray
+    window: str | None = None
+
+
+# ---------------------------------------------------------------------------
+# The two batched kernels. Both end in scoring's _scan_bottom_k, so the
+# merge/tie/sentinel semantics (-1 on unfilled slots, lower-index wins
+# ties) are the single-tenant scan's by construction.
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("max_results",))
+def _bank_score_vmap(theta_bank, phi_bank, slots, doc_ids, word_ids, mask,
+                     tol, *, max_results: int) -> TopK:
+    """vmap form: one lane per request; the lane slices its tenant's
+    tables from the bank and runs the shared chunked bottom-M scan
+    (chunk = the padded row, so the scan is one merge — identical
+    result to the single-tenant path at any chunking)."""
+    n_pad = doc_ids.shape[1]
+
+    def one(slot, dr, wr, mr):
+        th = theta_bank[slot]
+        ph = phi_bank[slot]
+
+        def score_chunk(dc, wc, mc):
+            s = _subscan_scores(th, ph, dc, wc)
+            return jnp.where((mc > 0) & (s < tol), s, jnp.inf)
+
+        return _scan_bottom_k((dr, wr, mr), n_pad, score_chunk,
+                              max_results=max_results, chunk=n_pad)
+
+    return jax.vmap(one)(slots, doc_ids, word_ids, mask)
+
+
+@functools.partial(jax.jit, static_argnames=("max_results",))
+def _bank_score_gather(theta_bank, phi_bank, slots, doc_ids, word_ids, mask,
+                       tol, *, max_results: int) -> TopK:
+    """gather form: the bank flattens to [(B·D_pad), K] and every event
+    gathers via the tenant-composed flat index — one fused stream, no
+    per-request table slice. Selection reuses the same bottom-M scan
+    per request row over the precomputed (masked) scores."""
+    b, d_pad, _ = theta_bank.shape
+    v_pad = phi_bank.shape[1]
+    theta_flat = theta_bank.reshape(b * d_pad, -1)
+    phi_flat = phi_bank.reshape(b * v_pad, -1)
+    n_pad = doc_ids.shape[1]
+    gd = (slots[:, None] * jnp.int32(d_pad) + doc_ids).reshape(-1)
+    gw = (slots[:, None] * jnp.int32(v_pad) + word_ids).reshape(-1)
+    s = score_events(theta_flat, phi_flat, gd, gw).reshape(doc_ids.shape)
+    s = jnp.where((mask > 0) & (s < tol), s, jnp.inf)
+
+    def sel(sr):
+        return _scan_bottom_k((sr,), n_pad, lambda sc: sc,
+                              max_results=max_results, chunk=n_pad)
+
+    return jax.vmap(sel)(s)
+
+
+_BANK_KERNELS = {"vmap": _bank_score_vmap, "gather": _bank_score_gather}
+
+
+class _Shard:
+    """One shape class's resident bank: [C, D_pad, K] / [C, V_pad, K]
+    device arrays plus the tenant→slot LRU bookkeeping."""
+
+    def __init__(self, d_pad: int, v_pad: int, k: int, capacity: int):
+        self.d_pad, self.v_pad, self.k = d_pad, v_pad, k
+        self.capacity = capacity
+        self.theta = jnp.zeros((capacity, d_pad, k), jnp.float32)
+        self.phi = jnp.zeros((capacity, v_pad, k), jnp.float32)
+        self.lru: OrderedDict[str, int] = OrderedDict()  # tenant -> slot
+        self.free: list[int] = list(range(capacity - 1, -1, -1))
+
+
+class ModelBank:
+    """The device-resident bank: registry + residency + batched scoring.
+
+    `capacity` is resident tenants PER SHAPE CLASS (tenants land in the
+    class of their pow2-padded (D_pad, V_pad, K); same-scale tenants
+    share arrays and compiled programs). `loader(tenant)` supplies
+    models not in the host registry one at a time; `bulk_loader(names)`
+    (the serve layer wires it to `checkpoint.load_models` over
+    `serving.models_dir`) fetches a request batch's unknown tenants in
+    one host-side pass before scoring. A loader miss is a
+    `BankRefusal`. `host_capacity` (0 = unbounded) caps how many
+    loader-backed models stay in the HOST registry: beyond it, the
+    least-recently-used re-fetchable tenant that is not device-resident
+    is dropped (`bank.host_evict`) — without it a long-lived server
+    walking the per-datatype × per-day × per-tenant model space grows
+    host RAM monotonically. Explicitly `add()`ed models are never
+    host-evicted (no loader can bring them back)."""
+
+    def __init__(self, capacity: int = 64, form: str = "auto",
+                 loader=None, bulk_loader=None, host_capacity: int = 0):
+        if capacity < 1:
+            raise ValueError("bank capacity must be >= 1")
+        if host_capacity < 0:
+            raise ValueError("host_capacity must be >= 0 (0 = unbounded)")
+        self.capacity = capacity
+        self.form = form
+        self._loader = loader
+        self._bulk_loader = bulk_loader
+        self.host_capacity = host_capacity
+        self._models: OrderedDict[str, TenantModel] = OrderedDict()
+        self._loader_backed: set[str] = set()
+        self._shards: dict[tuple[int, int, int], _Shard] = {}
+        self.dispatches = 0
+        self.compiled_shapes: set[tuple] = set()
+
+    # -- registry ---------------------------------------------------------
+
+    def add(self, tenant: str, theta, phi_wk) -> None:
+        theta = np.ascontiguousarray(theta, np.float32)
+        phi_wk = np.ascontiguousarray(phi_wk, np.float32)
+        if theta.ndim != 2 or phi_wk.ndim != 2 \
+                or theta.shape[1] != phi_wk.shape[1]:
+            raise ValueError(
+                f"tenant {tenant!r}: want theta [D,K] / phi_wk [V,K] with a "
+                f"shared K, got {theta.shape} / {phi_wk.shape}")
+        self._models[tenant] = TenantModel(theta, phi_wk)
+
+    def model(self, tenant: str) -> TenantModel:
+        m = self._models.get(tenant)
+        if m is not None:
+            self._models.move_to_end(tenant)
+        if m is None and self._loader is not None:
+            m = self._loader(tenant)
+            if m is not None:
+                self.add(tenant, m.theta, m.phi_wk)
+                self._loader_backed.add(tenant)
+                self._trim_host_registry(keep={tenant})
+                m = self._models[tenant]
+        if m is None:
+            raise BankRefusal(f"unknown tenant {tenant!r}")
+        return m
+
+    def _trim_host_registry(self, keep: set[str] = frozenset()) -> None:
+        """Drop the oldest re-fetchable, non-device-resident host
+        copies down to `host_capacity` loader-backed entries. Device
+        residency is untouched; a dropped tenant simply reloads from
+        the loader on its next reference. `keep` names tenants in
+        flight (just loaded, not yet admitted) that must survive even
+        over the cap."""
+        if not self.host_capacity:
+            return
+        n_backed = len(self._loader_backed)
+        if n_backed <= self.host_capacity:
+            return
+        for t in list(self._models):        # OrderedDict: oldest first
+            if n_backed <= self.host_capacity:
+                break
+            if t in keep or t not in self._loader_backed:
+                continue
+            if any(t in sh.lru for sh in self._shards.values()):
+                continue                    # still on device: keep host copy
+            del self._models[t]
+            self._loader_backed.discard(t)
+            counters.inc("bank.host_evict")
+            n_backed -= 1
+
+    def tenants(self) -> list[str]:
+        return sorted(self._models)
+
+    def _class_of(self, m: TenantModel) -> tuple[int, int, int]:
+        return (pow2_bucket(m.n_docs, BANK_DOC_FLOOR),
+                pow2_bucket(m.n_vocab, BANK_VOCAB_FLOOR), m.n_topics)
+
+    # -- residency --------------------------------------------------------
+
+    def resident(self, tenant: str) -> bool:
+        m = self._models.get(tenant)
+        if m is None:
+            return False
+        shard = self._shards.get(self._class_of(m))
+        return shard is not None and tenant in shard.lru
+
+    def _ensure_resident(self, shard: _Shard, needed: list[str]) -> None:
+        """Admit every tenant in `needed` (distinct, order-preserving)
+        into `shard`, LRU-evicting non-needed residents as required.
+        Called only at request batch boundaries — the winners-identity
+        argument for capped banks rests on that."""
+        missing = [t for t in needed if t not in shard.lru]
+        for t in needed:
+            if t in shard.lru:
+                shard.lru.move_to_end(t)
+                counters.inc("bank.resident_hit")
+        if not missing:
+            return
+        if len(needed) > shard.capacity:
+            raise BankRefusal(
+                f"request batch needs {len(needed)} distinct tenants in one "
+                f"shape class; residency capacity is {shard.capacity} "
+                "(split the batch)")
+        needed_set = set(needed)
+        while len(shard.free) < len(missing):
+            for t in shard.lru:        # OrderedDict: oldest first
+                if t not in needed_set:
+                    shard.free.append(shard.lru.pop(t))
+                    counters.inc("bank.evict")
+                    break
+        # Stage ALL admits host-side and ship ONE device_put per table
+        # family — the bank-aware bulk load (never B round-trips).
+        n = len(missing)
+        th = np.zeros((n, shard.d_pad, shard.k), np.float32)
+        ph = np.zeros((n, shard.v_pad, shard.k), np.float32)
+        slots = np.empty(n, np.int32)
+        for i, t in enumerate(missing):
+            m = self.model(t)   # not _models[]: a tiny host_capacity may
+                                # have trimmed a copy loaded this batch
+            th[i, :m.n_docs] = m.theta
+            ph[i, :m.n_vocab] = m.phi_wk
+            slots[i] = shard.free.pop()
+            shard.lru[t] = int(slots[i])
+            counters.inc("bank.admit")
+        th_d = jax.device_put(th)
+        ph_d = jax.device_put(ph)
+        counters.inc("bank.h2d_transfers", 2)
+        counters.inc("bank.h2d_bytes", th.nbytes + ph.nbytes)
+        idx = jnp.asarray(slots)
+        shard.theta = shard.theta.at[idx].set(th_d)
+        shard.phi = shard.phi.at[idx].set(ph_d)
+
+    # -- scoring ----------------------------------------------------------
+
+    def _validate(self, req: ScoreRequest, m: TenantModel) -> None:
+        d = np.asarray(req.doc_ids)
+        w = np.asarray(req.word_ids)
+        if d.shape != w.shape or d.ndim != 1:
+            raise BankRefusal(
+                f"tenant {req.tenant!r}: doc_ids/word_ids must be equal-"
+                f"length 1-d arrays, got {d.shape} / {w.shape}")
+        if d.size and (int(d.min()) < 0 or int(d.max()) >= m.n_docs
+                       or int(w.min()) < 0 or int(w.max()) >= m.n_vocab):
+            # Out-of-range ids would gather PADDING rows (score 0 — a
+            # fabricated top winner). Refuse, never clamp.
+            raise BankRefusal(
+                f"tenant {req.tenant!r}: token ids out of range for its "
+                f"model (D={m.n_docs}, V={m.n_vocab})")
+
+    def score_batch(self, requests: list[ScoreRequest], *, tol: float,
+                    max_results: int) -> list[TopK]:
+        """Score a mixed-tenant request batch; returns host-side TopK
+        per request, in request order. Requests group by shape class
+        and split into residency-capacity waves; each wave is ONE
+        jitted dispatch (the N→1 collapse the bank exists for)."""
+        out: list[TopK | None] = [None] * len(requests)
+        if self._bulk_loader is not None:
+            # Fetch the batch's unknown tenants in ONE host-side pass
+            # (checkpoint.load_models) instead of per-tenant loader
+            # round-trips; model() below still backstops stragglers.
+            unknown: list[str] = []
+            for req in requests:
+                if req.tenant not in self._models \
+                        and req.tenant not in unknown:
+                    unknown.append(req.tenant)
+            if unknown:
+                for t, m in self._bulk_loader(unknown).items():
+                    self.add(t, m.theta, m.phi_wk)
+                    self._loader_backed.add(t)
+                self._trim_host_registry(
+                    keep={req.tenant for req in requests})
+        by_class: dict[tuple, list[int]] = {}
+        for i, req in enumerate(requests):
+            m = self.model(req.tenant)
+            self._validate(req, m)
+            by_class.setdefault(self._class_of(m), []).append(i)
+        for key, idxs in by_class.items():
+            shard = self._shards.get(key)
+            if shard is None:
+                shard = self._shards[key] = _Shard(*key, self.capacity)
+            for wave in self._waves(requests, idxs, shard.capacity):
+                self._score_wave(shard, requests, wave, out, tol=tol,
+                                 max_results=max_results)
+        # Device eviction above may have freed host copies for trimming
+        # (request-batch boundary — same place residency may change).
+        self._trim_host_registry()
+        return out  # type: ignore[return-value]
+
+    @staticmethod
+    def _waves(requests, idxs: list[int], capacity: int):
+        """Split one class's request indices into waves of <= capacity
+        distinct tenants, preserving order (eviction then happens only
+        BETWEEN waves — request boundaries)."""
+        wave: list[int] = []
+        tenants: set[str] = set()
+        for i in idxs:
+            t = requests[i].tenant
+            if t not in tenants and len(tenants) == capacity:
+                yield wave
+                wave, tenants = [], set()
+            wave.append(i)
+            tenants.add(t)
+        if wave:
+            yield wave
+
+    def _score_wave(self, shard: _Shard, requests, wave: list[int],
+                    out: list, *, tol: float, max_results: int) -> None:
+        needed: list[str] = []
+        for i in wave:
+            if requests[i].tenant not in needed:
+                needed.append(requests[i].tenant)
+        self._ensure_resident(shard, needed)
+
+        r = len(wave)
+        n_events = [int(np.asarray(requests[i].doc_ids).size) for i in wave]
+        n_pad = pow2_bucket(max(n_events), BANK_EVENTS_FLOOR)
+        r_pad = pow2_bucket(r, 1)
+        d = np.zeros((r_pad, n_pad), np.int32)
+        w = np.zeros((r_pad, n_pad), np.int32)
+        m = np.zeros((r_pad, n_pad), np.float32)
+        slots = np.zeros(r_pad, np.int32)
+        for row, i in enumerate(wave):
+            n = n_events[row]
+            d[row, :n] = np.asarray(requests[i].doc_ids, np.int32)
+            w[row, :n] = np.asarray(requests[i].word_ids, np.int32)
+            m[row, :n] = 1.0
+            slots[row] = shard.lru[requests[i].tenant]
+
+        form = select_bank_form(self.form, r_pad, n_pad)
+        shape_key = (form, shard.d_pad, shard.v_pad, shard.k, r_pad, n_pad,
+                     max_results)
+        self.compiled_shapes.add(shape_key)
+        res = _BANK_KERNELS[form](
+            shard.theta, shard.phi, jnp.asarray(slots), jnp.asarray(d),
+            jnp.asarray(w), jnp.asarray(m), jnp.float32(tol),
+            max_results=max_results)
+        self.dispatches += 1
+        counters.inc("bank.dispatch")
+        counters.inc("bank.requests", r)
+        counters.inc("bank.events", sum(n_events))
+        scores = np.asarray(res.scores)    # ONE fetch per dispatch
+        indices = np.asarray(res.indices)
+        for row, i in enumerate(wave):
+            out[i] = TopK(scores=scores[row], indices=indices[row])
+
+
+@dataclasses.dataclass
+class BankResult:
+    """One request's outcome through the service: winners + provenance."""
+    topk: TopK
+    cached: bool
+
+
+class BankService:
+    """Request batching + per-(tenant, window) winner caching in front
+    of the bank — the serve layer's entry point (`/score`).
+
+    The cache asserts the (tenant, window) contract: a window names one
+    immutable event set (a finished day/hour), so its winners are a
+    pure function of (tenant, window, tol, max_results) — tol and
+    max_results join the key, so a repeat of the same window at a
+    different threshold or result count is scored fresh, never served
+    the other parameterization's winners. A repeat with a DIFFERENT
+    event count is treated as a conflict: scored fresh, re-cached, and
+    counted (`bank.cache_conflict`) — never served stale."""
+
+    def __init__(self, bank: ModelBank, max_batch_requests: int = 64,
+                 cache_size: int = 4096):
+        if max_batch_requests < 1:
+            raise ValueError("max_batch_requests must be >= 1")
+        self.bank = bank
+        self.max_batch_requests = max_batch_requests
+        self.cache_size = cache_size
+        self._cache: OrderedDict[tuple[str, str, float, int],
+                                 tuple[int, TopK]] = OrderedDict()
+
+    def score(self, requests: list[ScoreRequest], *, tol: float,
+              max_results: int) -> list[BankResult]:
+        out: list[BankResult | None] = [None] * len(requests)
+        misses: list[int] = []
+        for i, req in enumerate(requests):
+            key = (req.tenant, req.window, float(tol), int(max_results)) \
+                if req.window is not None else None
+            hit = self._cache.get(key) if key is not None else None
+            if hit is not None:
+                n_cached, topk = hit
+                if n_cached == int(np.asarray(req.doc_ids).size):
+                    self._cache.move_to_end(key)
+                    counters.inc("bank.cache_hit")
+                    out[i] = BankResult(topk, cached=True)
+                    continue
+                counters.inc("bank.cache_conflict")
+            if key is not None:     # uncacheable requests don't dilute
+                counters.inc("bank.cache_miss")
+            misses.append(i)
+        for lo in range(0, len(misses), self.max_batch_requests):
+            chunk = misses[lo:lo + self.max_batch_requests]
+            topks = self.bank.score_batch([requests[i] for i in chunk],
+                                          tol=tol, max_results=max_results)
+            for i, topk in zip(chunk, topks):
+                out[i] = BankResult(topk, cached=False)
+                req = requests[i]
+                if req.window is not None:
+                    self._put(
+                        (req.tenant, req.window, float(tol),
+                         int(max_results)),
+                        (int(np.asarray(req.doc_ids).size), topk))
+        return out  # type: ignore[return-value]
+
+    def _put(self, key, value) -> None:
+        self._cache[key] = value
+        self._cache.move_to_end(key)
+        while len(self._cache) > self.cache_size:
+            self._cache.popitem(last=False)
+
+    def cache_stats(self) -> dict:
+        return {"entries": len(self._cache),
+                "hits": counters.get("bank.cache_hit"),
+                "misses": counters.get("bank.cache_miss"),
+                "conflicts": counters.get("bank.cache_conflict")}

@@ -160,6 +160,17 @@ def results_path(results_dir: str | pathlib.Path, datatype: str,
             / f"{datatype}_results.csv")
 
 
+def model_name(datatype: str, date: str, tenant: str | None = None) -> str:
+    """Canonical bank key for a fitted model: the per-datatype ×
+    per-day (× per-tenant) identity the serving layer addresses models
+    by — `flow/20160708` or `flow/20160708/acme`. Used as the path stem
+    under serving.models_dir (checkpoint.model_path) and as the tenant
+    id in /score requests."""
+    y, mo, d = parse_date(date)
+    base = f"{datatype}/{y}{mo}{d}"
+    return f"{base}/{tenant}" if tenant else base
+
+
 def feedback_path(feedback_dir: str | pathlib.Path, datatype: str,
                   date: str) -> pathlib.Path:
     """Analyst feedback CSV the next ML run consumes (the L5→L4 noise

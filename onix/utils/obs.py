@@ -263,6 +263,21 @@ def gibbs_sparse_bytes_per_token(k_topics: int, n_active: int,
     return per_token
 
 
+def bank_score_bytes_per_event(k_topics: int, dtype_bytes: int = 4) -> float:
+    """Modeled memory traffic per scored event through the model bank's
+    batched program (onix/serving/model_bank.py; bench.py `model_bank`
+    roofline): the two bank-row gathers (θ_bank[slot, d], φ_bank[slot,
+    w]: 2·K·dtype B — the tenant axis folds into the gather index, so
+    the TENANT gather is these same rows, charged once), the per-event
+    token stream (d, w ids + mask: 12 B), the request's tenant slot
+    read amortized per event (≈4 B charged flat), and the f32 score
+    write feeding selection (4 B). Identical per-event traffic to the
+    single-tenant scan's model (bench `_roofline_detail`) plus the slot
+    read — which is exactly the claim: banking N tenants adds a slot
+    gather, not N× dispatch overhead."""
+    return 2 * k_topics * dtype_bytes + 12 + 4 + 4
+
+
 def svi_estep_bytes_per_pair(k_topics: int, iters: float) -> float:
     """Modeled memory traffic per deduped (doc, bucket) pair of the
     streaming SVI step (bench.py `streaming` roofline; docs/PERF.md

@@ -251,3 +251,80 @@ def test_sharded_resume_is_bit_identical(tmp_path, eight_devices):
         corpus, checkpoint_dir=tmp_path)
     clean2 = ShardedGibbsLDA(cfg, corpus.n_vocab, mesh=mesh2).fit(corpus)
     _assert_states_equal(clean2["state"], fresh["state"])
+
+
+# -- fitted-model persistence (r12 model bank) ------------------------------
+
+
+def test_model_save_load_roundtrip(tmp_path):
+    """save_model/load_model: exact arrays back, meta stamped with
+    shape + digest, nested (slash) names land in subdirs."""
+    rng = np.random.default_rng(0)
+    theta = rng.random((40, 6), np.float32)
+    phi = rng.random((30, 6), np.float32)
+    path = ckpt.save_model(tmp_path, "flow/20160708", theta, phi,
+                           meta={"engine": "gibbs"})
+    assert path.parent.name == "flow"
+    m = ckpt.load_model(tmp_path, "flow/20160708")
+    np.testing.assert_array_equal(m.arrays["theta"], theta)
+    np.testing.assert_array_equal(m.arrays["phi_wk"], phi)
+    assert m.meta["n_docs"] == 40 and m.meta["n_vocab"] == 30
+    assert m.meta["n_topics"] == 6 and m.meta["engine"] == "gibbs"
+    assert m.meta["model_format"] == 1
+    assert ckpt.load_model(tmp_path, "flow/19990101") is None
+    assert ckpt.list_models(tmp_path) == ["flow/20160708"]
+
+
+def test_model_digest_mismatch_refuses(tmp_path):
+    """A bit-flipped model npz is REFUSED (ModelIntegrityError), never
+    silently served — the bank's integrity contract."""
+    rng = np.random.default_rng(1)
+    path = ckpt.save_model(tmp_path, "m", rng.random((8, 4), np.float32),
+                           rng.random((6, 4), np.float32))
+    raw = bytearray(path.read_bytes())
+    raw[len(raw) // 2] ^= 0xFF
+    path.write_bytes(bytes(raw))
+    from onix.utils.obs import counters
+    counters.reset("ckpt")
+    with pytest.raises(ckpt.ModelIntegrityError):
+        ckpt.load_model(tmp_path, "m")
+    assert counters.get("ckpt.model_digest_mismatch") == 1
+
+
+def test_model_path_traversal_guard(tmp_path):
+    with pytest.raises(ValueError, match="escapes"):
+        ckpt.model_path(tmp_path / "models", "../../etc/passwd")
+
+
+def test_run_scoring_saves_model_for_serving(tmp_path):
+    """serving.save_fitted: run_scoring persists the day's (theta,
+    phi_wk) under serving.models_dir keyed store.model_name, loadable
+    by the bank."""
+    from onix.config import OnixConfig
+    from onix.pipelines.run import run_scoring
+    from onix.pipelines.synth import synth_flow_day
+    from onix.store import Store, model_name
+
+    cfg = OnixConfig()
+    cfg.store.root = str(tmp_path / "store")
+    cfg.serving.save_fitted = True
+    cfg.lda.n_sweeps, cfg.lda.burn_in = 4, 2
+    cfg.pipeline.datatype, cfg.pipeline.date = "flow", "2016-07-08"
+    cfg.validate()
+    table, _ = synth_flow_day(n_events=1500, n_hosts=40, n_anomalies=4,
+                              seed=5)
+    Store(cfg.store.root).write("flow", "2016-07-08", table)
+    assert run_scoring(cfg, engine="gibbs") == 0
+    name = model_name("flow", "2016-07-08")
+    assert name == "flow/20160708"
+    m = ckpt.load_model(cfg.serving.models_dir, name)
+    assert m is not None
+    assert m.arrays["theta"].shape[1] == cfg.lda.n_topics
+    assert m.arrays["phi_wk"].shape[1] == cfg.lda.n_topics
+    import json as _json
+    import pathlib as _pathlib
+    from onix.store import results_path
+    manifest = _json.loads(_pathlib.Path(
+        results_path(cfg.store.results_dir, "flow", "2016-07-08")
+        .with_suffix(".manifest.json")).read_text())
+    assert manifest["model_saved"].endswith("flow/20160708.npz")

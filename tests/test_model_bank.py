@@ -1,0 +1,477 @@
+"""Model-bank edge cases (r12, onix/serving/model_bank.py).
+
+The banked path's contract is BIT-IDENTITY with the single-tenant
+`top_suspicious` scan — including its -1 sentinel semantics through
+the tenant gather — plus residency that can never change a winner.
+Every case here is one of the ways the batched/padded/resident form
+could silently diverge from the scan it replaces.
+"""
+
+import http.client
+import json
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from onix.config import OnixConfig
+from onix.models.scoring import top_suspicious
+from onix.serving.model_bank import (BankRefusal, BankService, ModelBank,
+                                     ScoreRequest, select_bank_form)
+from onix.utils.obs import counters
+
+TOL, M = 1.0, 16
+
+
+@pytest.fixture(autouse=True)
+def _reset_counters():
+    counters.reset("bank")
+    yield
+    counters.reset("bank")
+
+
+def _model(rng, n_docs, n_vocab, k=8):
+    return (rng.dirichlet(np.full(k, 0.5), n_docs).astype(np.float32),
+            rng.dirichlet(np.full(k, 0.5), n_vocab).astype(np.float32))
+
+
+def _req(rng, tenant, n_docs, n_vocab, n, window=None):
+    return ScoreRequest(
+        tenant=tenant,
+        doc_ids=rng.integers(0, n_docs, n).astype(np.int32),
+        word_ids=rng.integers(0, n_vocab, n).astype(np.int32),
+        window=window)
+
+
+def _single_tenant(theta, phi, req, tol=TOL, max_results=M):
+    n = int(req.doc_ids.size)
+    return top_suspicious(jnp.asarray(theta), jnp.asarray(phi),
+                          jnp.asarray(req.doc_ids),
+                          jnp.asarray(req.word_ids),
+                          jnp.ones(n, jnp.float32), tol=tol,
+                          max_results=max_results)
+
+
+def test_bank_of_one_bit_identical_to_single_tenant():
+    """B=1 through the full bank machinery (pad, slot gather, batched
+    kernel) == the single-tenant scan, scores AND indices, both
+    forms."""
+    rng = np.random.default_rng(0)
+    theta, phi = _model(rng, 300, 200)
+    req = _req(rng, "a", 300, 200, 500)
+    ref = _single_tenant(theta, phi, req)
+    for form in ("vmap", "gather"):
+        bank = ModelBank(capacity=1, form=form)
+        bank.add("a", theta, phi)
+        (res,) = bank.score_batch([req], tol=TOL, max_results=M)
+        np.testing.assert_array_equal(res.scores, np.asarray(ref.scores))
+        np.testing.assert_array_equal(res.indices, np.asarray(ref.indices))
+
+
+def test_sentinel_propagates_through_tenant_gather():
+    """A request with fewer than max_results qualifying events keeps
+    the -1 sentinel on unfilled slots — the pad rows of the BANK (and
+    of the request axis) must never leak in as index 0 'events'."""
+    rng = np.random.default_rng(1)
+    theta, phi = _model(rng, 100, 80)
+    bank = ModelBank(capacity=2)
+    bank.add("a", theta, phi)
+    # 3 events, M=16 slots: 13+ must be -1/inf. Tight tol may reject
+    # some of the 3 as well — compare against the oracle exactly.
+    req = _req(rng, "a", 100, 80, 3)
+    ref = _single_tenant(theta, phi, req)
+    (res,) = bank.score_batch([req], tol=TOL, max_results=M)
+    np.testing.assert_array_equal(res.indices, np.asarray(ref.indices))
+    assert (res.indices[3:] == -1).all()
+    assert np.isinf(res.scores[3:]).all()
+    # A -1 slot never carries a finite score (the consumer-gather
+    # guard the sentinel exists for).
+    assert not np.isfinite(res.scores[res.indices == -1]).any()
+
+
+def test_zero_event_tenant_in_mixed_batch():
+    """A tenant with zero events rides a mixed batch: all-sentinel
+    result for it, unperturbed bit-identical results for the others."""
+    rng = np.random.default_rng(2)
+    models = {t: _model(rng, 200, 150) for t in ("a", "b", "c")}
+    bank = ModelBank(capacity=4)
+    for t, (th, ph) in models.items():
+        bank.add(t, th, ph)
+    reqs = [_req(rng, "a", 200, 150, 400),
+            ScoreRequest("b", np.empty(0, np.int32), np.empty(0, np.int32)),
+            _req(rng, "c", 200, 150, 77)]
+    out = bank.score_batch(reqs, tol=TOL, max_results=M)
+    assert (out[1].indices == -1).all() and np.isinf(out[1].scores).all()
+    for i in (0, 2):
+        th, ph = models[reqs[i].tenant]
+        ref = _single_tenant(th, ph, reqs[i])
+        np.testing.assert_array_equal(out[i].scores,
+                                      np.asarray(ref.scores))
+        np.testing.assert_array_equal(out[i].indices,
+                                      np.asarray(ref.indices))
+
+
+def test_forms_bit_identical_mixed_shapes():
+    """vmap and gather agree bit-for-bit across a mixed-size tenant
+    set (two shape classes) and varying request lengths."""
+    rng = np.random.default_rng(3)
+    dims = [(300, 200), (900, 600), (300, 200), (120, 90)]
+    models = {f"t{i}": _model(rng, d, v) for i, (d, v) in enumerate(dims)}
+    reqs = [_req(rng, f"t{i}", d, v, n)
+            for (i, (d, v)), n in zip(enumerate(dims), (64, 1, 700, 130))]
+    results = {}
+    for form in ("vmap", "gather"):
+        bank = ModelBank(capacity=4, form=form)
+        for t, (th, ph) in models.items():
+            bank.add(t, th, ph)
+        results[form] = bank.score_batch(reqs, tol=TOL, max_results=M)
+    for a, b in zip(results["vmap"], results["gather"]):
+        np.testing.assert_array_equal(a.scores, b.scores)
+        np.testing.assert_array_equal(a.indices, b.indices)
+
+
+def test_lru_eviction_readmission_identical_winners():
+    """Capacity 2, four same-class tenants, a stream that forces
+    evict + readmit: winners identical to an uncapped bank, churn
+    actually happened, and eviction never fired mid-batch."""
+    rng = np.random.default_rng(4)
+    models = {f"t{i}": _model(rng, 150, 100) for i in range(4)}
+    stream = [_req(rng, f"t{i % 4}", 150, 100, 200) for i in range(12)]
+
+    def run(capacity):
+        bank = ModelBank(capacity=capacity)
+        for t, (th, ph) in models.items():
+            bank.add(t, th, ph)
+        out = []
+        for lo in range(0, len(stream), 2):   # 2-request batches
+            out.extend(bank.score_batch(stream[lo:lo + 2], tol=TOL,
+                                        max_results=M))
+        return out
+
+    counters.reset("bank")
+    capped = run(2)
+    evicts = counters.get("bank.evict")
+    admits = counters.get("bank.admit")
+    uncapped = run(4)
+    assert evicts > 0, "stream never evicted — the test is vacuous"
+    assert admits > 4, "no tenant was ever readmitted"
+    for a, b in zip(capped, uncapped):
+        np.testing.assert_array_equal(a.scores, b.scores)
+        np.testing.assert_array_equal(a.indices, b.indices)
+
+
+def test_batch_over_capacity_splits_into_waves():
+    """One batch naming more distinct tenants than capacity splits
+    into multiple waves (more dispatches) instead of refusing — and
+    winners still match the oracle."""
+    rng = np.random.default_rng(5)
+    models = {f"t{i}": _model(rng, 150, 100) for i in range(5)}
+    bank = ModelBank(capacity=2)
+    for t, (th, ph) in models.items():
+        bank.add(t, th, ph)
+    reqs = [_req(rng, f"t{i}", 150, 100, 120) for i in range(5)]
+    out = bank.score_batch(reqs, tol=TOL, max_results=M)
+    assert bank.dispatches == 3         # ceil(5 distinct / 2) waves
+    for req, res in zip(reqs, out):
+        th, ph = models[req.tenant]
+        ref = _single_tenant(th, ph, req)
+        np.testing.assert_array_equal(res.indices, np.asarray(ref.indices))
+
+
+def test_bulk_admission_is_one_device_put_per_family():
+    """Admitting many tenants at one request boundary ships exactly
+    ONE H2D transfer per table family (the stacked device_put), not
+    one per tenant."""
+    rng = np.random.default_rng(6)
+    bank = ModelBank(capacity=8)
+    reqs = []
+    for i in range(6):
+        th, ph = _model(rng, 150, 100)
+        bank.add(f"t{i}", th, ph)
+        reqs.append(_req(rng, f"t{i}", 150, 100, 50))
+    counters.reset("bank")
+    bank.score_batch(reqs, tol=TOL, max_results=M)
+    assert counters.get("bank.admit") == 6
+    assert counters.get("bank.h2d_transfers") == 2   # theta + phi
+    assert counters.get("bank.h2d_bytes") > 0
+    assert counters.get("bank.dispatch") == 1
+
+
+def test_refusals():
+    """Unknown tenant and out-of-range token ids are refused BEFORE
+    any device work — out-of-range ids would gather padding rows
+    (score 0: a fabricated winner)."""
+    rng = np.random.default_rng(7)
+    th, ph = _model(rng, 100, 80)
+    bank = ModelBank(capacity=2)
+    bank.add("a", th, ph)
+    with pytest.raises(BankRefusal, match="unknown tenant"):
+        bank.score_batch([_req(rng, "nope", 100, 80, 10)], tol=TOL,
+                         max_results=M)
+    bad = _req(rng, "a", 100, 80, 10)
+    bad.word_ids[3] = 80                # == n_vocab: one past the end
+    with pytest.raises(BankRefusal, match="out of range"):
+        bank.score_batch([bad], tol=TOL, max_results=M)
+    assert bank.dispatches == 0
+
+
+def test_select_bank_form_priority(monkeypatch):
+    """Gate priority: env override > explicit form > measured table >
+    vmap default on unmeasured backends."""
+    monkeypatch.setenv("ONIX_BANK_FORM", "vmap")
+    assert select_bank_form("gather", 64, 4096, backend="cpu") == "vmap"
+    monkeypatch.delenv("ONIX_BANK_FORM")
+    assert select_bank_form("gather", 1, 1, backend="cpu") == "gather"
+    # cpu is measured (gather at every dispatch size); an unmeasured
+    # backend keeps the vmap default.
+    assert select_bank_form("auto", 64, 4096, backend="cpu") == "gather"
+    assert select_bank_form("auto", 64, 4096, backend="quantum") == "vmap"
+    with pytest.raises(ValueError):
+        select_bank_form("sideways", 1, 1, backend="cpu")
+
+
+def test_service_winner_cache():
+    """Second replay of the same (tenant, window) pairs is all cache
+    hits with identical winners; a changed event count on a cached
+    window is a CONFLICT (scored fresh), never served stale."""
+    rng = np.random.default_rng(8)
+    th, ph = _model(rng, 200, 150)
+    bank = ModelBank(capacity=2)
+    bank.add("a", th, ph)
+    svc = BankService(bank, max_batch_requests=4)
+    reqs = [_req(rng, "a", 200, 150, 100, window=f"w{i}") for i in range(3)]
+    first = svc.score(reqs, tol=TOL, max_results=M)
+    assert not any(r.cached for r in first)
+    again = svc.score(reqs, tol=TOL, max_results=M)
+    assert all(r.cached for r in again)
+    for a, b in zip(first, again):
+        np.testing.assert_array_equal(a.topk.scores, b.topk.scores)
+    disp_before = bank.dispatches
+    changed = ScoreRequest("a", reqs[0].doc_ids[:50], reqs[0].word_ids[:50],
+                           window="w0")
+    (res,) = svc.score([changed], tol=TOL, max_results=M)
+    assert not res.cached
+    assert bank.dispatches == disp_before + 1
+    assert counters.get("bank.cache_conflict") == 1
+
+
+def _score_server(tmp_path, **serving_kw):
+    from onix.checkpoint import save_model
+    from onix.oa.serve import serve_background
+
+    cfg = OnixConfig()
+    cfg.store.root = str(tmp_path / "store")
+    for k, v in serving_kw.items():
+        setattr(cfg.serving, k, v)
+    cfg.validate()
+    rng = np.random.default_rng(9)
+    th, ph = _model(rng, 120, 90)
+    save_model(cfg.serving.models_dir, "flow/20160708", th, ph)
+    server, port = serve_background(cfg)
+    return cfg, (th, ph), server, port
+
+
+def _post_json(port, path, obj):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    conn.request("POST", path, body=json.dumps(obj),
+                 headers={"Content-Type": "application/json"})
+    r = conn.getresponse()
+    return r.status, json.loads(r.read() or b"{}")
+
+
+def test_score_endpoint_end_to_end(tmp_path):
+    """/score over HTTP: winners match the single-tenant oracle, the
+    repeat is served from the winner cache, unknown tenants and
+    traversal-shaped names 404, and /bank/stats reports the counters."""
+    cfg, (th, ph), server, port = _score_server(tmp_path)
+    try:
+        rng = np.random.default_rng(10)
+        d = rng.integers(0, 120, 200).astype(np.int32)
+        w = rng.integers(0, 90, 200).astype(np.int32)
+        body = {"requests": [{"tenant": "flow/20160708", "window": "d0",
+                              "doc_ids": d.tolist(),
+                              "word_ids": w.tolist()}],
+                "tol": TOL, "max_results": M}
+        status, out = _post_json(port, "/score", body)
+        assert status == 200 and out["ok"]
+        res = out["results"][0]
+        assert res["cached"] is False
+        ref = _single_tenant(th, ph, ScoreRequest("x", d, w))
+        np.testing.assert_array_equal(np.asarray(res["indices"], np.int32),
+                                      np.asarray(ref.indices))
+        np.testing.assert_allclose(
+            np.asarray(res["scores"], np.float32)[np.asarray(
+                res["indices"]) >= 0],
+            np.asarray(ref.scores)[np.asarray(ref.indices) >= 0])
+        status, out2 = _post_json(port, "/score", body)
+        assert status == 200 and out2["results"][0]["cached"] is True
+        # refusals: unknown tenant, path traversal
+        for tenant in ("flow/29991231", "../../etc/passwd"):
+            status, out3 = _post_json(port, "/score", {
+                "requests": [{"tenant": tenant, "doc_ids": [0],
+                              "word_ids": [0]}]})
+            assert status == 404, tenant
+        # malformed body is a 400, not a dropped connection
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        conn.request("POST", "/score", body="{not json",
+                     headers={"Content-Type": "application/json"})
+        assert conn.getresponse().status == 400
+        # stats endpoint sees the traffic
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        conn.request("GET", "/bank/stats")
+        r = conn.getresponse()
+        stats = json.loads(r.read())
+        assert r.status == 200
+        assert stats["models_on_disk"] == 1
+        assert stats["dispatches"] >= 1
+        assert stats["cache"]["hits"] >= 1
+    finally:
+        server.server_close()
+
+
+def test_score_endpoint_rejects_cross_site(tmp_path):
+    """The /score POST shares /feedback's CSRF ladder."""
+    cfg, _, server, port = _score_server(tmp_path)
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        conn.request("POST", "/score", body="{}",
+                     headers={"Content-Type": "application/json",
+                              "Origin": "http://evil.example"})
+        assert conn.getresponse().status == 403
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        conn.request("POST", "/score", body="tenant=x",
+                     headers={"Content-Type":
+                              "application/x-www-form-urlencoded"})
+        assert conn.getresponse().status == 415
+    finally:
+        server.server_close()
+
+
+def test_cache_keyed_by_tol_and_max_results():
+    """A cached (tenant, window) must NOT serve a request at a
+    different tol or max_results — those change the winner set, so
+    they join the cache key."""
+    rng = np.random.default_rng(11)
+    th, ph = _model(rng, 200, 150)
+    bank = ModelBank(capacity=2)
+    bank.add("a", th, ph)
+    svc = BankService(bank)
+    req = _req(rng, "a", 200, 150, 100, window="w0")
+    (r1,) = svc.score([req], tol=TOL, max_results=M)
+    assert not r1.cached
+    # Different max_results: fresh, and sized to the new ask.
+    (r2,) = svc.score([req], tol=TOL, max_results=M // 2)
+    assert not r2.cached
+    assert r2.topk.scores.shape == (M // 2,)
+    np.testing.assert_array_equal(
+        r2.topk.indices,
+        _single_tenant(th, ph, req, max_results=M // 2).indices)
+    # Different tol: fresh, matches the oracle at that tol.
+    (r3,) = svc.score([req], tol=0.5 * TOL, max_results=M)
+    assert not r3.cached
+    np.testing.assert_array_equal(
+        r3.topk.indices,
+        _single_tenant(th, ph, req, tol=0.5 * TOL).indices)
+    # Each parameterization now hits its own entry.
+    for kw in (dict(tol=TOL, max_results=M),
+               dict(tol=TOL, max_results=M // 2),
+               dict(tol=0.5 * TOL, max_results=M)):
+        (r,) = svc.score([req], **kw)
+        assert r.cached, kw
+
+
+def test_bulk_loader_fetches_batch_misses_in_one_call():
+    """score_batch collects a batch's unknown tenants and fetches them
+    through ONE bulk_loader call (checkpoint.load_models shape), not
+    per-tenant loader round-trips."""
+    rng = np.random.default_rng(12)
+    models = {t: _model(rng, 100, 80) for t in ("a", "b", "c")}
+    calls = []
+
+    def bulk(names):
+        calls.append(list(names))
+        from onix.serving.model_bank import TenantModel
+        return {n: TenantModel(*models[n]) for n in names if n in models}
+
+    bank = ModelBank(capacity=4, bulk_loader=bulk)
+    reqs = [_req(rng, t, 100, 80, 40) for t in ("a", "b", "a", "c")]
+    out = bank.score_batch(reqs, tol=TOL, max_results=M)
+    assert calls == [["a", "b", "c"]]
+    for req, got in zip(reqs, out):
+        ref = _single_tenant(*models[req.tenant], req)
+        np.testing.assert_array_equal(got.indices, ref.indices)
+    # Known tenants don't re-fetch; a genuinely unknown one refuses.
+    bank.score_batch(reqs[:1], tol=TOL, max_results=M)
+    assert len(calls) == 1
+    with pytest.raises(BankRefusal, match="unknown tenant"):
+        bank.score_batch([_req(rng, "nope", 100, 80, 4)], tol=TOL,
+                         max_results=M)
+
+
+def test_host_registry_trim_and_reload():
+    """host_capacity bounds the loader-backed HOST registry: the LRU
+    re-fetchable tenant that is no longer device-resident is dropped
+    (bank.host_evict) and transparently reloads on next reference,
+    with identical winners throughout."""
+    rng = np.random.default_rng(13)
+    models = {t: _model(rng, 100, 80) for t in ("a", "b")}
+    loads = []
+
+    def loader(tenant):
+        from onix.serving.model_bank import TenantModel
+        loads.append(tenant)
+        m = models.get(tenant)
+        return None if m is None else TenantModel(*m)
+
+    bank = ModelBank(capacity=1, loader=loader, host_capacity=1)
+    req_a = _req(rng, "a", 100, 80, 40)
+    req_b = _req(rng, "b", 100, 80, 40)
+    bank.score_batch([req_a], tol=TOL, max_results=M)
+    # b's admission evicts a from the device; the host trim then drops
+    # a's (now non-resident, re-fetchable) host copy.
+    bank.score_batch([req_b], tol=TOL, max_results=M)
+    assert counters.get("bank.host_evict") == 1
+    assert bank.tenants() == ["b"]
+    (got,) = bank.score_batch([req_a], tol=TOL, max_results=M)
+    assert loads.count("a") == 2        # reloaded after the trim
+    np.testing.assert_array_equal(
+        got.indices, _single_tenant(*models["a"], req_a).indices)
+    # Explicitly add()ed models are never host-evicted.
+    bank2 = ModelBank(capacity=1, loader=loader, host_capacity=1)
+    bank2.add("pinned", *models["a"])
+    bank2.score_batch([req_b], tol=TOL, max_results=M)
+    assert "pinned" in bank2.tenants()
+
+
+def test_score_endpoint_unfilled_slots_serialize_as_null(tmp_path):
+    """Unfilled TopK slots carry +inf device-side; the JSON response
+    must spell them null (RFC 8259 has no Infinity token — a browser's
+    JSON.parse would throw on the whole payload)."""
+    cfg, (th, ph), server, port = _score_server(tmp_path)
+    try:
+        # 3 events, max_results 16: at least 13 unfilled (-1) slots.
+        body = {"requests": [{"tenant": "flow/20160708",
+                              "doc_ids": [0, 1, 2],
+                              "word_ids": [0, 1, 2]}],
+                "tol": TOL, "max_results": M}
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        conn.request("POST", "/score", body=json.dumps(body),
+                     headers={"Content-Type": "application/json"})
+        r = conn.getresponse()
+        raw = r.read().decode()
+        assert r.status == 200
+
+        def _no_constants(name):
+            raise AssertionError(f"non-RFC8259 token in /score: {name}")
+
+        out = json.loads(raw, parse_constant=_no_constants)
+        res = out["results"][0]
+        assert any(i == -1 for i in res["indices"])
+        for score, idx in zip(res["scores"], res["indices"]):
+            if idx == -1:
+                assert score is None
+            else:
+                assert isinstance(score, float)
+    finally:
+        server.server_close()
