@@ -754,6 +754,102 @@ def bench_model_bank(jax, jnp, small=False):
     }
 
 
+def bench_feedback_rescore(jax, jnp, small=False):
+    """feedback_rescore: the r13 noise filter's fused post-score
+    adjustment — the filtered flow pair scan
+    (feedback.rescore.table_pair_bottom_k_filtered) vs the unfiltered
+    `table_pair_bottom_k` over the SAME Zipf event stream, so the
+    filter's overhead on the judged selection path is a tracked number
+    every run. Two proofs ride along, asserted per run:
+
+      * empty-filter bit-identity — the filtered scan under a filter
+        of zero entries returns scores AND indices bit-identical to
+        the unfiltered scan (the filter.py exactness contract);
+      * exact winner delta — with a filter suppressing half the
+        unfiltered winners' (src, dst) pairs, the winners REMOVED are
+        exactly the unfiltered winners whose pair is suppressed (no
+        survivor, no collateral), and no suppressed pair appears in
+        the filtered set.
+    """
+    from onix.feedback.filter import HostFilter, pack_pair, split_key
+    from onix.feedback.rescore import table_pair_bottom_k_filtered
+    from onix.models.scoring import score_table, table_pair_bottom_k
+
+    n_docs, n_vocab, k = (20_000, 256, 20) if small else (100_000, 512, 20)
+    n_events = 1 << 21 if small else 1 << 23
+    max_results = 1000
+
+    rng = np.random.default_rng(3)
+    theta = _dirichlet(rng, k, n_docs)
+    phi_wk = _dirichlet(rng, k, n_vocab)
+    table = score_table(jnp.asarray(theta), jnp.asarray(phi_wk)).ravel()
+    d_src = rng.integers(0, n_docs, n_events).astype(np.int32)
+    d_dst = rng.integers(0, n_docs, n_events).astype(np.int32)
+    w = rng.integers(0, n_vocab, n_events).astype(np.int32)
+    isrc = jnp.asarray(d_src * n_vocab + w)
+    idst = jnp.asarray(d_dst * n_vocab + w)
+    pair = pack_pair(d_src.astype(np.uint32), d_dst.astype(np.uint32))
+    phi_h, plo_h = split_key(pair)
+    wd = jnp.asarray(w)
+    ph_d, pl_d = jnp.asarray(phi_h), jnp.asarray(plo_h)
+
+    def timed(fn):
+        np.asarray(fn().scores)         # compile + settle
+        best = float("inf")
+        for _ in range(2):
+            t0 = time.perf_counter()
+            out = fn()
+            np.asarray(out.scores)      # forces completion
+            best = min(best, time.perf_counter() - t0)
+        return out, best
+
+    ref, dt_ref = timed(lambda: table_pair_bottom_k(
+        table, isrc, idst, tol=1.0, max_results=max_results))
+
+    empty = HostFilter.empty().tables()
+    f0, dt_empty = timed(lambda: table_pair_bottom_k_filtered(
+        table, isrc, idst, wd, ph_d, pl_d, empty,
+        tol=1.0, max_results=max_results))
+    identical = (bool(np.array_equal(np.asarray(ref.scores),
+                                     np.asarray(f0.scores)))
+                 and bool(np.array_equal(np.asarray(ref.indices),
+                                         np.asarray(f0.indices))))
+    assert identical, "empty-filter scan diverged from the unfiltered scan"
+
+    # Suppress every other unfiltered winner's (src, dst) pair — the
+    # analyst dismissing half the day's findings.
+    win = np.asarray(ref.indices)
+    win = win[win >= 0]
+    filt = HostFilter.empty().merged(pair_suppress=pair[win[::2]])
+    tabs = filt.tables()
+    f1, dt_filt = timed(lambda: table_pair_bottom_k_filtered(
+        table, isrc, idst, wd, ph_d, pl_d, tabs,
+        tol=1.0, max_results=max_results))
+    fidx = np.asarray(f1.indices)
+    fidx = set(fidx[fidx >= 0].tolist())
+    suppressed = set(np.flatnonzero(
+        HostFilter.member(pair, filt.pair_suppress)).tolist())
+    removed = set(win.tolist()) - fidx
+    delta_exact = (removed == (set(win.tolist()) & suppressed)
+                   and not (fidx & suppressed))
+    assert delta_exact, "winner delta is not exactly the suppressed set"
+
+    return {
+        "events_per_sec_filtered": round(n_events / dt_filt, 1),
+        "events_per_sec_unfiltered": round(n_events / dt_ref, 1),
+        "events_per_sec_empty_filter": round(n_events / dt_empty, 1),
+        "filter_overhead_frac": round(dt_filt / dt_ref - 1.0, 4),
+        "empty_filter_bit_identical": identical,
+        "winner_delta_exactly_suppressed_set": delta_exact,
+        "n_suppressed_keys": int(len(filt.pair_suppress)),
+        "n_winners_removed": len(removed),
+        "n_events": n_events, "n_docs": n_docs, "n_vocab": n_vocab,
+        "n_topics": k, "max_results": max_results,
+        "wall_seconds": round(dt_filt, 3),
+        "wall_seconds_unfiltered": round(dt_ref, 3),
+    }
+
+
 def _roofline_detail(detail: dict) -> dict | None:
     """detail.roofline: achieved bytes/s + fraction-of-peak for the two
     judged hot loops, from each component's modeled per-item traffic
@@ -1162,6 +1258,12 @@ def _measure() -> None:
     # the serving tentpole's N→1 dispatch collapse as a tracked
     # number every run (docs/PERF.md "model bank").
     run("model_bank", lambda: bench_model_bank(jax, jnp, small=fallback))
+    # The r13 noise filter: filtered vs unfiltered pair scan, with the
+    # empty-filter bit-identity and exact-winner-delta proofs asserted
+    # every run (docs/ROBUSTNESS.md "feedback loop"; TPU crossover row
+    # queued in docs/TPU_QUEUE.json `feedback_rescore_tpu`).
+    run("feedback_rescore",
+        lambda: bench_feedback_rescore(jax, jnp, small=fallback))
     # Roofline accounting over whatever components completed — bytes/s
     # and fraction-of-peak become tracked numbers (docs/PERF.md), so a
     # throughput regression is a falling fraction, not a prose claim.

@@ -171,9 +171,16 @@ def model_path(models_dir: str | pathlib.Path, name: str) -> pathlib.Path:
 
 
 def save_model(models_dir: str | pathlib.Path, name: str,
-               theta, arrays_phi_wk, meta: dict | None = None) -> pathlib.Path:
+               theta, arrays_phi_wk, meta: dict | None = None,
+               epoch: int = 0) -> pathlib.Path:
     """Atomically persist one tenant's fitted tables (npz + sha256'd
-    json meta, the checkpoint discipline)."""
+    json meta, the checkpoint discipline).
+
+    `epoch` is the MODEL EPOCH (meta key `model_epoch`): 0 for a fresh
+    fit, bumped by every online feedback update
+    (feedback.online.OnlineUpdater.nudge_and_save). The serving bank
+    keys its winner cache on it, so a consumer that re-banks the file
+    can never serve winners computed under an older epoch."""
     npz_path = model_path(models_dir, name)
     npz_path.parent.mkdir(parents=True, exist_ok=True)
     theta = np.asarray(theta, np.float32)
@@ -188,6 +195,7 @@ def save_model(models_dir: str | pathlib.Path, name: str,
     meta = dict(meta or {}, name=name,
                 n_docs=int(theta.shape[-2]), n_vocab=int(phi_wk.shape[-2]),
                 n_topics=int(theta.shape[-1]),
+                model_epoch=int(epoch),
                 npz_sha256=h.hexdigest(), model_format=1)
     # Stage BOTH tmp files before either final rename, so the
     # npz/json-mismatch window on a re-save is just the two adjacent
@@ -233,6 +241,23 @@ def load_model(models_dir: str | pathlib.Path, name: str) -> Checkpoint | None:
     with np.load(npz_path) as z:
         arrays = {k: z[k] for k in z.files}
     return Checkpoint(arrays=arrays, meta=meta)
+
+
+def model_meta_epoch(models_dir: str | pathlib.Path,
+                     name: str) -> int | None:
+    """The persisted `model_epoch` of a stored model, or None when no
+    model (complete meta) exists — WITHOUT hashing the npz. Writers
+    re-saving a tenant (a re-fit, an online nudge) read this to bump
+    past it: the serving winner cache keys on the epoch, so a re-save
+    that kept the old epoch could serve winners computed under the
+    previous tables forever."""
+    json_path = model_path(models_dir, name).with_suffix(".json")
+    if not json_path.exists():
+        return None
+    try:
+        return int(json.loads(json_path.read_text()).get("model_epoch", 0))
+    except (json.JSONDecodeError, OSError, ValueError):
+        return None
 
 
 def load_models(models_dir: str | pathlib.Path,

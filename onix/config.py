@@ -365,6 +365,62 @@ class ServingConfig:
 
 
 @dataclass
+class FeedbackConfig:
+    """The analyst feedback loop (r13, `onix/feedback/`): how captured
+    verdicts turn into model behavior on two timescales — the immediate
+    noise-filter rescoring (suppress/boost applied inside the scoring
+    scans and the model bank) and the incremental online λ/φ update
+    that rides the SVI machinery on feedback-weighted minibatches
+    (PAPER.md §L5's noise filter + the Streaming-Gibbs/SCVB0 update
+    family, arxiv 1601.01142 / 1305.2452)."""
+
+    # Immediate rescoring on/off: the DEFAULT install gate — when
+    # False, apply_feedback and the serve-side compile install no
+    # filter unless the caller explicitly overrides (the
+    # online-update-only configuration the replay harness's ≤5-batch
+    # arm measures). An installed filter is always applied.
+    filter_enabled: bool = True
+    # Score multiplier for BOOSTED (analyst-confirmed threat) events in
+    # the filtered scans: < 1 pushes a confirmed event further down the
+    # ascending-suspicious order so it keeps surfacing. 1.0 disables
+    # boosting while keeping suppression.
+    boost_scale: float = 0.25
+    # Token weight of a DISMISSED (benign) row in the online-update
+    # minibatch — the streaming analog of the reference's ×DUPFACTOR
+    # corpus duplication: weight-w feedback tokens update λ exactly as
+    # w identical observed tokens would, raising p(word|doc) until the
+    # dismissed traffic stops scoring suspicious. 0 disables the online
+    # update (immediate filter only).
+    dismiss_weight: float = 1000.0
+    # Token weight of a CONFIRMED (threat) row in the online-update
+    # minibatch. Default 0: confirmations must NOT add mass (that would
+    # teach the model the attack pattern is common — the exact failure
+    # load_feedback guards against); they act through the boost filter.
+    confirm_weight: float = 0.0
+    # SVI steps per feedback application (each step replays the
+    # feedback-weighted minibatch once through svi_step).
+    online_steps: int = 1
+    # λ pseudo-count strength when nudging a fitted batch (θ, φ) model
+    # (OnlineUpdater): λ0 = eta + prior_strength·φ, so the nudge moves
+    # a posterior with this much prior mass, not a fresh model.
+    prior_strength: float = 10000.0
+    # θ pseudo-count strength for the nudged model's document rows:
+    # new θ_d ∝ theta_strength·θ_d + (γ_d − α) after the weighted
+    # E-step.
+    theta_strength: float = 100.0
+
+    def validate(self) -> None:
+        if not (0.0 < self.boost_scale <= 1.0):
+            raise ValueError("feedback.boost_scale must be in (0, 1]")
+        if self.dismiss_weight < 0 or self.confirm_weight < 0:
+            raise ValueError("feedback weights must be >= 0")
+        if self.online_steps < 1:
+            raise ValueError("feedback.online_steps must be >= 1")
+        if self.prior_strength <= 0 or self.theta_strength <= 0:
+            raise ValueError("feedback strengths must be > 0")
+
+
+@dataclass
 class OAConfig:
     """Operational Analytics (SURVEY.md §2.1 #12-#13): enrichment inputs
     and the per-date UI data directory the dashboards read."""
@@ -390,12 +446,14 @@ class OnixConfig:
     store: StoreConfig = field(default_factory=StoreConfig)
     oa: OAConfig = field(default_factory=OAConfig)
     serving: ServingConfig = field(default_factory=ServingConfig)
+    feedback: FeedbackConfig = field(default_factory=FeedbackConfig)
 
     def validate(self) -> "OnixConfig":
         self.lda.validate()
         self.mesh.validate()
         self.pipeline.validate()
         self.serving.validate()
+        self.feedback.validate()
         root = pathlib.Path(self.store.root)
         for attr, sub in (("feedback_dir", "feedback"),
                           ("results_dir", "results"),
@@ -473,6 +531,7 @@ _NESTED = {
     (OnixConfig, "store"): StoreConfig,
     (OnixConfig, "oa"): OAConfig,
     (OnixConfig, "serving"): ServingConfig,
+    (OnixConfig, "feedback"): FeedbackConfig,
 }
 
 

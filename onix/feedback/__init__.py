@@ -1,0 +1,29 @@
+"""Analyst feedback loop — verdicts become model behavior (r13).
+
+In the reference the OA layer's whole point is this loop: analysts
+label suspicious connects, a noise filter suppresses dismissed
+traffic, and the next run's model learns from the labels
+(PAPER.md §L5 "analyst UI, heuristics, noise filter, feedback
+capture"; reference README.md:48 ×DUPFACTOR). `oa/feedback.py` is the
+WRITE side (labels → CSV); this package is the READ side, on two
+timescales:
+
+* `filter` / `rescore` — **immediate rescoring**: the feedback log
+  compiles into a per-(datatype, date, tenant) noise filter —
+  suppressed/boosted word ids and pair keys as device arrays —
+  applied as a fused post-score adjustment inside the existing
+  bottom-k scan machinery (`scoring._scan_bottom_k`), the model-bank
+  batched kernels, and the streaming winner selection. Dismissed
+  winners drop out of `/score` and the streaming alert set on the
+  very next request, without refitting.
+* `online` — **incremental model updates**: feedback-weighted
+  minibatches replayed through the existing SVI machinery
+  (`lda_svi.svi_step` — the same weighted-mask path the deduped
+  streaming E-step rides) nudge λ/φ without a cold refit, persisted
+  via `checkpoint.save_model` under a bumped model epoch.
+"""
+
+from onix.feedback.filter import (FilterTables, HostFilter,  # noqa: F401
+                                  apply_filter, compile_feedback,
+                                  filter_from_csv, pack_pair)
+from onix.feedback.online import OnlineUpdater  # noqa: F401

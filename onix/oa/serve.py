@@ -251,13 +251,50 @@ class OAHandler(SimpleHTTPRequestHandler):
             self.send_response(400)
             payload = json.dumps({"error": str(e)}).encode()
         else:
+            # Close the loop LIVE (r13): recompile the tenant's noise
+            # filter from the updated CSV and install it on an already-
+            # running bank — set_filter bumps the model epoch, so every
+            # cached winner set for this tenant is invalidated and the
+            # very next /score re-scores under the filter. A server
+            # with no bank yet loads the filter lazily on first score
+            # (filter_loader below); either way dismissed winners never
+            # outlive this POST.
+            epoch = self._apply_feedback_filter(
+                body["datatype"], body["date"], out)
             self.send_response(200)
             payload = json.dumps({"ok": True, "n": len(rows),
-                                  "path": str(out)}).encode()
+                                  "path": str(out),
+                                  "model_epoch": epoch}).encode()
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(payload)))
         self.end_headers()
         self.wfile.write(payload)
+
+    def _apply_feedback_filter(self, datatype: str, date: str,
+                               csv_path) -> int | None:
+        """Install the recompiled filter on the live bank service (if
+        one exists); returns the tenant's new model epoch, or None when
+        no bank is running yet."""
+        if not self.cfg.feedback.filter_enabled:
+            return None      # online-update-only configuration
+        service = self.server.peek_bank_service()
+        if service is None:
+            return None
+        from onix.feedback.filter import filter_from_csv
+        from onix.store import model_name
+        base = model_name(datatype, date)
+        with self.server.bank_lock:
+            # Compile INSIDE the lock: an install always reflects the
+            # CSV's state at install time and installs are serialized,
+            # so two racing /feedback POSTs can never leave an older
+            # snapshot as the live filter (the last installer has read
+            # a CSV containing every append that preceded it).
+            # apply_feedback_filter also reaches sub-tenants (which
+            # share the per-(datatype, date) CSV) and drops cache
+            # entries epochs cannot reach.
+            filt = filter_from_csv(csv_path,
+                                   self.cfg.feedback.boost_scale)
+            return service.apply_feedback_filter(base, filt)
 
 
     # -- model-bank scoring (r12, onix/serving/) --------------------------
@@ -514,6 +551,12 @@ class OAServer(ThreadingHTTPServer):
         self.bank_lock = threading.Lock()
         self._bank_service = None
 
+    def peek_bank_service(self):
+        """The bank service if one has been created — the /feedback
+        handler must never instantiate jax + the bank just to record a
+        label on a dashboards-only server."""
+        return self._bank_service
+
     def bank_service(self, cfg: OnixConfig):
         """The per-server BankService, created on first /score — jax
         and the bank arrays never load for a dashboards-only server.
@@ -532,8 +575,9 @@ class OAServer(ThreadingHTTPServer):
                             f"model {name!r} is multi-chain "
                             f"({m.arrays['theta'].shape}); combine "
                             "chains upstream before banking")
-                    return TenantModel(m.arrays["theta"],
-                                       m.arrays["phi_wk"])
+                    return TenantModel(
+                        m.arrays["theta"], m.arrays["phi_wk"],
+                        epoch=int(m.meta.get("model_epoch", 0)))
 
                 def bulk_loader(names: list[str]) -> dict[str, TenantModel]:
                     # ONE host-side pass over the misses
@@ -549,10 +593,46 @@ class OAServer(ThreadingHTTPServer):
                 def loader(tenant: str) -> TenantModel | None:
                     return bulk_loader([tenant]).get(tenant)
 
+                def filter_loader(tenant: str):
+                    # Tenant names are store.model_name keys
+                    # (<datatype>/<yyyymmdd>[/<sub>]): the persisted
+                    # feedback CSV for that (datatype, date) compiles
+                    # into the tenant's noise filter on first load —
+                    # a restarted server keeps suppressing what the
+                    # analyst already dismissed.
+                    if not cfg.feedback.filter_enabled:
+                        return None
+                    from onix.feedback.filter import filter_from_csv
+                    from onix.store import feedback_path
+                    parts = tenant.split("/")
+                    if len(parts) < 2:
+                        return None
+                    try:
+                        path = feedback_path(cfg.store.feedback_dir,
+                                             parts[0], parts[1])
+                    except ValueError:
+                        return None
+                    return filter_from_csv(path,
+                                           cfg.feedback.boost_scale)
+
+                def epoch_loader(tenant: str):
+                    # One small json read: lets a live server adopt a
+                    # re-save (re-fit, online nudge) from ANOTHER
+                    # process — the epoch moves and the old tables
+                    # drop before any cached winner can be served.
+                    from onix.checkpoint import model_meta_epoch
+                    try:
+                        return model_meta_epoch(cfg.serving.models_dir,
+                                                tenant)
+                    except ValueError:      # traversal-shaped name
+                        return None
+
                 bank = ModelBank(capacity=cfg.serving.bank_capacity,
                                  form=cfg.serving.bank_form,
                                  loader=loader, bulk_loader=bulk_loader,
-                                 host_capacity=cfg.serving.host_model_cache)
+                                 host_capacity=cfg.serving.host_model_cache,
+                                 filter_loader=filter_loader,
+                                 epoch_loader=epoch_loader)
                 self._bank_service = BankService(
                     bank,
                     max_batch_requests=cfg.serving.max_batch_requests,

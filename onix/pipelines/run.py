@@ -218,12 +218,19 @@ def run_scoring(cfg: OnixConfig, engine: str = "gibbs",
     # checkpoint.save_model).
     model_saved = None
     if cfg.serving.save_fitted:
-        from onix.checkpoint import save_model
+        from onix.checkpoint import model_meta_epoch, save_model
         from onix.store import model_name
+        name = model_name(datatype, date)
+        # A RE-fit bumps past the stored epoch (which an online nudge
+        # may have raised): the serving winner cache keys on it, and a
+        # re-save that reset the epoch to 0 would let a bank that
+        # reloads this file keep serving pre-refit cached winners.
+        prev = model_meta_epoch(cfg.serving.models_dir, name)
         model_saved = str(save_model(
-            cfg.serving.models_dir, model_name(datatype, date),
+            cfg.serving.models_dir, name,
             fit["theta"], fit["phi_wk"],
-            meta={"engine": engine, "config_hash": cfg.config_hash}))
+            meta={"engine": engine, "config_hash": cfg.config_hash},
+            epoch=0 if prev is None else prev + 1))
         log.emit("model_saved", path=model_saved)
 
     # Score REAL tokens only (feedback duplicates are training-only).

@@ -253,6 +253,12 @@ class _Prep:
     n_batch_docs: int
     docs_before: int
     n_docs_after: int
+    # Noise-filter key streams (r13, onix/feedback/): the per-token
+    # bucket ids and the per-EVENT packed pair key — (sip, dip) for
+    # flow, (client, bucket) for dns/proxy — None on the string-keyed
+    # doc path (no stable 32-bit identities to pack).
+    wid_tok: np.ndarray | None = None
+    ev_pair: np.ndarray | None = None
 
 
 @dataclasses.dataclass
@@ -354,6 +360,12 @@ class StreamingScorer:
         # seconds, and the thread-vs-process calibration that picked
         # the mode.
         self.prefetch_stats: dict = {}
+        # r13 analyst feedback: the compiled noise filter (None until
+        # the first apply_feedback; persists through checkpoints) and
+        # the application tally the replay harness reports.
+        self.noise_filter = None
+        self.feedback_stats = {"applied": 0, "suppress_keys": 0,
+                               "boost_keys": 0, "online_steps": 0}
         self._batch_no = 0
         self.checkpoint_dir = (pathlib.Path(checkpoint_dir)
                                if checkpoint_dir else None)
@@ -413,16 +425,29 @@ class StreamingScorer:
         u32_mode = isinstance(self.docs, U32DocTable)
         doc_keys = (self.docs.keys if u32_mode else np.char.encode(
             np.asarray(self.docs.keys, dtype=str), "utf-8"))
+        # The noise filter rides the checkpoint (empty arrays when no
+        # feedback was ever applied): a resumed stream must keep
+        # suppressing what the analyst already dismissed. Keys are raw
+        # u32-pair/bucket identities, so they survive doc-table
+        # eviction/compaction unchanged.
+        f = self.noise_filter
+        e64 = np.empty(0, np.uint64)
         ckpt.save(
             self.checkpoint_dir / self._fingerprint(), self._batch_no,
             {"lam": np.asarray(self.state.lam),
              "step": np.asarray(self.state.step),
              "gamma": self._gamma[:n],
              "doc_keys": doc_keys,
-             "last_seen": self._last_seen[:n]},
+             "last_seen": self._last_seen[:n],
+             "fb_word_sup": f.word_suppress if f else e64,
+             "fb_word_boost": f.word_boost if f else e64,
+             "fb_pair_sup": f.pair_suppress if f else e64,
+             "fb_pair_boost": f.pair_boost if f else e64},
             {"fingerprint": self._fingerprint(), "engine": "streaming",
              "datatype": self.datatype, "doc_key_mode":
                  "u32" if u32_mode else "str",
+             "fb_boost_scale": (f.boost_scale if f
+                                else self.cfg.feedback.boost_scale),
              "edges": edges})
 
     def _restore_latest(self) -> bool:
@@ -453,6 +478,17 @@ class StreamingScorer:
                            and isinstance(v[0], str) else np.asarray(v))
                        for k, v in edges.items()}
                       if edges is not None else None)
+        # Noise filter (absent in pre-r13 checkpoints: stays None).
+        if "fb_word_sup" in saved.arrays:
+            from onix.feedback.filter import HostFilter
+            f = HostFilter(
+                np.asarray(saved.arrays["fb_word_sup"], np.uint64),
+                np.asarray(saved.arrays["fb_word_boost"], np.uint64),
+                np.asarray(saved.arrays["fb_pair_sup"], np.uint64),
+                np.asarray(saved.arrays["fb_pair_boost"], np.uint64),
+                float(saved.meta.get("fb_boost_scale",
+                                     self.cfg.feedback.boost_scale)))
+            self.noise_filter = None if f.empty_filter else f
         self._batch_no = saved.sweep
         return True
 
@@ -704,6 +740,27 @@ class StreamingScorer:
         n_batch_docs = len(np.unique(did_b))
         self.pair_rows += t_rows
         self.events_seen += len(table)
+        # Noise-filter event keys (r13): the packed pair identity per
+        # EVENT, from the raw u32 identities (stable across doc-table
+        # eviction/compaction — doc ids are not). Flow tokens are the
+        # [src|dst] halves of the same events in order on BOTH word
+        # paths (words.flow_words_from_arrays / _device_words), so the
+        # pair is one slice-and-pack; dns/proxy pairs are (client,
+        # bucket). String-keyed doc tables carry no u32s — pair
+        # filtering is off there, word-bucket filtering still applies.
+        ips = ip_u32 if dev is not None else words.ip_u32
+        n = len(table)
+        ev_pair = None
+        if ips is not None:
+            from onix.feedback.filter import pack_pair
+            if self.datatype == "flow" and len(ips) == 2 * n:
+                ev_pair = pack_pair(ips[:n], ips[n:])
+            elif self.datatype != "flow" and len(ips) == n:
+                # One token per event, but not necessarily in event
+                # order — scatter through event_idx.
+                ev_pair = np.zeros(n, np.uint64)
+                ev_pair[event_idx] = pack_pair(ips,
+                                               wid.astype(np.uint32))
         self.stage_walls["minibatch"] += t_stage() - t0
         return _Prep(table=table, n_events=len(table),
                      event_idx=event_idx,
@@ -711,14 +768,34 @@ class StreamingScorer:
                      did_b=did_b, wid_b=wid_b, weights=weights, inv=inv,
                      t=t, t_rows=t_rows, n_batch_docs=n_batch_docs,
                      docs_before=docs_before,
-                     n_docs_after=self.docs.n_docs)
+                     n_docs_after=self.docs.n_docs,
+                     wid_tok=wid, ev_pair=ev_pair)
 
     def _emit(self, p: "_Prep", tok_scores: np.ndarray,
               evict: bool = True) -> BatchResult:
         """Per-event reduce + alert rows + batch bookkeeping for one
-        prepared minibatch (shared tail of both paths)."""
+        prepared minibatch (shared tail of both paths).
+
+        The noise filter (r13) applies HERE, on the hot path's winner
+        selection: word-bucket adjustments on the token scores before
+        the event min-reduce, pair adjustments on the event scores
+        before the tol screen — the same boost-then-suppress-then-tol
+        order as the fused device scans (feedback/rescore.py), at the
+        point where scores are already host-side for selection. An
+        absent or EMPTY filter skips every adjustment outright, so the
+        no-feedback stream is bit-identical to pre-filter behavior."""
         t0 = time.perf_counter()
         n_events = p.n_events
+        # The config gate (feedback.filter_enabled) applies at INSTALL
+        # time (apply_feedback's `immediate` default) — an explicitly
+        # requested immediate=True install must also be APPLIED, so
+        # application is gated only on a non-empty installed filter.
+        f = self.noise_filter
+        if f is not None and f.empty_filter:
+            f = None
+        if f is not None and p.wid_tok is not None:
+            tok_scores = f.apply_word(tok_scores,
+                                      p.wid_tok.astype(np.uint64))
         if p.dev_flow:
             # Device flow layout is [src|dst] tokens of the same events
             # in order: the event min is one elementwise minimum, not an
@@ -728,6 +805,13 @@ class StreamingScorer:
         else:
             ev_scores = np.full(n_events, np.inf, np.float64)
             np.minimum.at(ev_scores, p.event_idx, tok_scores)
+        if f is not None and p.ev_pair is not None:
+            before = ev_scores
+            ev_scores = f.apply_pair(ev_scores, p.ev_pair)
+            if ev_scores is not before:
+                counters.inc("feedback.rescored_events",
+                             int(np.sum(~np.isfinite(ev_scores)
+                                        & np.isfinite(before))))
 
         tol = self.cfg.pipeline.tol
         hit = np.flatnonzero(ev_scores < tol)
@@ -746,6 +830,151 @@ class StreamingScorer:
                            n_events=n_events,
                            n_new_docs=n_after - p.docs_before,
                            step=int(self.state.step))
+
+    # -- analyst feedback (r13, onix/feedback/) ---------------------------
+    #
+    # The loop the OA layer exists for: verdicts on alert rows flow
+    # back into (a) the noise filter — the dismissed identity vanishes
+    # from the NEXT batch's winner set — and (b) an incremental
+    # feedback-weighted λ update through the same svi_step machinery
+    # the stream already runs, so the model itself stops scoring the
+    # dismissed traffic suspicious without a cold refit.
+
+    def apply_feedback(self, rows: pd.DataFrame, labels,
+                       immediate: bool | None = None,
+                       online: bool | None = None) -> dict:
+        """Apply analyst verdicts on raw telemetry rows (typically
+        alert rows from an earlier BatchResult). `labels` follows the
+        reference severity scale per row: 1/2 confirmed threat (boost),
+        3 benign (suppress/dismiss).
+
+        Identities are re-derived through the SAME frozen-edge word
+        path the stream scores with (word buckets from the packed key,
+        u32 doc identities), so the filter keys match future batches
+        exactly. `immediate`/`online` override the config gates
+        (feedback.filter_enabled / dismiss_weight > 0) — the replay
+        harness uses them to isolate the two timescales."""
+        from onix.feedback.filter import (BENIGN_LABEL, HostFilter,
+                                          pack_pair)
+
+        if self.edges is None:
+            raise ValueError("apply_feedback before any batch: the "
+                             "stream has no frozen edges (or model) "
+                             "to interpret the rows against")
+        labels = np.asarray(labels)
+        if len(labels) != len(rows):
+            raise ValueError("labels must match the row count")
+        fb = self.cfg.feedback
+        immediate = fb.filter_enabled if immediate is None else immediate
+        online = (fb.dismiss_weight > 0 or fb.confirm_weight > 0) \
+            if online is None else online
+
+        words = self._words(rows)
+        wid = _bucket_of_keys(words.word_key, self._salt, self.n_buckets)
+        benign = labels == BENIGN_LABEL
+        n = len(rows)
+        stats = {"n_rows": int(n), "n_benign": int(benign.sum())}
+
+        if immediate:
+            if self.noise_filter is None:
+                self.noise_filter = HostFilter.empty(fb.boost_scale)
+            if self.datatype == "flow" and words.ip_u32 is not None \
+                    and len(words.ip_u32) == 2 * n:
+                pair = pack_pair(words.ip_u32[:n], words.ip_u32[n:])
+            elif self.datatype != "flow" and words.ip_u32 is not None:
+                pair = np.zeros(n, np.uint64)
+                pair[words.event_idx] = pack_pair(
+                    words.ip_u32, wid.astype(np.uint32))
+            else:
+                pair = None     # string-keyed docs: word scope only
+            if pair is not None:
+                self.noise_filter = self.noise_filter.merged(
+                    pair_suppress=pair[benign],
+                    pair_boost=pair[~benign])
+            else:
+                wid_ev = np.zeros(n, np.uint64)
+                wid_ev[words.event_idx] = wid[:len(words.event_idx)] \
+                    .astype(np.uint64)
+                self.noise_filter = self.noise_filter.merged(
+                    word_suppress=wid_ev[benign],
+                    word_boost=wid_ev[~benign])
+            self.feedback_stats["suppress_keys"] = int(
+                self.noise_filter.pair_suppress.size
+                + self.noise_filter.word_suppress.size)
+            self.feedback_stats["boost_keys"] = int(
+                self.noise_filter.pair_boost.size
+                + self.noise_filter.word_boost.size)
+
+        if online:
+            stats.update(self._online_nudge(words, wid, labels))
+        self.feedback_stats["applied"] += 1
+        return stats
+
+    def _online_nudge(self, words, wid: np.ndarray,
+                      labels: np.ndarray) -> dict:
+        """Feedback-weighted minibatch through the stream's own SVI
+        update: dismissed rows enter at dismiss_weight (the ×DUPFACTOR
+        analog — λ and the docs' gamma learn the traffic is normal, so
+        p(word|doc) rises and it stops scoring suspicious), confirmed
+        rows at confirm_weight (default 0: confirmations must not
+        teach the model the attack is common). The minibatch is scaled
+        to ITSELF (corpus_docs = its own doc count), never
+        extrapolated to the corpus — a handful of weight-1000 rows
+        must not deflate every other word's φ."""
+        from onix.feedback.filter import BENIGN_LABEL
+
+        fb = self.cfg.feedback
+        tok_lab = labels[words.event_idx]       # labels per TOKEN
+        weights = np.where(tok_lab == BENIGN_LABEL,
+                           np.float32(fb.dismiss_weight),
+                           np.float32(fb.confirm_weight))
+        keep = weights > 0
+        if not keep.any():
+            return {"online_steps": 0}
+        if isinstance(self.docs, U32DocTable):
+            if words.ip_u32 is None:
+                # One odd feedback frame (IPv6/malformed rows) must
+                # NOT flip a columnar stream's doc table to string
+                # keys — that one-way conversion would disable the
+                # device word path for the stream's remaining life.
+                # Skip the nudge instead (the immediate filter, when
+                # on, has already taken effect).
+                counters.inc("feedback.nudge_skipped_no_u32")
+                return {"online_steps": 0,
+                        "skipped": "rows lack u32 doc identities"}
+            did = self.docs.ids(words.ip_u32)
+        else:
+            ips = words.ip
+            if ips is None:
+                from onix.pipelines.words import u32_to_ips
+                ips = u32_to_ips(words.ip_u32)
+            did = self.docs.ids(ips)
+        self._grow(self.docs.n_docs)
+        did, wid_k, weights = did[keep], wid[keep], weights[keep]
+
+        t0 = time.perf_counter()
+        pad_to, pad_docs = self._pick_pad(len(did), len(np.unique(did)))
+        batch = make_minibatch(did, wid_k, pad_to=pad_to,
+                               pad_docs=pad_docs, weights=weights)
+        dm = np.asarray(batch.doc_map)
+        real = dm >= 0
+        k = self._gamma.shape[1]
+        g0 = np.full((batch.n_docs, k), self.cfg.lda.alpha + 1.0,
+                     np.float32)
+        g0[real] = self._gamma[dm[real]]
+        steps = 0
+        gamma = g0
+        for _ in range(fb.online_steps):
+            self.state, gamma = self.model.update(
+                self.state, batch, corpus_docs=max(float(real.sum()), 2.0),
+                gamma0=gamma)
+            self.dispatches["svi_update"] += 1
+            steps += 1
+        gm = np.asarray(gamma)
+        self._gamma[dm[real]] = gm[real]
+        self.feedback_stats["online_steps"] += steps
+        self.stage_walls["svi_update"] += time.perf_counter() - t0
+        return {"online_steps": steps, "svi_step": int(self.state.step)}
 
     def process(self, table: pd.DataFrame,
                 cols: dict | None = None) -> BatchResult:

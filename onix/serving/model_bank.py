@@ -60,6 +60,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from onix.feedback.filter import (FILTER_FLOOR, FilterTables, HostFilter,
+                                  _pad_sorted, apply_filter, split_key)
 from onix.models.compaction import pow2_bucket
 from onix.models.scoring import TopK, _scan_bottom_k, _subscan_scores, score_events
 from onix.utils.obs import counters
@@ -125,9 +127,13 @@ class BankRefusal(ValueError):
 
 @dataclasses.dataclass(frozen=True)
 class TenantModel:
-    """One tenant's fitted tables, host-side (f32 [D,K] / [V,K])."""
+    """One tenant's fitted tables, host-side (f32 [D,K] / [V,K]).
+    `epoch` is the persisted model epoch (checkpoint meta
+    `model_epoch`) — 0 for a fresh fit, bumped by online feedback
+    updates; the bank's winner-cache invalidation keys on it."""
     theta: np.ndarray
     phi_wk: np.ndarray
+    epoch: int = 0
 
     @property
     def n_docs(self) -> int:
@@ -158,40 +164,69 @@ class ScoreRequest:
 # ---------------------------------------------------------------------------
 # The two batched kernels. Both end in scoring's _scan_bottom_k, so the
 # merge/tie/sentinel semantics (-1 on unfilled slots, lower-index wins
-# ties) are the single-tenant scan's by construction.
+# ties) are the single-tenant scan's by construction. Both apply the
+# per-tenant NOISE FILTER (r13, onix/feedback/) as the same fused
+# post-score adjustment before the tol screen: per request row, four
+# sorted sentinel-padded key tables (word/pair × suppress/boost) plus a
+# boost scale. A tenant with no feedback rides all-sentinel rows, whose
+# membership mask is constant False — scores bit-identical to the
+# pre-filter kernels (the filter.py exactness contract, tested).
 # ---------------------------------------------------------------------------
+
+
+def _row_filter_adjust(s, dc, wc, filt):
+    """One request row's fused adjustment: word key = the event's word
+    id, pair key = the packed (doc, word) identity the serve-layer
+    feedback rows label (filter.pack_pair — here as (hi, lo) = (doc,
+    word) uint32 halves, the x32-safe rendering)."""
+    wl = wc.astype(jnp.uint32)
+    wk = (jnp.zeros_like(wl), wl)
+    pk = (dc.astype(jnp.uint32), wl)
+    return apply_filter(s, wk, pk, filt)
 
 
 @functools.partial(jax.jit, static_argnames=("max_results",))
 def _bank_score_vmap(theta_bank, phi_bank, slots, doc_ids, word_ids, mask,
-                     tol, *, max_results: int) -> TopK:
+                     tol, filt_rows, *, max_results: int) -> TopK:
     """vmap form: one lane per request; the lane slices its tenant's
     tables from the bank and runs the shared chunked bottom-M scan
     (chunk = the padded row, so the scan is one merge — identical
-    result to the single-tenant path at any chunking)."""
+    result to the single-tenant path at any chunking). `filt_rows` is
+    a FilterTables pytree with a leading request axis on every leaf,
+    or None — the static no-feedback fast path that compiles without
+    any membership search (a wave with no filtered tenant must cost
+    exactly what it did pre-filter)."""
     n_pad = doc_ids.shape[1]
 
-    def one(slot, dr, wr, mr):
-        th = theta_bank[slot]
-        ph = phi_bank[slot]
+    def make_one(filtered):
+        def one(slot, dr, wr, mr, *filt):
+            th = theta_bank[slot]
+            ph = phi_bank[slot]
 
-        def score_chunk(dc, wc, mc):
-            s = _subscan_scores(th, ph, dc, wc)
-            return jnp.where((mc > 0) & (s < tol), s, jnp.inf)
+            def score_chunk(dc, wc, mc):
+                s = _subscan_scores(th, ph, dc, wc)
+                if filtered:
+                    s = _row_filter_adjust(s, dc, wc, filt[0])
+                return jnp.where((mc > 0) & (s < tol), s, jnp.inf)
 
-        return _scan_bottom_k((dr, wr, mr), n_pad, score_chunk,
-                              max_results=max_results, chunk=n_pad)
+            return _scan_bottom_k((dr, wr, mr), n_pad, score_chunk,
+                                  max_results=max_results, chunk=n_pad)
+        return one
 
-    return jax.vmap(one)(slots, doc_ids, word_ids, mask)
+    if filt_rows is None:
+        return jax.vmap(make_one(False))(slots, doc_ids, word_ids, mask)
+    return jax.vmap(make_one(True))(slots, doc_ids, word_ids, mask,
+                                    filt_rows)
 
 
 @functools.partial(jax.jit, static_argnames=("max_results",))
 def _bank_score_gather(theta_bank, phi_bank, slots, doc_ids, word_ids, mask,
-                       tol, *, max_results: int) -> TopK:
+                       tol, filt_rows, *, max_results: int) -> TopK:
     """gather form: the bank flattens to [(B·D_pad), K] and every event
     gathers via the tenant-composed flat index — one fused stream, no
     per-request table slice. Selection reuses the same bottom-M scan
-    per request row over the precomputed (masked) scores."""
+    per request row over the precomputed (masked, filter-adjusted)
+    scores. filt_rows=None is the static no-feedback fast path."""
     b, d_pad, _ = theta_bank.shape
     v_pad = phi_bank.shape[1]
     theta_flat = theta_bank.reshape(b * d_pad, -1)
@@ -200,6 +235,8 @@ def _bank_score_gather(theta_bank, phi_bank, slots, doc_ids, word_ids, mask,
     gd = (slots[:, None] * jnp.int32(d_pad) + doc_ids).reshape(-1)
     gw = (slots[:, None] * jnp.int32(v_pad) + word_ids).reshape(-1)
     s = score_events(theta_flat, phi_flat, gd, gw).reshape(doc_ids.shape)
+    if filt_rows is not None:
+        s = jax.vmap(_row_filter_adjust)(s, doc_ids, word_ids, filt_rows)
     s = jnp.where((mask > 0) & (s < tol), s, jnp.inf)
 
     def sel(sr):
@@ -244,7 +281,8 @@ class ModelBank:
     host-evicted (no loader can bring them back)."""
 
     def __init__(self, capacity: int = 64, form: str = "auto",
-                 loader=None, bulk_loader=None, host_capacity: int = 0):
+                 loader=None, bulk_loader=None, host_capacity: int = 0,
+                 filter_loader=None, epoch_loader=None):
         if capacity < 1:
             raise ValueError("bank capacity must be >= 1")
         if host_capacity < 0:
@@ -253,16 +291,31 @@ class ModelBank:
         self.form = form
         self._loader = loader
         self._bulk_loader = bulk_loader
+        self._filter_loader = filter_loader
+        self._epoch_loader = epoch_loader
         self.host_capacity = host_capacity
         self._models: OrderedDict[str, TenantModel] = OrderedDict()
         self._loader_backed: set[str] = set()
         self._shards: dict[tuple[int, int, int], _Shard] = {}
+        # r13 feedback loop: per-tenant compiled noise filter
+        # (onix/feedback/filter.HostFilter) + MODEL EPOCH. The epoch
+        # bumps on every event that can change a tenant's winners —
+        # add() (new/updated tables) and set_filter() — and the serve
+        # layer's winner cache keys on it, so post-feedback requests
+        # can never be served pre-feedback winners.
+        self._filters: dict[str, HostFilter] = {}
+        self._epochs: dict[str, int] = {}
+        # Last PERSISTED model_epoch seen per tenant (add() adopt/bump
+        # logic): distinguishes "same file reloaded" from "new file
+        # whose stamp trails the filter-inflated in-memory epoch".
+        self._disk_epochs: dict[str, int] = {}
         self.dispatches = 0
         self.compiled_shapes: set[tuple] = set()
 
     # -- registry ---------------------------------------------------------
 
-    def add(self, tenant: str, theta, phi_wk) -> None:
+    def add(self, tenant: str, theta, phi_wk,
+            epoch: int | None = None) -> None:
         theta = np.ascontiguousarray(theta, np.float32)
         phi_wk = np.ascontiguousarray(phi_wk, np.float32)
         if theta.ndim != 2 or phi_wk.ndim != 2 \
@@ -270,7 +323,100 @@ class ModelBank:
             raise ValueError(
                 f"tenant {tenant!r}: want theta [D,K] / phi_wk [V,K] with a "
                 f"shared K, got {theta.shape} / {phi_wk.shape}")
-        self._models[tenant] = TenantModel(theta, phi_wk)
+        self._models[tenant] = TenantModel(theta, phi_wk,
+                                           epoch=int(epoch or 0))
+        # New tables invalidate cached winners. An EXPLICIT epoch is a
+        # persisted stamp (loader path): reloading the SAME file after
+        # a host-evict (stamp unchanged since last seen) must NOT
+        # invalidate its cached winners — but a CHANGED stamp means a
+        # genuinely new file, and the in-memory epoch must move PAST
+        # its current value even when set_filter bumps (never
+        # persisted) have inflated it numerically ahead of the disk
+        # stamp; comparing magnitudes alone would let a re-fit hide
+        # behind filter bumps and serve pre-refit cached winners. A
+        # bare add() means new tables of unknown provenance: always
+        # bump.
+        cur = self._epochs.get(tenant)
+        if epoch is not None:
+            prev_disk = self._disk_epochs.get(tenant)
+            self._disk_epochs[tenant] = int(epoch)
+            if prev_disk is not None and int(epoch) != prev_disk:
+                self._epochs[tenant] = max((cur or 0) + 1, int(epoch))
+            else:
+                self._epochs[tenant] = max(cur or 0, int(epoch))
+        else:
+            self._epochs[tenant] = (cur + 1) if cur is not None else 0
+        # Device residency of the OLD tables must not survive the new
+        # ones — evict from every shard (the update may have changed
+        # the tenant's shape class) so the next wave re-admits the
+        # updated copy.
+        for shard in self._shards.values():
+            if tenant in shard.lru:
+                shard.free.append(shard.lru.pop(tenant))
+                counters.inc("bank.evict")
+
+    def epoch(self, tenant: str) -> int:
+        """Current model epoch (0 for a tenant never seen)."""
+        return self._epochs.get(tenant, 0)
+
+    def set_filter(self, tenant: str, filt: HostFilter | None) -> None:
+        """Install (or clear, with None/empty) a tenant's compiled
+        noise filter. Always bumps the epoch — the winner cache must
+        drop entries scored under the previous filter either way."""
+        if filt is None or filt.empty_filter:
+            self._filters.pop(tenant, None)
+        else:
+            self._filters[tenant] = filt
+        self._epochs[tenant] = self._epochs.get(tenant, 0) + 1
+
+    def get_filter(self, tenant: str) -> HostFilter | None:
+        return self._filters.get(tenant)
+
+    def refresh_from_disk(self, tenant: str) -> None:
+        """Adopt an OUT-OF-PROCESS re-save: re-read the tenant's
+        persisted epoch stamp (`epoch_loader`, serve wires it to
+        checkpoint.model_meta_epoch — one small json read) and, when
+        it differs from the last stamp seen, bump the in-memory epoch
+        and drop the host copy + device residency so the next score
+        loads the NEW tables. Without this, a nudge_and_save or
+        re-fit by another process is invisible to a live server — its
+        winner cache would serve pre-update winners until restart.
+        Only loader-backed tenants refresh (an explicitly add()ed
+        model has no file of record to re-fetch)."""
+        if self._epoch_loader is None or tenant not in self._loader_backed:
+            return
+        stamp = self._epoch_loader(tenant)
+        prev = self._disk_epochs.get(tenant)
+        if stamp is None or prev is None or stamp == prev:
+            return
+        self._disk_epochs[tenant] = int(stamp)
+        self._epochs[tenant] = max(self._epochs.get(tenant, 0) + 1,
+                                   int(stamp))
+        self._models.pop(tenant, None)
+        self._loader_backed.discard(tenant)
+        for shard in self._shards.values():
+            if tenant in shard.lru:
+                shard.free.append(shard.lru.pop(tenant))
+                counters.inc("bank.evict")
+        counters.inc("bank.disk_epoch_refresh")
+
+    def set_filter_tree(self, base: str, filt: HostFilter | None) -> int:
+        """Install the filter on `base` AND every known sub-tenant
+        (`base/<sub>`): sub-tenants share the per-(datatype, date)
+        feedback CSV — filter_loader compiles them the same filter on
+        first load, so the live-update path must reach them too or
+        their cached winners would keep serving dismissed events until
+        a restart. "Known" = registered models plus tenants that
+        already carry a filter; an unloaded sub-tenant still gets the
+        filter from filter_loader when it loads. Returns base's new
+        epoch."""
+        prefix = base + "/"
+        targets = {base} | {t for t in
+                            set(self._models) | set(self._filters)
+                            if t.startswith(prefix)}
+        for t in targets:
+            self.set_filter(t, filt)
+        return self.epoch(base)
 
     def model(self, tenant: str) -> TenantModel:
         m = self._models.get(tenant)
@@ -279,13 +425,28 @@ class ModelBank:
         if m is None and self._loader is not None:
             m = self._loader(tenant)
             if m is not None:
-                self.add(tenant, m.theta, m.phi_wk)
+                self.add(tenant, m.theta, m.phi_wk, epoch=m.epoch)
                 self._loader_backed.add(tenant)
+                self._load_filter(tenant)
                 self._trim_host_registry(keep={tenant})
                 m = self._models[tenant]
         if m is None:
             raise BankRefusal(f"unknown tenant {tenant!r}")
         return m
+
+    def _load_filter(self, tenant: str) -> None:
+        """Attach the tenant's persisted feedback filter on first load
+        (serve wires `filter_loader` to the feedback CSV compile), so
+        a restarted server suppresses dismissed winners from its very
+        first /score — no re-labeling needed."""
+        if self._filter_loader is None or tenant in self._filters:
+            return
+        filt = self._filter_loader(tenant)
+        if filt is not None and not filt.empty_filter:
+            # Through set_filter — the attach must BUMP the epoch:
+            # winner-cache entries for this tenant may predate a
+            # host-evict, and they were scored without this filter.
+            self.set_filter(tenant, filt)
 
     def _trim_host_registry(self, keep: set[str] = frozenset()) -> None:
         """Drop the oldest re-fetchable, non-device-resident host
@@ -408,8 +569,9 @@ class ModelBank:
                     unknown.append(req.tenant)
             if unknown:
                 for t, m in self._bulk_loader(unknown).items():
-                    self.add(t, m.theta, m.phi_wk)
+                    self.add(t, m.theta, m.phi_wk, epoch=m.epoch)
                     self._loader_backed.add(t)
+                    self._load_filter(t)
                 self._trim_host_registry(
                     keep={req.tenant for req in requests})
         by_class: dict[tuple, list[int]] = {}
@@ -446,6 +608,42 @@ class ModelBank:
         if wave:
             yield wave
 
+    def _filter_rows(self, requests, wave: list[int],
+                     r_pad: int) -> FilterTables:
+        """Stack the wave's per-tenant filter tables into a
+        FilterTables pytree with a leading [r_pad] request axis: per
+        family a ([r_pad, F] hi, [r_pad, F] lo) uint32 pair of sorted
+        sentinel-padded rows, plus the per-row boost scale. F is the
+        pow2 cover of the wave's largest table per family (floor
+        FILTER_FLOOR), so no-feedback waves stay in one tiny shape
+        class and the key-table ladder adds O(log entries) compiles."""
+        filts = [self._filters.get(requests[i].tenant) for i in wave]
+
+        def fam_rows(fam):
+            f_pad = pow2_bucket(
+                max([FILTER_FLOOR]
+                    + [len(getattr(x, fam)) for x in filts if x]),
+                FILTER_FLOOR)
+            rows = np.tile(_pad_sorted(np.empty(0, np.uint64), f_pad),
+                           (r_pad, 1))
+            for row, x in enumerate(filts):
+                if x is not None:
+                    keys = getattr(x, fam)
+                    rows[row, :len(keys)] = keys
+            hi, lo = split_key(rows.ravel())
+            return (jnp.asarray(hi.reshape(r_pad, f_pad)),
+                    jnp.asarray(lo.reshape(r_pad, f_pad)))
+
+        scale = np.ones(r_pad, np.float32)
+        for row, x in enumerate(filts):
+            if x is not None:
+                scale[row] = x.boost_scale
+        return FilterTables(word_suppress=fam_rows("word_suppress"),
+                            word_boost=fam_rows("word_boost"),
+                            pair_suppress=fam_rows("pair_suppress"),
+                            pair_boost=fam_rows("pair_boost"),
+                            boost_scale=jnp.asarray(scale))
+
     def _score_wave(self, shard: _Shard, requests, wave: list[int],
                     out: list, *, tol: float, max_results: int) -> None:
         needed: list[str] = []
@@ -468,15 +666,27 @@ class ModelBank:
             w[row, :n] = np.asarray(requests[i].word_ids, np.int32)
             m[row, :n] = 1.0
             slots[row] = shard.lru[requests[i].tenant]
+        # Static no-feedback fast path: a wave with no filtered tenant
+        # ships filt_rows=None and compiles WITHOUT the membership
+        # search — identical cost to the pre-filter kernels (the
+        # common case; the filtered variant is its own compiled shape).
+        if any(requests[i].tenant in self._filters for i in wave):
+            filt_rows = self._filter_rows(requests, wave, r_pad)
+            filt_dims = (filt_rows.word_suppress[0].shape[1],
+                         filt_rows.word_boost[0].shape[1],
+                         filt_rows.pair_suppress[0].shape[1],
+                         filt_rows.pair_boost[0].shape[1])
+        else:
+            filt_rows, filt_dims = None, None
 
         form = select_bank_form(self.form, r_pad, n_pad)
         shape_key = (form, shard.d_pad, shard.v_pad, shard.k, r_pad, n_pad,
-                     max_results)
+                     max_results, filt_dims)
         self.compiled_shapes.add(shape_key)
         res = _BANK_KERNELS[form](
             shard.theta, shard.phi, jnp.asarray(slots), jnp.asarray(d),
             jnp.asarray(w), jnp.asarray(m), jnp.float32(tol),
-            max_results=max_results)
+            filt_rows, max_results=max_results)
         self.dispatches += 1
         counters.inc("bank.dispatch")
         counters.inc("bank.requests", r)
@@ -500,12 +710,17 @@ class BankService:
 
     The cache asserts the (tenant, window) contract: a window names one
     immutable event set (a finished day/hour), so its winners are a
-    pure function of (tenant, window, tol, max_results) — tol and
-    max_results join the key, so a repeat of the same window at a
-    different threshold or result count is scored fresh, never served
-    the other parameterization's winners. A repeat with a DIFFERENT
-    event count is treated as a conflict: scored fresh, re-cached, and
-    counted (`bank.cache_conflict`) — never served stale."""
+    pure function of (tenant, window, tol, max_results) AND the
+    tenant's MODEL EPOCH — the epoch at score time is stored with the
+    entry, and a hit whose stored epoch trails the tenant's current one
+    (feedback applied, model updated/re-saved) is EVICTED and re-scored
+    (`bank.cache_epoch_evictions`): a post-feedback request can never
+    be served pre-feedback winners. tol and max_results join the key,
+    so a repeat of the same window at a different threshold or result
+    count is scored fresh, never served the other parameterization's
+    winners. A repeat with a DIFFERENT event count is treated as a
+    conflict: scored fresh, re-cached, and counted
+    (`bank.cache_conflict`) — never served stale."""
 
     def __init__(self, bank: ModelBank, max_batch_requests: int = 64,
                  cache_size: int = 4096):
@@ -515,24 +730,36 @@ class BankService:
         self.max_batch_requests = max_batch_requests
         self.cache_size = cache_size
         self._cache: OrderedDict[tuple[str, str, float, int],
-                                 tuple[int, TopK]] = OrderedDict()
+                                 tuple[int, int, TopK]] = OrderedDict()
 
     def score(self, requests: list[ScoreRequest], *, tol: float,
               max_results: int) -> list[BankResult]:
         out: list[BankResult | None] = [None] * len(requests)
+        # Out-of-process update probe, once per distinct tenant per
+        # call (ModelBank.refresh_from_disk): a re-save by another
+        # process moves the epoch BEFORE the hit checks below, so the
+        # cache can never serve winners computed under the old file.
+        for tenant in {r.tenant for r in requests}:
+            self.bank.refresh_from_disk(tenant)
         misses: list[int] = []
         for i, req in enumerate(requests):
             key = (req.tenant, req.window, float(tol), int(max_results)) \
                 if req.window is not None else None
             hit = self._cache.get(key) if key is not None else None
             if hit is not None:
-                n_cached, topk = hit
-                if n_cached == int(np.asarray(req.doc_ids).size):
+                n_cached, epoch_cached, topk = hit
+                if epoch_cached != self.bank.epoch(req.tenant):
+                    # Scored under an older model epoch: stale by
+                    # construction, never serveable.
+                    del self._cache[key]
+                    counters.inc("bank.cache_epoch_evictions")
+                elif n_cached == int(np.asarray(req.doc_ids).size):
                     self._cache.move_to_end(key)
                     counters.inc("bank.cache_hit")
                     out[i] = BankResult(topk, cached=True)
                     continue
-                counters.inc("bank.cache_conflict")
+                else:
+                    counters.inc("bank.cache_conflict")
             if key is not None:     # uncacheable requests don't dilute
                 counters.inc("bank.cache_miss")
             misses.append(i)
@@ -544,11 +771,32 @@ class BankService:
                 out[i] = BankResult(topk, cached=False)
                 req = requests[i]
                 if req.window is not None:
+                    # Epoch AFTER scoring: score_batch may have loaded
+                    # the tenant (adopting its persisted epoch) — the
+                    # entry must carry the epoch its winners were
+                    # computed under.
                     self._put(
                         (req.tenant, req.window, float(tol),
                          int(max_results)),
-                        (int(np.asarray(req.doc_ids).size), topk))
+                        (int(np.asarray(req.doc_ids).size),
+                         self.bank.epoch(req.tenant), topk))
         return out  # type: ignore[return-value]
+
+    def apply_feedback_filter(self, base: str, filt) -> int:
+        """The serve layer's one-call feedback install: filter + epoch
+        bumps for every KNOWN tenant under `base`
+        (bank.set_filter_tree), plus an outright drop of every cache
+        entry under the base — an UNLOADED sub-tenant's name is
+        unknowable here, so its stale entries cannot be reached
+        through epochs (its filter attaches, with a bump, when it next
+        loads; but a cached pre-evict entry would hit before any load
+        runs). Returns base's new epoch."""
+        epoch = self.bank.set_filter_tree(base, filt)
+        prefix = base + "/"
+        for key in [k for k in self._cache
+                    if k[0] == base or k[0].startswith(prefix)]:
+            del self._cache[key]
+        return epoch
 
     def _put(self, key, value) -> None:
         self._cache[key] = value
@@ -560,4 +808,6 @@ class BankService:
         return {"entries": len(self._cache),
                 "hits": counters.get("bank.cache_hit"),
                 "misses": counters.get("bank.cache_miss"),
-                "conflicts": counters.get("bank.cache_conflict")}
+                "conflicts": counters.get("bank.cache_conflict"),
+                "epoch_evictions":
+                    counters.get("bank.cache_epoch_evictions")}

@@ -1,0 +1,489 @@
+"""The r13 noise filter: compile, fused rescoring, bank + streaming.
+
+The contracts under test are the ones ISSUE 9 names: a filter of zero
+entries is BIT-identical to no filter on every path; a suppressed
+winner drops out of the very next winner set (scan, bank, stream) and
+never resurfaces across streaming eviction/checkpoint-resume
+boundaries; the winner cache can never serve pre-feedback winners
+(model-epoch keying); boost keeps a confirmed event surfacing.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import jax.numpy as jnp
+
+from onix.config import OnixConfig
+from onix.feedback.filter import (HostFilter, compile_feedback,
+                                  filter_from_csv, pack_pair, split_key)
+from onix.feedback.rescore import (table_bottom_k_filtered,
+                                   table_pair_bottom_k_filtered,
+                                   top_suspicious_filtered)
+from onix.models.scoring import (score_table, table_bottom_k,
+                                 table_pair_bottom_k, top_suspicious)
+from onix.utils.obs import counters
+
+TOL, M = 1.0, 32
+
+
+@pytest.fixture(autouse=True)
+def _reset_counters():
+    counters.reset("bank")
+    counters.reset("feedback")
+    yield
+    counters.reset("bank")
+    counters.reset("feedback")
+
+
+def _model(rng, n_docs, n_vocab, k=8):
+    return (rng.dirichlet(np.full(k, 0.5), n_docs).astype(np.float32),
+            rng.dirichlet(np.full(k, 0.5), n_vocab).astype(np.float32))
+
+
+# -- HostFilter / compile ----------------------------------------------------
+
+
+def test_merge_relabel_flips_sets():
+    f = HostFilter.empty().merged(pair_suppress=np.array([7, 9], np.uint64))
+    f = f.merged(pair_boost=np.array([9], np.uint64))
+    assert f.pair_suppress.tolist() == [7]
+    assert f.pair_boost.tolist() == [9]
+    f = f.merged(pair_suppress=np.array([9], np.uint64))
+    assert sorted(f.pair_suppress.tolist()) == [7, 9]
+    assert f.pair_boost.size == 0
+
+
+def test_pack_pair_u32_range_is_lossless():
+    hi = np.array([0, 1, 0xFFFFFFFE], np.uint32)
+    lo = np.array([0xFFFFFFFF, 0, 5], np.uint32)
+    keys = pack_pair(hi, lo)
+    h2, l2 = split_key(keys)
+    np.testing.assert_array_equal(h2, hi)
+    np.testing.assert_array_equal(l2, lo)
+    assert len(np.unique(keys)) == 3
+
+
+def test_compile_feedback_splits_word_and_pair_keys():
+    df = pd.DataFrame({
+        "ip": ["a", "b", "c", "d"],
+        "word": ["w"] * 4,
+        "label": [3, 1, 3, 2],
+        "doc_id": [5, 6, "", ""],
+        "word_id": [11, 12, 13, 14],
+    })
+    f = compile_feedback(df)
+    assert f.pair_suppress.tolist() == [pack_pair(5, 11)]
+    assert f.pair_boost.tolist() == [pack_pair(6, 12)]
+    assert f.word_suppress.tolist() == [13]
+    assert f.word_boost.tolist() == [14]
+
+
+def test_filter_from_csv_missing_and_stringonly(tmp_path):
+    assert filter_from_csv(tmp_path / "nope.csv").empty_filter
+    p = tmp_path / "fb.csv"
+    pd.DataFrame({"ip": ["a"], "word": ["w"],
+                  "label": [3]}).to_csv(p, index=False)
+    assert filter_from_csv(p).empty_filter
+
+
+# -- fused scans -------------------------------------------------------------
+
+
+def _pair_setup(seed=0, n=30_000, n_docs=400, n_vocab=64):
+    rng = np.random.default_rng(seed)
+    theta, phi = _model(rng, n_docs, n_vocab)
+    table = score_table(jnp.asarray(theta), jnp.asarray(phi)).ravel()
+    ds = rng.integers(0, n_docs, n).astype(np.int32)
+    dd = rng.integers(0, n_docs, n).astype(np.int32)
+    w = rng.integers(0, n_vocab, n).astype(np.int32)
+    pair = pack_pair(ds.astype(np.uint32), dd.astype(np.uint32))
+    ph, pl = split_key(pair)
+    return (theta, phi, table, ds, dd, w, pair,
+            jnp.asarray(ds * n_vocab + w), jnp.asarray(dd * n_vocab + w),
+            jnp.asarray(w), jnp.asarray(ph), jnp.asarray(pl))
+
+
+def test_empty_filter_bit_identical_all_scans():
+    (theta, phi, table, ds, dd, w, pair,
+     isrc, idst, wd, ph, pl) = _pair_setup()
+    empty = HostFilter.empty().tables()
+
+    ref = table_pair_bottom_k(table, isrc, idst, tol=TOL, max_results=M)
+    out = table_pair_bottom_k_filtered(table, isrc, idst, wd, ph, pl,
+                                       empty, tol=TOL, max_results=M)
+    np.testing.assert_array_equal(np.asarray(ref.scores),
+                                  np.asarray(out.scores))
+    np.testing.assert_array_equal(np.asarray(ref.indices),
+                                  np.asarray(out.indices))
+
+    ref = table_bottom_k(table, isrc, tol=TOL, max_results=M)
+    out = table_bottom_k_filtered(table, isrc, wd, ph, pl, empty,
+                                  tol=TOL, max_results=M)
+    np.testing.assert_array_equal(np.asarray(ref.scores),
+                                  np.asarray(out.scores))
+    np.testing.assert_array_equal(np.asarray(ref.indices),
+                                  np.asarray(out.indices))
+
+    mask = jnp.ones(len(ds), jnp.float32)
+    ref = top_suspicious(jnp.asarray(theta), jnp.asarray(phi),
+                         jnp.asarray(ds), jnp.asarray(w), mask,
+                         tol=TOL, max_results=M)
+    out = top_suspicious_filtered(jnp.asarray(theta), jnp.asarray(phi),
+                                  jnp.asarray(ds), jnp.asarray(w), mask,
+                                  ph, pl, empty, tol=TOL, max_results=M)
+    np.testing.assert_array_equal(np.asarray(ref.scores),
+                                  np.asarray(out.scores))
+    np.testing.assert_array_equal(np.asarray(ref.indices),
+                                  np.asarray(out.indices))
+
+
+def test_pair_suppression_removes_exactly_the_suppressed_winners():
+    (_, _, table, ds, dd, w, pair,
+     isrc, idst, wd, ph, pl) = _pair_setup(seed=1)
+    ref = table_pair_bottom_k(table, isrc, idst, tol=TOL, max_results=M)
+    win = np.asarray(ref.indices)
+    win = win[win >= 0]
+    filt = HostFilter.empty().merged(pair_suppress=pair[win[::2]])
+    out = table_pair_bottom_k_filtered(table, isrc, idst, wd, ph, pl,
+                                       filt.tables(), tol=TOL,
+                                       max_results=M)
+    fidx = np.asarray(out.indices)
+    fidx = set(fidx[fidx >= 0].tolist())
+    suppressed = set(np.flatnonzero(
+        HostFilter.member(pair, filt.pair_suppress)).tolist())
+    assert not (fidx & suppressed)
+    assert (set(win.tolist()) - fidx) == (set(win.tolist()) & suppressed)
+
+
+def test_word_boost_keeps_confirmed_event_surfacing():
+    """A confirmed-threat word whose raw score clears tol must stay in
+    the winner set once boosted (scale pushes it back under tol)."""
+    (_, _, table, ds, dd, w, pair,
+     isrc, idst, wd, ph, pl) = _pair_setup(seed=2)
+    table_h = np.asarray(table)
+    s_raw = np.minimum(table_h[np.asarray(isrc)], table_h[np.asarray(idst)])
+    tol = float(np.quantile(s_raw, 0.001))
+    # Pick an event just ABOVE tol: invisible unfiltered, boosted in.
+    above = np.flatnonzero((s_raw > tol) & (s_raw < tol / 0.25 * 0.9))
+    target = above[0]
+    ref = table_pair_bottom_k_filtered(
+        table, isrc, idst, wd, ph, pl, HostFilter.empty().tables(),
+        tol=tol, max_results=M)
+    assert target not in set(np.asarray(ref.indices).tolist())
+    filt = HostFilter.empty().merged(
+        word_boost=np.array([w[target]], np.uint64))
+    out = table_pair_bottom_k_filtered(
+        table, isrc, idst, wd, ph, pl, filt.tables(),
+        tol=tol, max_results=M)
+    assert target in set(np.asarray(out.indices).tolist())
+
+
+# -- model bank --------------------------------------------------------------
+
+
+def test_bank_filter_suppresses_and_bumps_epoch():
+    from onix.serving.model_bank import ModelBank, ScoreRequest
+    rng = np.random.default_rng(3)
+    theta, phi = _model(rng, 300, 200)
+    bank = ModelBank(capacity=2)
+    bank.add("a", theta, phi)
+    e0 = bank.epoch("a")
+    req = ScoreRequest("a", rng.integers(0, 300, 500).astype(np.int32),
+                       rng.integers(0, 200, 500).astype(np.int32))
+    (ref,) = bank.score_batch([req], tol=TOL, max_results=M)
+    win = ref.indices[ref.indices >= 0]
+    # dismiss the top winner's (doc, word) pair
+    d0, w0 = int(req.doc_ids[win[0]]), int(req.word_ids[win[0]])
+    filt = HostFilter.empty().merged(
+        pair_suppress=pack_pair(np.array([d0], np.uint32),
+                                np.array([w0], np.uint32)))
+    bank.set_filter("a", filt)
+    assert bank.epoch("a") == e0 + 1
+    (out,) = bank.score_batch([req], tol=TOL, max_results=M)
+    alive = out.indices[out.indices >= 0]
+    same_pair = [(int(req.doc_ids[i]), int(req.word_ids[i]))
+                 for i in alive]
+    assert (d0, w0) not in same_pair
+    assert int(win[0]) not in alive.tolist()
+
+
+def test_bank_empty_filter_bit_identical():
+    from onix.serving.model_bank import ModelBank, ScoreRequest
+    rng = np.random.default_rng(4)
+    theta, phi = _model(rng, 300, 200)
+    req = ScoreRequest("a", rng.integers(0, 300, 333).astype(np.int32),
+                       rng.integers(0, 200, 333).astype(np.int32))
+    outs = []
+    for filt in (None, HostFilter.empty()):
+        bank = ModelBank(capacity=2)
+        bank.add("a", theta, phi)
+        if filt is not None:
+            bank.set_filter("a", filt)
+        outs.append(bank.score_batch([req], tol=TOL, max_results=M)[0])
+    np.testing.assert_array_equal(outs[0].scores, outs[1].scores)
+    np.testing.assert_array_equal(outs[0].indices, outs[1].indices)
+
+
+def test_winner_cache_epoch_eviction():
+    """Post-feedback requests can never be served pre-feedback winners:
+    a cached (tenant, window) entry scored under epoch e is evicted —
+    and counted — once the epoch moves."""
+    from onix.serving.model_bank import BankService, ModelBank, ScoreRequest
+    rng = np.random.default_rng(5)
+    theta, phi = _model(rng, 300, 200)
+    bank = ModelBank(capacity=2)
+    bank.add("a", theta, phi)
+    svc = BankService(bank)
+    req = ScoreRequest("a", rng.integers(0, 300, 400).astype(np.int32),
+                       rng.integers(0, 200, 400).astype(np.int32),
+                       window="w1")
+    (r1,) = svc.score([req], tol=TOL, max_results=M)
+    (r2,) = svc.score([req], tol=TOL, max_results=M)
+    assert not r1.cached and r2.cached
+    win = r2.topk.indices[r2.topk.indices >= 0]
+    d0, w0 = int(req.doc_ids[win[0]]), int(req.word_ids[win[0]])
+    bank.set_filter("a", HostFilter.empty().merged(
+        pair_suppress=pack_pair(np.array([d0], np.uint32),
+                                np.array([w0], np.uint32))))
+    (r3,) = svc.score([req], tol=TOL, max_results=M)
+    assert not r3.cached                      # epoch moved: re-scored
+    assert counters.get("bank.cache_epoch_evictions") == 1
+    assert int(win[0]) not in r3.topk.indices.tolist()
+    (r4,) = svc.score([req], tol=TOL, max_results=M)
+    assert r4.cached                          # new-epoch entry serves
+
+
+def test_filter_loader_attaches_on_load(tmp_path):
+    """A restarted server (fresh bank) compiles the persisted feedback
+    CSV into the tenant's filter on first load."""
+    from onix.serving.model_bank import ModelBank, ScoreRequest, TenantModel
+    rng = np.random.default_rng(6)
+    theta, phi = _model(rng, 300, 200)
+    req = ScoreRequest("t", rng.integers(0, 300, 400).astype(np.int32),
+                       rng.integers(0, 200, 400).astype(np.int32))
+    plain = ModelBank(capacity=2)
+    plain.add("t", theta, phi)
+    (ref,) = plain.score_batch([req], tol=TOL, max_results=M)
+    win = ref.indices[ref.indices >= 0]
+    d0, w0 = int(req.doc_ids[win[0]]), int(req.word_ids[win[0]])
+
+    filt = HostFilter.empty().merged(
+        pair_suppress=pack_pair(np.array([d0], np.uint32),
+                                np.array([w0], np.uint32)))
+    bank = ModelBank(capacity=2,
+                     loader=lambda t: TenantModel(theta, phi),
+                     filter_loader=lambda t: filt)
+    (out,) = bank.score_batch([req], tol=TOL, max_results=M)
+    assert int(win[0]) not in out.indices.tolist()
+
+
+# -- streaming ---------------------------------------------------------------
+
+
+def _flow_batch(seed, n=1200, beacon=True):
+    from onix.pipelines.synth import synth_flow_day
+    t, _ = synth_flow_day(n_events=n, n_hosts=80, n_anomalies=0,
+                          seed=seed)
+    if beacon:
+        rows = t.iloc[:3].copy()
+        rows["sip"] = "10.66.66.66"
+        rows["dip"] = "203.0.113.99"
+        rows["sport"] = 44123
+        rows["dport"] = 51789
+        rows["proto"] = "TCP"
+        rows["ipkt"] = 2
+        rows["ibyt"] = 99
+        rows["treceived"] = "2016-07-08 03:33:00"
+        t = pd.concat([t, rows], ignore_index=True)
+    return t
+
+
+def _beacon_alerts(res):
+    a = res.alerts
+    if len(a) == 0:
+        return 0
+    return int(((a["sip"] == "10.66.66.66")
+                & (a["dip"] == "203.0.113.99")).sum())
+
+
+def _dismiss_beacon(sc, res, **kw):
+    mask = ((res.alerts["sip"] == "10.66.66.66")
+            & (res.alerts["dip"] == "203.0.113.99"))
+    rows = res.alerts[mask].drop(columns=["score", "event_idx"])
+    assert len(rows) > 0
+    return sc.apply_feedback(rows, np.full(len(rows), 3), **kw)
+
+
+def test_streaming_suppressed_pair_never_reappears(tmp_path):
+    """The satellite contract: dismissed (src, dst) gone from the next
+    batch's winners, and STILL gone after doc-table eviction and a
+    checkpoint-resume into a fresh scorer."""
+    from onix.pipelines.streaming import StreamingScorer
+    cfg = OnixConfig()
+    cfg.lda.checkpoint_every = 1
+    cfg.validate()
+    ck = tmp_path / "ck"
+    sc = StreamingScorer(cfg, "flow", n_buckets=1 << 10,
+                         checkpoint_dir=ck, max_docs=60)
+    r0 = sc.process(_flow_batch(0))
+    assert _beacon_alerts(r0) > 0
+    _dismiss_beacon(sc, r0, immediate=True, online=False)
+    r1 = sc.process(_flow_batch(1))
+    assert _beacon_alerts(r1) == 0
+    # max_docs=60 over 80-host batches: eviction fires every batch;
+    # the filter keys are raw u32 pairs, untouched by id compaction.
+    r2 = sc.process(_flow_batch(2))
+    assert _beacon_alerts(r2) == 0
+
+    sc2 = StreamingScorer(cfg, "flow", n_buckets=1 << 10,
+                          checkpoint_dir=ck, max_docs=60)
+    assert sc2.noise_filter is not None
+    assert sc2.noise_filter.pair_suppress.size == 1
+    r3 = sc2.process(_flow_batch(3))
+    assert _beacon_alerts(r3) == 0
+
+
+def test_streaming_empty_filter_bit_identical():
+    from onix.pipelines.streaming import StreamingScorer
+    cfg = OnixConfig()
+    cfg.validate()
+    a = StreamingScorer(cfg, "flow", n_buckets=1 << 10)
+    b = StreamingScorer(cfg, "flow", n_buckets=1 << 10)
+    b.noise_filter = HostFilter.empty()
+    for seed in (0, 1):
+        ra = a.process(_flow_batch(seed))
+        rb = b.process(_flow_batch(seed))
+        np.testing.assert_array_equal(ra.scores, rb.scores)
+        assert (ra.alerts["event_idx"].tolist()
+                == rb.alerts["event_idx"].tolist())
+
+
+def test_streaming_filter_disabled_by_config():
+    """filter_enabled=False gates the DEFAULT install: apply_feedback
+    without an explicit `immediate` installs nothing, so the dismissed
+    pair keeps surfacing; an explicit immediate=True overrides the
+    config and both installs and applies."""
+    from onix.pipelines.streaming import StreamingScorer
+    cfg = OnixConfig()
+    cfg.feedback.filter_enabled = False
+    cfg.validate()
+    sc = StreamingScorer(cfg, "flow", n_buckets=1 << 10)
+    r0 = sc.process(_flow_batch(0))
+    _dismiss_beacon(sc, r0, online=False)         # config default: off
+    assert sc.noise_filter is None
+    r1 = sc.process(_flow_batch(1))
+    assert _beacon_alerts(r1) > 0
+    _dismiss_beacon(sc, r1, immediate=True, online=False)   # override
+    r2 = sc.process(_flow_batch(2))
+    assert _beacon_alerts(r2) == 0
+
+
+def test_apply_feedback_before_first_batch_refused():
+    from onix.pipelines.streaming import StreamingScorer
+    cfg = OnixConfig()
+    cfg.validate()
+    sc = StreamingScorer(cfg, "flow", n_buckets=1 << 10)
+    with pytest.raises(ValueError, match="frozen edges"):
+        sc.apply_feedback(_flow_batch(0).iloc[:1], np.array([3]))
+
+
+def test_replay_harness_smoke():
+    """Tier-1 smoke of the acceptance harness at a tiny shape — the
+    test_fit_gap_smoke discipline: the replay proof cannot rot between
+    full runs."""
+    import pathlib
+    import sys
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent
+                           / "scripts"))
+    import exp_feedback_loop as X
+    rc = X.main(["--small", "--batches", "4", "--events-per-batch", "500",
+                 "--tp-pairs", "1"])
+    assert rc == 0
+
+
+def test_set_filter_tree_reaches_sub_tenants():
+    """A /feedback POST must invalidate SUB-tenants too — they share
+    the per-(datatype, date) feedback CSV."""
+    from onix.serving.model_bank import ModelBank
+    rng = np.random.default_rng(7)
+    theta, phi = _model(rng, 100, 80)
+    bank = ModelBank(capacity=4)
+    bank.add("flow/20160708", theta, phi)
+    bank.add("flow/20160708/acme", theta, phi)
+    bank.add("flow/20160709", theta, phi)       # different day: untouched
+    e_base = bank.epoch("flow/20160708")
+    e_sub = bank.epoch("flow/20160708/acme")
+    e_other = bank.epoch("flow/20160709")
+    filt = HostFilter.empty().merged(
+        pair_suppress=np.array([5], np.uint64))
+    assert bank.set_filter_tree("flow/20160708", filt) == e_base + 1
+    assert bank.epoch("flow/20160708/acme") == e_sub + 1
+    assert bank.get_filter("flow/20160708/acme") is filt
+    assert bank.epoch("flow/20160709") == e_other
+    assert bank.get_filter("flow/20160709") is None
+
+
+def test_refit_resave_bumps_model_epoch(tmp_path):
+    """run_scoring's save_fitted path bumps past the stored epoch on a
+    re-fit — a re-save that reset the epoch would let a reloading bank
+    serve pre-refit cached winners forever."""
+    from onix.checkpoint import (load_model, model_meta_epoch, save_model)
+    rng = np.random.default_rng(8)
+    theta, phi = _model(rng, 50, 40)
+    assert model_meta_epoch(tmp_path, "flow/20160708") is None
+    save_model(tmp_path, "flow/20160708", theta, phi, epoch=0)
+    assert model_meta_epoch(tmp_path, "flow/20160708") == 0
+    # the run.py idiom: re-save at prev + 1
+    prev = model_meta_epoch(tmp_path, "flow/20160708")
+    save_model(tmp_path, "flow/20160708", theta, phi, epoch=prev + 1)
+    assert load_model(tmp_path, "flow/20160708").meta["model_epoch"] == 1
+
+
+def test_new_disk_epoch_invalidates_even_behind_filter_bumps():
+    """A re-fit's persisted stamp may numerically TRAIL an in-memory
+    epoch inflated by (never-persisted) set_filter bumps — a changed
+    stamp must still move the epoch, or the cache serves pre-refit
+    winners."""
+    from onix.serving.model_bank import ModelBank
+    rng = np.random.default_rng(9)
+    theta, phi = _model(rng, 100, 80)
+    bank = ModelBank(capacity=2)
+    bank.add("t", theta, phi, epoch=0)
+    for _ in range(3):
+        bank.set_filter("t", HostFilter.empty().merged(
+            pair_suppress=np.array([rng.integers(1, 99)], np.uint64)))
+    inflated = bank.epoch("t")
+    assert inflated == 3
+    # Same file reloaded (host-evict path): NO invalidation.
+    bank.add("t", theta, phi, epoch=0)
+    assert bank.epoch("t") == inflated
+    # Re-fit persisted at epoch 1 (< inflated): MUST invalidate.
+    theta2, phi2 = _model(rng, 100, 80)
+    bank.add("t", theta2, phi2, epoch=1)
+    assert bank.epoch("t") > inflated
+
+
+def test_apply_feedback_filter_drops_prefix_cache_entries():
+    """Cached winners for UNLOADED sub-tenants are unreachable through
+    epochs (names unknown until load) — the service drops every entry
+    under the base outright on a feedback install."""
+    from onix.serving.model_bank import BankService, ModelBank, ScoreRequest
+    rng = np.random.default_rng(10)
+    models = {}
+    bank = ModelBank(capacity=4)
+    for t in ("flow/20160708", "flow/20160708/acme", "flow/20160709"):
+        th, ph = _model(rng, 100, 80)
+        bank.add(t, th, ph)
+        models[t] = (th, ph)
+    svc = BankService(bank)
+    reqs = [ScoreRequest(t, rng.integers(0, 100, 50).astype(np.int32),
+                         rng.integers(0, 80, 50).astype(np.int32),
+                         window="w")
+            for t in models]
+    svc.score(reqs, tol=TOL, max_results=M)
+    assert len(svc._cache) == 3
+    svc.apply_feedback_filter("flow/20160708", HostFilter.empty().merged(
+        pair_suppress=np.array([1], np.uint64)))
+    remaining = {k[0] for k in svc._cache}
+    assert remaining == {"flow/20160709"}

@@ -1,0 +1,174 @@
+"""The r13 online updater: feedback-weighted minibatch λ/φ nudges.
+
+Contracts: a dismissed (doc, word) pair's probability RISES (it stops
+scoring suspicious) while unrelated pairs barely move (zero-lag
+detection preserved); confirmations alone change nothing (weight 0 —
+the model must never learn an attack is common); persisted nudges bump
+the model epoch end-to-end (save → load → bank adopt).
+"""
+
+import numpy as np
+import pytest
+
+from onix.checkpoint import load_model, save_model
+from onix.config import FeedbackConfig, LDAConfig
+from onix.feedback.online import OnlineUpdater
+
+
+def _model(rng, n_docs=300, n_vocab=128, k=8):
+    theta = rng.dirichlet(np.full(k, 0.5), n_docs).astype(np.float32)
+    # Column-stochastic phi (p(word|topic)) — the fitted-table layout.
+    phi = rng.dirichlet(np.full(n_vocab, 0.5), k).T.astype(np.float32)
+    return theta, phi
+
+
+def _p(theta, phi, d, w):
+    return (theta[d] * phi[w]).sum(axis=1)
+
+
+def test_nudge_raises_dismissed_and_preserves_others():
+    rng = np.random.default_rng(0)
+    theta, phi = _model(rng)
+    up = OnlineUpdater(LDAConfig(n_topics=8), FeedbackConfig())
+    d = np.array([5, 7], np.int32)
+    w = np.array([3, 9], np.int32)
+    res = up.nudge(theta, phi, d, w, np.array([3, 3]))
+    assert (_p(res.theta, res.phi_wk, d, w) > _p(theta, phi, d, w)).all()
+    # Unrelated pairs move < 5% — the nudge is scaled to itself, never
+    # extrapolated to the corpus.
+    od = np.array([100, 200, 250])
+    ow = np.array([50, 80, 110])
+    rel = _p(res.theta, res.phi_wk, od, ow) / _p(theta, phi, od, ow)
+    assert np.all(np.abs(rel - 1.0) < 0.05), rel
+    assert res.stats["mean_score_after"] > res.stats["mean_score_before"]
+
+
+def test_confirmations_alone_are_a_noop():
+    rng = np.random.default_rng(1)
+    theta, phi = _model(rng)
+    up = OnlineUpdater(LDAConfig(n_topics=8), FeedbackConfig())
+    res = up.nudge(theta, phi, np.array([1], np.int32),
+                   np.array([2], np.int32), np.array([1]))
+    np.testing.assert_array_equal(res.theta, theta)
+    np.testing.assert_array_equal(res.phi_wk, phi)
+    assert res.stats["online_steps"] == 0
+
+
+def test_more_steps_move_further():
+    rng = np.random.default_rng(2)
+    theta, phi = _model(rng)
+    d = np.array([5], np.int32)
+    w = np.array([3], np.int32)
+    lab = np.array([3])
+    gains = []
+    for steps in (1, 5):
+        up = OnlineUpdater(LDAConfig(n_topics=8),
+                           FeedbackConfig(online_steps=steps))
+        res = up.nudge(theta, phi, d, w, lab)
+        gains.append(float(_p(res.theta, res.phi_wk, d, w)[0]))
+    assert gains[1] > gains[0]
+
+
+def test_nudge_validates_inputs():
+    rng = np.random.default_rng(3)
+    theta, phi = _model(rng)
+    up = OnlineUpdater(LDAConfig(n_topics=8), FeedbackConfig())
+    with pytest.raises(ValueError, match="out of range"):
+        up.nudge(theta, phi, np.array([999], np.int32),
+                 np.array([0], np.int32), np.array([3]))
+    with pytest.raises(ValueError, match="equal-length"):
+        up.nudge(theta, phi, np.array([1, 2], np.int32),
+                 np.array([0], np.int32), np.array([3]))
+    with pytest.raises(ValueError, match="single-estimate"):
+        up.nudge(np.stack([theta, theta]), phi,
+                 np.array([1], np.int32), np.array([0], np.int32),
+                 np.array([3]))
+
+
+def test_nudge_and_save_bumps_model_epoch(tmp_path):
+    """The durable loop: nudge a persisted model, re-save under a
+    bumped epoch, and watch the bank adopt it — the epoch that keys
+    the winner cache."""
+    from onix.serving.model_bank import ModelBank
+
+    rng = np.random.default_rng(4)
+    theta, phi = _model(rng)
+    save_model(tmp_path, "flow/20160708", theta, phi)
+    m0 = load_model(tmp_path, "flow/20160708")
+    assert m0.meta["model_epoch"] == 0
+
+    up = OnlineUpdater(LDAConfig(n_topics=8), FeedbackConfig())
+    res = up.nudge_and_save(tmp_path, "flow/20160708",
+                            np.array([5], np.int32),
+                            np.array([3], np.int32), np.array([3]))
+    assert res.stats["model_epoch"] == 1
+    m1 = load_model(tmp_path, "flow/20160708")
+    assert m1.meta["model_epoch"] == 1
+    np.testing.assert_array_equal(m1.arrays["phi_wk"], res.phi_wk)
+
+    bank = ModelBank(capacity=2)
+    bank.add("flow/20160708", m1.arrays["theta"], m1.arrays["phi_wk"],
+             epoch=int(m1.meta["model_epoch"]))
+    assert bank.epoch("flow/20160708") == 1
+    # A second nudge bumps again.
+    up.nudge_and_save(tmp_path, "flow/20160708",
+                      np.array([6], np.int32), np.array([4], np.int32),
+                      np.array([3]))
+    m2 = load_model(tmp_path, "flow/20160708")
+    assert m2.meta["model_epoch"] == 2
+
+
+def test_missing_model_raises(tmp_path):
+    up = OnlineUpdater(LDAConfig(n_topics=8), FeedbackConfig())
+    with pytest.raises(FileNotFoundError):
+        up.nudge_and_save(tmp_path, "flow/19990101",
+                          np.array([0], np.int32), np.array([0], np.int32),
+                          np.array([3]))
+
+
+def test_out_of_band_resave_invalidates_live_bank_cache(tmp_path):
+    """A nudge_and_save (or re-fit) by ANOTHER process must reach a
+    live server: the bank's epoch probe re-reads the persisted stamp
+    per score call, bumps the epoch, and drops the stale host copy —
+    the winner cache can never serve pre-update winners."""
+    from onix.checkpoint import model_meta_epoch
+    from onix.serving.model_bank import (BankService, ModelBank,
+                                         ScoreRequest, TenantModel)
+
+    rng = np.random.default_rng(5)
+    theta, phi = _model(rng, 120, 90)
+    save_model(tmp_path, "flow/20160708", theta, phi)
+
+    def loader(t):
+        m = load_model(tmp_path, t)
+        return None if m is None else TenantModel(
+            m.arrays["theta"], m.arrays["phi_wk"],
+            epoch=int(m.meta.get("model_epoch", 0)))
+
+    bank = ModelBank(capacity=2, loader=loader,
+                     epoch_loader=lambda t: model_meta_epoch(tmp_path, t))
+    svc = BankService(bank)
+    req = ScoreRequest("flow/20160708",
+                       rng.integers(0, 120, 300).astype(np.int32),
+                       rng.integers(0, 90, 300).astype(np.int32),
+                       window="w")
+    (r1,) = svc.score([req], tol=1.0, max_results=16)
+    (r2,) = svc.score([req], tol=1.0, max_results=16)
+    assert r2.cached
+    e_before = bank.epoch("flow/20160708")
+
+    # "Another process": nudge the persisted file out-of-band.
+    top = r2.topk.indices[0]
+    up = OnlineUpdater(LDAConfig(n_topics=8), FeedbackConfig())
+    up.nudge_and_save(tmp_path, "flow/20160708",
+                      np.array([req.doc_ids[top]], np.int32),
+                      np.array([req.word_ids[top]], np.int32),
+                      np.array([3]))
+
+    (r3,) = svc.score([req], tol=1.0, max_results=16)
+    assert not r3.cached                     # stale entry evicted
+    assert bank.epoch("flow/20160708") > e_before
+    # ...and the tables actually reloaded: the dismissed pair's score
+    # rose, so the old top winner is no longer first.
+    assert (r3.topk.indices[0] != top
+            or r3.topk.scores[0] > r2.topk.scores[0])

@@ -327,3 +327,69 @@ def test_n_chains_rejected_for_non_gibbs_engines(cfg):
     cfg.lda.n_chains = 4
     with pytest.raises(ValueError, match="only implemented for the 'gibbs'"):
         fit_engine(cfg, None, "svi")
+
+
+def test_append_feedback_validates_datatype_date_rank(cfg):
+    rows = pd.DataFrame({"ip": ["a"], "word": ["w"], "label": [3]})
+    with pytest.raises(ValueError, match="datatype"):
+        append_feedback(cfg, "netbios", "2016-07-08", rows)
+    with pytest.raises(ValueError, match="bad date"):
+        append_feedback(cfg, "flow", "2016-7-8", rows)
+    bad_rank = pd.DataFrame({"ip": ["a"], "word": ["w"], "label": [3],
+                             "rank": ["seven"]})
+    with pytest.raises(ValueError, match="ranks must be integers"):
+        append_feedback(cfg, "flow", "2016-07-08", bad_rank)
+    with pytest.raises(ValueError, match="ranks must be >= 1"):
+        append_feedback(cfg, "flow", "2016-07-08",
+                        pd.DataFrame({"ip": ["a"], "word": ["w"],
+                                      "label": [3], "rank": [0]}))
+    with pytest.raises(ValueError, match="word ids"):
+        append_feedback(cfg, "flow", "2016-07-08",
+                        pd.DataFrame({"ip": ["a"], "word": ["w"],
+                                      "label": [3], "word_id": [-2]}))
+    # valid ids round-trip through the CSV into the compiled filter
+    from onix.feedback.filter import filter_from_csv, pack_pair
+    path = append_feedback(cfg, "flow", "2016-07-08",
+                           pd.DataFrame({"ip": ["a"], "word": ["w"],
+                                         "label": [3], "doc_id": [4],
+                                         "word_id": [9]}))
+    filt = filter_from_csv(path)
+    assert filt.pair_suppress.tolist() == [pack_pair(4, 9)]
+
+
+def test_two_process_writers_never_tear_the_csv(cfg, tmp_path):
+    """Crash-safety satellite: two separate PROCESSES hammering
+    append_feedback concurrently — every label survives and the file
+    parses at the end (temp-then-rename inside the lock means a reader
+    can never observe a torn CSV)."""
+    import subprocess
+    import sys
+    import textwrap
+
+    fdir = cfg.store.feedback_dir
+    script = textwrap.dedent("""
+        import sys
+
+        import pandas as pd
+
+        from onix.config import load_config
+        from onix.oa.feedback import append_feedback
+
+        tag, fdir = sys.argv[1], sys.argv[2]
+        cfg = load_config(None, [f"store.feedback_dir={fdir}"])
+        for i in range(12):
+            rows = pd.DataFrame({"ip": [f"10.{tag}.0.{i}"],
+                                 "word": [f"w{tag}-{i}"], "label": [3]})
+            append_feedback(cfg, "flow", "2016-07-08", rows)
+    """)
+    procs = [subprocess.Popen([sys.executable, "-c", script, tag, fdir],
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.PIPE)
+             for tag in ("1", "2")]
+    for p in procs:
+        _, err = p.communicate(timeout=120)
+        assert p.returncode == 0, err.decode()
+    got = pd.read_csv(feedback_path(fdir, "flow", "2016-07-08"))
+    assert len(got) == 24
+    assert sorted(got["ip"]) == sorted(
+        f"10.{tag}.0.{i}" for tag in ("1", "2") for i in range(12))
