@@ -850,6 +850,152 @@ def bench_feedback_rescore(jax, jnp, small=False):
     }
 
 
+def bench_campaign_overlap(jax, jnp, small=False):
+    """campaign_overlap: the r14 orchestrator's judged comparison —
+    three datatypes through ingest→fit→score→OA strictly sequentially
+    vs overlapped (one datatype's host prepare riding a worker thread
+    behind the bounded handoff queue while another's fit occupies the
+    device), over the SAME synthetic feeds. Winner sets AND scores are
+    asserted identical between the arms every run (deterministic
+    stages ⇒ the overlapped rate can never come from different
+    detections); barrier-stall seconds (consumer-blocked only — the
+    overlap-exact discipline of obs.OccupancyClock) and per-stage
+    occupancy ride along in detail. Interleaved best-of-2 after a warm
+    pass (the exp_fit_gap weather discipline)."""
+    from onix.pipelines.campaign import run_campaign, winners_identical
+
+    kw = dict(n_events=4_000 if small else 12_000,
+              n_sweeps=4, max_results=100, seed=5, dp=1)
+
+    warm_seq = run_campaign(overlap=False, **kw)
+    warm_ovl = run_campaign(overlap=True, **kw)
+    assert winners_identical(warm_seq, warm_ovl), (
+        "overlapped campaign's winners diverged from the sequential arm")
+    best = {"seq": warm_seq, "ovl": warm_ovl}
+    for _ in range(2):
+        m = run_campaign(overlap=False, **kw)
+        if (m["aggregate"]["wall_seconds"]
+                < best["seq"]["aggregate"]["wall_seconds"]):
+            best["seq"] = m
+        m = run_campaign(overlap=True, **kw)
+        if (m["aggregate"]["wall_seconds"]
+                < best["ovl"]["aggregate"]["wall_seconds"]):
+            best["ovl"] = m
+    seq, ovl = best["seq"]["aggregate"], best["ovl"]["aggregate"]
+    return {
+        "events_per_sec_overlapped": ovl["events_per_second"],
+        "events_per_sec_sequential": seq["events_per_second"],
+        "speedup_overlap_vs_sequential": round(
+            seq["wall_seconds"] / max(ovl["wall_seconds"], 1e-9), 3),
+        "winner_sets_identical": True,
+        "barrier_stall_s_sequential": seq["barrier_stall_s"],
+        "barrier_stall_s_overlapped": ovl["barrier_stall_s"],
+        "stall_improvement_s": round(seq["barrier_stall_s"]
+                                     - ovl["barrier_stall_s"], 3),
+        "occupancy_overlapped": best["ovl"]["occupancy"],
+        "occupancy_sequential": best["seq"]["occupancy"],
+        "stage_sum_identity_ok": (
+            seq["stage_sum_identity_ok"] and ovl["stage_sum_identity_ok"]),
+        "n_datatypes": 3,
+        "events_per_datatype": kw["n_events"],
+        "n_sweeps": kw["n_sweeps"],
+        "wall_seconds": ovl["wall_seconds"],
+        "wall_seconds_sequential": seq["wall_seconds"],
+    }
+
+
+def bench_gibbs_merge_async(jax, jnp, small=False):
+    """gibbs_merge_async: the r14 bounded-staleness merge arm vs the
+    r7 synchronous psum fold on the sharded engine's wrapped
+    (shard_map) superstep path, at the judged product-vocabulary
+    shape. τ=0 bit-identity is asserted every run — the async program
+    (device-varying carry, deferred folds, boundary flush) must
+    reproduce the synchronous fold's state EXACTLY — then sync vs τ=1
+    runs interleaved best-of-2 with the ll parity band asserted.
+
+    At this host's ambient single device the peer deltas are zero, so
+    the comparison measures pure program structure (ring carry +
+    deferred-fold scheduling) and τ=1 stays bit-compatible; the
+    multi-shard regime where the deferred fold stops stalling on real
+    ICI collective latency is queued in docs/TPU_QUEUE.json
+    (`gibbs_merge_async_tpu`) — `n_devices` records which regime this
+    artifact measured."""
+    from onix.config import LDAConfig
+    from onix.corpus import Corpus
+    from onix.models.lda_gibbs import LL_PARITY_BAND
+    from onix.parallel.mesh import make_mesh
+    from onix.parallel.sharded_gibbs import ShardedGibbsLDA
+
+    n_vocab, k = 512, 20
+    n_tokens = 1 << 20 if small else 1 << 22
+    n_docs = 20_000 if small else 80_000
+    n_sweeps = 8
+    block = 1 << 17
+
+    rng = np.random.default_rng(4)
+    corpus = Corpus(
+        doc_ids=rng.integers(0, n_docs, n_tokens).astype(np.int32),
+        word_ids=rng.integers(0, n_vocab, n_tokens).astype(np.int32),
+        n_docs=n_docs, n_vocab=n_vocab)
+    n_dev = len(jax.devices())
+    mesh = make_mesh(dp=n_dev, mp=1)
+
+    def make_arm(merge_form, tau):
+        cfg = LDAConfig(n_topics=k, n_sweeps=n_sweeps,
+                        burn_in=n_sweeps // 2, block_size=block, seed=0,
+                        merge_form=merge_form, merge_staleness=tau)
+        return ShardedGibbsLDA(cfg, n_vocab, mesh=mesh)
+
+    m_sync = make_arm("sync", 0)
+    m_tau0 = make_arm("async", 0)
+    m_tau1 = make_arm("async", 1)
+    # ONE shared layout + device transfer: the merge knobs change the
+    # compiled superstep, not the corpus sharding, so all three arms
+    # sweep the identical device-resident blocks (which is also what
+    # makes the tau=0 state comparison bit-exact by construction).
+    sc = m_sync.prepare(corpus)
+    dev = m_sync.device_corpus(sc)
+
+    def run(model):
+        st, ll = model._superstep_shardmap(model.init_state(sc), *dev,
+                                           0, n_steps=n_sweeps)
+        return st, float(ll)
+
+    st_sync, ll_sync = run(m_sync)            # compile + warm
+    st_tau0, _ = run(m_tau0)
+    st_tau1, ll_tau1 = run(m_tau1)
+    for name in st_sync._fields:
+        assert np.array_equal(np.asarray(getattr(st_sync, name)),
+                              np.asarray(getattr(st_tau0, name))), (
+            f"async tau=0 {name} diverged from the synchronous fold")
+    assert abs(ll_tau1 - ll_sync) < LL_PARITY_BAND * abs(ll_sync), (
+        f"async tau=1 out of the ll band: {ll_tau1} vs {ll_sync}")
+
+    best = {"sync": float("inf"), "tau1": float("inf")}
+    for _ in range(2):
+        for name, model in (("sync", m_sync), ("tau1", m_tau1)):
+            t0 = time.perf_counter()
+            st, _ = run(model)
+            np.asarray(st.n_k)            # forces completion
+            best[name] = min(best[name], time.perf_counter() - t0)
+    return {
+        "tokens_per_sec_async_tau1": round(
+            n_sweeps * n_tokens / best["tau1"], 1),
+        "tokens_per_sec_sync_fold": round(
+            n_sweeps * n_tokens / best["sync"], 1),
+        "async_speedup_vs_sync": round(best["sync"] / best["tau1"], 3),
+        "tau0_bit_identical": True,
+        "ll_parity_band_ok": True,
+        "ll_sync": round(ll_sync, 4), "ll_async_tau1": round(ll_tau1, 4),
+        "n_devices": n_dev, "mesh": {"dp": n_dev, "mp": 1},
+        "n_tokens": n_tokens, "n_sweeps": n_sweeps,
+        "n_docs": n_docs, "n_vocab": n_vocab, "n_topics": k,
+        "block_size": block,
+        "wall_seconds": round(best["tau1"], 3),
+        "wall_seconds_sync_fold": round(best["sync"], 3),
+    }
+
+
 def _roofline_detail(detail: dict) -> dict | None:
     """detail.roofline: achieved bytes/s + fraction-of-peak for the two
     judged hot loops, from each component's modeled per-item traffic
@@ -1264,6 +1410,18 @@ def _measure() -> None:
     # queued in docs/TPU_QUEUE.json `feedback_rescore_tpu`).
     run("feedback_rescore",
         lambda: bench_feedback_rescore(jax, jnp, small=fallback))
+    # The r14 campaign orchestrator: sequential vs overlapped
+    # three-datatype runs over the same feeds, winner parity asserted,
+    # barrier-stall + occupancy counters in detail (docs/PERF.md
+    # "async merge + campaign overlap").
+    run("campaign_overlap",
+        lambda: bench_campaign_overlap(jax, jnp, small=fallback))
+    # The r14 bounded-staleness merge arm: sync vs τ=1 interleaved
+    # best-of with the τ=0 bit-identity asserted per run (the
+    # multi-shard collective-latency rows are queued in
+    # docs/TPU_QUEUE.json `gibbs_merge_async_tpu`).
+    run("gibbs_merge_async",
+        lambda: bench_gibbs_merge_async(jax, jnp, small=fallback))
     # Roofline accounting over whatever components completed — bytes/s
     # and fraction-of-peak become tracked numbers (docs/PERF.md), so a
     # throughput regression is a falling fraction, not a prose claim.

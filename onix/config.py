@@ -123,6 +123,33 @@ class LDAConfig:
     # arm (LightLDA-style cycle length). More proposals mix faster per
     # sweep at linearly more per-token cost.
     sparse_mh: int = 2
+    # Sharded-engine count-merge form (r14; ROADMAP item 5's AD-LDA
+    # extension, arxiv 0909.4603). "sync" keeps the synchronous psum
+    # fold: every merge window (sync group) ends in a full-barrier
+    # collective whose result gates the next window's sampling — the
+    # reference's MPI_Reduce+Bcast cadence. "async" is the bounded-
+    # staleness exchange: each shard sweeps against a count view that
+    # carries its OWN updates fresh and its peers' deltas up to
+    # merge_staleness merge windows late (Streaming Gibbs Sampling for
+    # LDA, arxiv 1601.01142, gives the quality argument for sweeping on
+    # bounded-stale counts), so the collective at window t no longer
+    # gates the sampling of window t+1..t+τ and XLA can overlap it with
+    # compute instead of stalling the pipeline. All pending deltas
+    # flush at every fused-superstep boundary, so superstep-boundary
+    # counts (checkpoints, the boundary ll, the accumulators) are
+    # EXACT global counts in both forms. τ=0 degenerates to a path
+    # bit-identical to the synchronous fold (tested); τ>0 is a
+    # different chain with the same stationary target, held to the
+    # LL_PARITY_BAND + winner-parity contract. The RESOLVED merge form
+    # joins both engines' checkpoint fingerprints: a resume across a
+    # merge-form/τ change is refused, and sync contributes nothing so
+    # pre-r14 checkpoints keep resuming.
+    merge_form: str = "sync"
+    # Merge windows a peer delta may lag in the async arm (τ). A delta
+    # produced at merge window t folds in at window t+τ — never later
+    # (ring FIFO, sharded_gibbs.ring_push) — or at the superstep
+    # flush, whichever comes first. Ignored under merge_form="sync".
+    merge_staleness: int = 1
     # Streaming local-update family: "svi" (Hoffman's uncollapsed
     # variational E-step — the default, unchanged) or "scvb0" (the
     # SCVB0 collapsed zeroth-order minibatch arm, arxiv 1305.2452 —
@@ -173,6 +200,11 @@ class LDAConfig:
             raise ValueError(
                 "lda.stream_estep must be svi|scvb0, "
                 f"got {self.stream_estep!r}")
+        if self.merge_form not in ("sync", "async"):
+            raise ValueError(
+                f"lda.merge_form must be sync|async, got {self.merge_form!r}")
+        if self.merge_staleness < 0:
+            raise ValueError("lda.merge_staleness must be >= 0")
 
 
 @dataclass

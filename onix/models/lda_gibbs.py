@@ -329,6 +329,22 @@ def sampler_fingerprint(form: str, sparse_active: int,
             "sparse": [int(sparse_active), int(sparse_mh)]}
 
 
+def merge_fingerprint(form: str, staleness: int) -> dict:
+    """Checkpoint-identity entry for the RESOLVED count-merge form
+    (r14; shared by GibbsLDA and ShardedGibbsLDA fit, mirroring
+    sampler_fingerprint). Sync contributes NOTHING: the synchronous
+    fold is bit-identical to the pre-r14 code, so pre-r14 checkpoints
+    keep resuming. The async arm adds the form plus its live staleness
+    bound τ (τ>0 changes what the chain samples; τ=0 is bit-identical
+    to sync but still a distinct configuration whose resume the spec
+    refuses rather than silently crossing) — which is also what
+    refuses a resume across a merge-form/τ change in either
+    direction."""
+    if form != "async":
+        return {}
+    return {"merge": [form, int(staleness)]}
+
+
 def _resolved_sampler_form(sampler_form: str | None, *, k_topics: int,
                            pinned: bool) -> str:
     """The ONE deference chain behind every sampler-form decision —
@@ -1175,9 +1191,18 @@ class GibbsLDA:
         # dense checkpoints keep resuming.
         fp = ckpt.fingerprint(cfg, self.n_docs, self.n_vocab,
                               corpus.n_tokens, superstep=S,
-                              extra=sampler_fingerprint(
-                                  self.sampler_form, self.sparse_active,
-                                  cfg.sparse_mh))
+                              extra={**sampler_fingerprint(
+                                         self.sampler_form,
+                                         self.sparse_active,
+                                         cfg.sparse_mh),
+                                     # Merge form: inert on one device
+                                     # (no peers), but the identity rule
+                                     # is shared with the sharded engine
+                                     # — a merge-form/τ change refuses
+                                     # the resume on BOTH engines.
+                                     **merge_fingerprint(
+                                         cfg.merge_form,
+                                         cfg.merge_staleness)})
         # Per-fingerprint subdir: checkpoints of runs with a different
         # identity can neither be adopted nor pruned by this run.
         if checkpoint_dir is not None:

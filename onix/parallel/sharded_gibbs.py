@@ -21,7 +21,20 @@ iteration. Here:
   MPI_Reduce + MPI_Bcast with XLA collectives (BASELINE.json north star
   names this exact mapping): topic-word chunk deltas reduce over the
   data axes (ICI within a slice, DCN across), doc-topic deltas reduce
-  over mp, and topic totals over both.
+  over mp, and topic totals over both;
+- `lda.merge_form = "async"` (r14) swaps the full-barrier fold for the
+  AD-LDA-style bounded-staleness exchange (arxiv 0909.4603; quality
+  argument arxiv 1601.01142): each shard's count view carries its OWN
+  updates fresh while peers' psum'd deltas ride a τ-deep FIFO
+  (`ring_push`) and fold in exactly `lda.merge_staleness` merge
+  windows late — so the collective issued at window t no longer gates
+  the sampling of windows t+1..t+τ and XLA overlaps it with compute
+  instead of stalling the superstep at every barrier. All pending
+  deltas flush at the fused-superstep boundary, so boundary counts
+  (checkpoints, the boundary ll, the accumulators) are EXACT global
+  counts in both forms, and τ=0 degenerates to a program whose count
+  arithmetic is bit-identical to the synchronous fold (int32 adds are
+  exact and commutative; asserted in tests/test_merge_async.py).
 
 Equivalence: with one device this is bit-identical in distribution to
 the single-device engine; tests assert count invariants and topic
@@ -153,6 +166,31 @@ def shard_corpus(corpus: Corpus, n_data: int, block_size: int,
     )
 
 
+def ring_push(ring, delta):
+    """Bounded-staleness FIFO step for the async merge arm: returns
+    (entry folding NOW, new ring). A peer delta pushed at merge window
+    t is emitted at window t+τ where τ == ring.shape[0] — exactly τ
+    windows late, NEVER later (the staleness bound is the ring length,
+    a static property of the compiled program; the superstep flush
+    folds whatever is still pending at the boundary, so a delta's
+    realized lag is min(τ, windows to the boundary)). `ring is None`
+    spells τ=0: the delta folds immediately, which is what makes the
+    τ=0 arm's count arithmetic bit-identical to the synchronous fold.
+    Pure function of arrays — unit-tested directly
+    (tests/test_merge_async.py::test_ring_push_staleness_bound)."""
+    if ring is None:                    # tau == 0: immediate fold
+        return delta, None
+    return ring[0], jnp.concatenate([ring[1:], delta[None]], axis=0)
+
+
+def _ring_sum(ring):
+    """Sum of a ring's pending entries (0 for the τ=0 spelling) — the
+    flush term that turns a shard's stale view back into exact global
+    counts: view + pending == N(0) + Σ all shards' deltas so far, at
+    every merge-window boundary."""
+    return 0 if ring is None else ring.sum(axis=0)
+
+
 def chunked_to_global_nwk(nwk_chunks: np.ndarray, n_vocab: int) -> np.ndarray:
     """[M, Vc, K] chunked counts -> [V, K] global (w = local*M + chunk)."""
     m, vc, k = nwk_chunks.shape
@@ -255,6 +293,16 @@ class ShardedGibbsLDA:
         self.sampler_form, self.sparse_active, sampler_kw = \
             lda_gibbs.resolve_sampler(config, k_topics=k,
                                       nwk_form=nwk_form)
+        # Count-merge form (r14): resolved once at construction like
+        # the sampler form — the value feeds the compiled superstep AND
+        # the checkpoint fingerprint (merge_fingerprint), so the
+        # program and the resume identity can never disagree. τ is
+        # pinned to 0 under sync so the fingerprint entry (async only)
+        # is a function of what actually runs.
+        self.merge_form = config.merge_form
+        use_async = self.merge_form == "async"
+        tau = int(config.merge_staleness) if use_async else 0
+        self.merge_tau = tau
         # shard_map has no replication rule for pallas_call, so the
         # sweep-carrying shard regions must drop the static replication
         # check whenever the Pallas form CAN be traced (explicitly
@@ -265,6 +313,13 @@ class ShardedGibbsLDA:
         # Evaluated at TRACE time, right where make_block_step resolves
         # the same form, so the two decisions always read the same env.
         def sweep_smap_kw():
+            # The async merge arm's count views are genuinely device-
+            # VARYING mid-superstep (own deltas fresh, peers' stale) and
+            # only the boundary flush restores replication-in-value, so
+            # the static replication linter has nothing true to check —
+            # drop it, exactly as the pallas arm must.
+            if use_async:
+                return {_SHARD_MAP_CHECK_KW: False}
             form = (nwk_form if nwk_form is not None
                     else lda_gibbs.env_nwk_form())
             maybe_pallas = (
@@ -319,6 +374,67 @@ class ShardedGibbsLDA:
                 group_step, (n_dk_l, n_wk_l, n_k_l, key_c),
                 (d_g, w_g, m_g, z_g))
             return z_out, ndk_f, nwk_f, nk_f, key_f
+
+        def _zero_rings(n_dk_l, n_wk_l, n_k_l):
+            """Fresh pending-delta FIFOs at superstep entry: τ slots of
+            zeros per collective-reduced table — peers' first τ windows
+            of deltas arrive late by construction. n_dk only rides a
+            ring when mp shards exist (without mp every shard owns its
+            docs' rows outright: no collective, no staleness)."""
+            if tau == 0:
+                return (None, None, None)
+            mk = lambda a: jnp.zeros((tau,) + a.shape, a.dtype)
+            return (mk(n_dk_l) if M else None, mk(n_wk_l), mk(n_k_l))
+
+        def _group_sweep_async(z_g, n_dk_l, n_wk_l, n_k_l, key_c,
+                               d_g, w_g, m_g, rings):
+            """The bounded-staleness rendering of _group_sweep: the
+            count carry is each shard's VIEW (own updates fresh; peer
+            deltas folded from the ring exactly τ windows late), not
+            the replicated fold. The psum still issues every window —
+            its RESULT just stops gating the next window's sampling
+            for τ>0, which is the stall the async arm removes. At τ=0
+            the ring is the identity and the arithmetic
+            (view + own + (psum − own) == base + psum) is bit-identical
+            to _group_sweep's fold in exact int32. View + pending ==
+            exact global counts at every window boundary — the
+            invariant the superstep flush and the accumulator fold
+            lean on."""
+            def group_step(carry, xs):
+                ndk_v, nwk_v, nk_v, key_c, rg = carry
+                r_dk, r_wk, r_k = rg
+                dg, wg, mg, zg = xs
+
+                def one_chain(zc, ndkc, nwkc, nkc, keyc):
+                    return _local_sweep(
+                        zc, ndkc, nwkc, nkc, keyc, dg, wg, mg,
+                        alpha=config.alpha, eta=config.eta,
+                        n_vocab=n_vocab, k_topics=k, nwk_form=nwk_form,
+                        **sampler_kw)
+
+                z_new, ndk_new, nwk_new, nk_new, key_new = \
+                    jax.vmap(one_chain)(zg, ndk_v, nwk_v, nk_v, key_c)
+                # Peers' deltas = the collective total minus our own;
+                # own deltas stay in the view immediately (the AD-LDA
+                # discipline — a shard is never stale w.r.t. itself).
+                own_wk = nwk_new - nwk_v
+                peer_wk = jax.lax.psum(own_wk, D) - own_wk
+                own_k = nk_new - nk_v
+                peer_k = jax.lax.psum(own_k, both) - own_k
+                fold_wk, r_wk = ring_push(r_wk, peer_wk)
+                fold_k, r_k = ring_push(r_k, peer_k)
+                if M:
+                    own_dk = ndk_new - ndk_v
+                    peer_dk = jax.lax.psum(own_dk, M) - own_dk
+                    fold_dk, r_dk = ring_push(r_dk, peer_dk)
+                    ndk_new = ndk_new + fold_dk
+                return (ndk_new, nwk_new + fold_wk, nk_new + fold_k,
+                        key_new, (r_dk, r_wk, r_k)), z_new
+
+            (ndk_f, nwk_f, nk_f, key_f, rings_f), z_out = jax.lax.scan(
+                group_step, (n_dk_l, n_wk_l, n_k_l, key_c, rings),
+                (d_g, w_g, m_g, z_g))
+            return z_out, ndk_f, nwk_f, nk_f, key_f, rings_f
 
         def _grouped(d, w, m, z):
             """Shard-local token blocks + z in sync-group layout."""
@@ -483,6 +599,110 @@ class ShardedGibbsLDA:
                 return new_state, (sm0 / jnp.maximum(t0, 1.0)).mean(), ll
             return new_state, ll
 
+        def superstep_async_fn(state: ShardedGibbsState, docs, words,
+                               mask, start, n_steps: int,
+                               with_initial_ll=False):
+            """The bounded-staleness superstep (merge_form="async"):
+            identical host contract to superstep_fn — same inputs, same
+            outputs, same out_specs — with the sweep chain riding
+            _group_sweep_async's stale views and the pending-delta
+            rings FLUSHED before anything returns, so the state handed
+            back (and checkpointed, and ll-evaluated, and accumulated)
+            is exact replicated global counts. The accumulator fold at
+            each sweep boundary adds view + pending — the exact counts
+            at that boundary — so posterior means are computed from the
+            same count semantics as the sync arm's. τ=0 compiles a
+            genuinely different program (varying carry, deferred-fold
+            structure) whose results are bit-identical to superstep_fn
+            (tests/test_merge_async.py); τ>0 is a different chain with
+            the same stationary target, held to the ll band + winner
+            parity contract."""
+            def shard_fn(z, n_dk, n_wk, n_k, keys, accd, accw, nacc,
+                         d, w, m, start_s):
+                d_g, w_g, m_g, z_g, C, nb, B = _grouped(d, w, m, z)
+                zero = jnp.float32(0)
+                d0, w0, m0 = d[0, 0], w[0, 0], m[0, 0]
+                if with_initial_ll:
+                    # Incoming counts are exact (superstep boundaries
+                    # always flush), so the pre-sweep ll needs no
+                    # staleness correction.
+                    sm0, t0 = _chain_ll_local(n_dk[0], n_wk[0], n_k,
+                                              d0, w0, m0, zero)
+                    sm0 = jax.lax.psum(sm0, both)
+                    t0 = jax.lax.psum(t0, both)
+
+                def one_sweep(carry, i):
+                    (zg, ndk_r, nwk_r, nk_r, key_c, rings,
+                     ad, aw, na) = carry
+                    zg, ndk_r, nwk_r, nk_r, key_c, rings = \
+                        _group_sweep_async(zg, ndk_r, nwk_r, nk_r,
+                                           key_c, d_g, w_g, m_g, rings)
+                    r_dk, r_wk, r_k = rings
+                    do = start_s + i >= burn
+                    do_f = do.astype(jnp.float32)
+                    # Accumulate EXACT boundary counts (view + pending)
+                    # so the posterior-mean estimator is arm-invariant
+                    # in semantics AND replicated-in-value where the
+                    # out_specs demand it (acc_ndk over mp, acc_nwk
+                    # over the data axes).
+                    ndk_x = ndk_r + _ring_sum(r_dk) if M else ndk_r
+                    ad = ad + do_f * ndk_x.astype(jnp.float32)
+                    aw = aw + do_f * ((nwk_r + _ring_sum(r_wk))
+                                      .astype(jnp.float32))
+                    na = na + do.astype(jnp.int32)
+                    return (zg, ndk_r, nwk_r, nk_r, key_c, rings,
+                            ad, aw, na), None
+
+                carry0 = (z_g, n_dk[0], n_wk[0], n_k, keys[0, 0],
+                          _zero_rings(n_dk[0], n_wk[0], n_k),
+                          accd[0], accw[0], nacc)
+                (z_g2, ndk_f, nwk_f, nk_f, key_f, rings_f,
+                 ad, aw, na), _ = jax.lax.scan(
+                    one_sweep, carry0,
+                    jnp.arange(n_steps, dtype=jnp.int32))
+                # The boundary FLUSH: fold every still-pending peer
+                # delta, restoring exact replicated global counts —
+                # what the host contract (and the ll below) reads.
+                r_dk, r_wk, r_k = rings_f
+                if M:
+                    ndk_f = ndk_f + _ring_sum(r_dk)
+                nwk_f = nwk_f + _ring_sum(r_wk)
+                nk_f = nk_f + _ring_sum(r_k)
+                sm, t = _chain_ll_local(ndk_f, nwk_f, nk_f,
+                                        d0, w0, m0, zero)
+                sm, t = jax.lax.psum(sm, both), jax.lax.psum(t, both)
+                z_full = z_g2.swapaxes(0, 1).reshape(C, nb, B)
+                outs = (z_full[None, None], ndk_f[None], nwk_f[None],
+                        nk_f, key_f[None, None], ad[None], aw[None],
+                        na, sm, t)
+                return outs + ((sm0, t0) if with_initial_ll else ())
+
+            out_specs = (P(D, *mp_spec), P(D), P(*mp_spec), P(),
+                         P(D, *mp_spec), P(D), P(*mp_spec), P(),
+                         P(), P())
+            if with_initial_ll:
+                out_specs = out_specs + (P(), P())
+            outs = _shard_map(
+                shard_fn, mesh=self.mesh,
+                in_specs=(P(D, *mp_spec), P(D), P(*mp_spec), P(),
+                          P(D, *mp_spec), P(D), P(*mp_spec), P(),
+                          P(D, *mp_spec), P(D, *mp_spec),
+                          P(D, *mp_spec), P()),
+                out_specs=out_specs,
+                **sweep_smap_kw(),
+            )(state.z, state.n_dk, state.n_wk, state.n_k, state.keys,
+              state.acc_ndk, state.acc_nwk, state.n_acc,
+              docs, words, mask, jnp.asarray(start, jnp.int32))
+            z, n_dk, n_wk, n_k, keys, accd, accw, nacc, sm, t = outs[:10]
+            new_state = ShardedGibbsState(
+                z=z, n_dk=n_dk, n_wk=n_wk, n_k=n_k, keys=keys,
+                acc_ndk=accd, acc_nwk=accw, n_acc=nacc)
+            ll = (sm / jnp.maximum(t, 1.0)).mean()
+            if with_initial_ll:
+                sm0, t0 = outs[10:]
+                return new_state, (sm0 / jnp.maximum(t0, 1.0)).mean(), ll
+            return new_state, ll
+
         def superstep_dp1_fn(state: ShardedGibbsState, docs, words, mask,
                              start, n_steps: int, with_initial_ll=False):
             """dp=1/mp=1 fast path: the identical superstep math with NO
@@ -573,15 +793,27 @@ class ShardedGibbsLDA:
         import os
         self.dp1_fast = (self.n_data == 1 and self.n_mp == 1
                          and os.environ.get("ONIX_DP1_FAST") != "0")
+        # Merge-form dispatch: the dp=1/mp=1 fast path has no peers so
+        # async ≡ sync there bit-for-bit (the fast path IS the τ=0
+        # degenerate on one device); off the fast path the async form
+        # swaps superstep_fn for the bounded-staleness program. The
+        # per-sweep _sweep dispatch keeps the synchronous fold on every
+        # form — it exists for the pre-r7 cross-check arms, and a merge
+        # window shorter than its dispatch cannot overlap anything.
+        wrapped_superstep = (superstep_async_fn if use_async
+                            else superstep_fn)
         self._superstep = jax.jit(
-            superstep_dp1_fn if self.dp1_fast else superstep_fn,
+            superstep_dp1_fn if self.dp1_fast else wrapped_superstep,
             static_argnames=("n_steps", "with_initial_ll"),
             donate_argnums=(0,))
         # The shard_map superstep stays constructible regardless, for
         # the fast-path equality tests and the pre-PR bench arm (no
-        # donation: test callers reuse their input states).
+        # donation: test callers reuse their input states). It carries
+        # the RESOLVED merge form, so a dp=1 async model can still be
+        # compared bit-for-bit against a sync model's wrapped path.
         self._superstep_shardmap = jax.jit(
-            superstep_fn, static_argnames=("n_steps", "with_initial_ll"))
+            wrapped_superstep,
+            static_argnames=("n_steps", "with_initial_ll"))
         self._mp_axis = M
 
     # -- sharding specs ----------------------------------------------------
@@ -722,7 +954,16 @@ class ShardedGibbsLDA:
                                      **lda_gibbs.sampler_fingerprint(
                                          self.sampler_form,
                                          self.sparse_active,
-                                         cfg.sparse_mh)},
+                                         cfg.sparse_mh),
+                                     # RESOLVED merge form (r14): τ>0
+                                     # is a different chain, and even
+                                     # the bit-identical τ=0 async arm
+                                     # refuses a cross-form resume by
+                                     # spec; sync contributes nothing
+                                     # so pre-r14 checkpoints resume.
+                                     **lda_gibbs.merge_fingerprint(
+                                         self.merge_form,
+                                         self.merge_tau)},
                               superstep=S_step)
         if checkpoint_dir is not None:
             import pathlib

@@ -1,0 +1,336 @@
+"""Overlapped multi-datatype campaign orchestrator (r14; ROADMAP item 5).
+
+The scale runner (scale.py) executes ONE datatype end-to-end and the
+three judged pipelines ran strictly sequentially: flow's host
+synthesize/word-build/corpus-build finished before flow's device fit
+started, and dns's host work waited for flow's fit to drain — on the
+measured host-bound pattern (docs/PERF.md r10: ~0.5 s/batch of host
+decode/convert on the cores XLA also uses) that serializes host work
+against device compute instead of overlapping it. This orchestrator
+composes the pieces ROADMAP item 5 names — the sharded Gibbs engine
+(sync or r14 async bounded-staleness merge), device scoring, and the
+r9 resilience layer — into one campaign over flow+dns+proxy where one
+datatype's host PREPARE stage (synthesize → word build → corpus build)
+runs on a worker thread while another datatype's FIT occupies the
+device, behind a bounded in-order queue (the depth-k prefetcher's
+backpressure discipline, streaming.py ColumnPrefetcher).
+
+Accounting is overlap-exact (utils/obs.OccupancyClock): per-stage,
+per-datatype busy seconds; `prepare_wait` counts CONSUMER-BLOCKED
+seconds only (the orchestration-level barrier stall — what the
+overlapped arm exists to shrink); `overlap_s` counts genuinely
+concurrent stage seconds; and the driver thread's stage-sum identity
+(Σ busy + Σ blocked + idle == span) is asserted every run.
+
+Fault semantics (docs/ROBUSTNESS.md "campaign fault plan"): the
+engine-level sites stay live — `fit:sweep` preemptions land on
+superstep boundaries, which are exactly the async arm's merge-flush
+boundaries, and `ckpt:save=torn` exercises the digest fallback — and
+the campaign adds `campaign:prepare` (a poisoned input batch, absorbed
+by one bounded retry like the watcher's poison path). A preempted fit
+retries through its per-datatype checkpoint dir, so a fault-riddled
+campaign resumes to artifacts identical to the fault-free run in the
+exact (sync / async τ=0) arm, and to in-band artifacts in the async
+τ>0 arm (a mid-superstep preemption re-segments the merge windows —
+the chain is segmentation-dependent for τ>0 by construction).
+
+Every stage is the production code path: the *_words_from_arrays
+builders, build_corpus, ShardedGibbsLDA, select_suspicious_events.
+Nothing here is a special-cased benchmark kernel.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import queue
+import threading
+import time
+
+import numpy as np
+
+from onix.config import DATATYPES, LDAConfig
+from onix.pipelines.corpus_build import build_corpus, select_suspicious_events
+from onix.pipelines.scale import _default_anomalies, _words_from_cols
+from onix.pipelines.synth import SYNTH_ARRAYS
+from onix.utils import faults
+from onix.utils.obs import OccupancyClock, counters
+
+#: Campaign manifest schema — stamped so downstream evidence JSONs are
+#: self-describing (the r3-era SCALE_1B artifacts carried no topology).
+CAMPAIGN_SCHEMA = 1
+
+#: Bounded retries for a preempted fit: every retry resumes from the
+#: per-datatype checkpoint dir (or replays deterministically without
+#: one), and fault-plan rules are one-shot, so this bound only guards
+#: against a plan that preempts more often than it can make progress.
+_MAX_FIT_ATTEMPTS = 8
+
+
+class _Prepared:
+    """One datatype's host-side inputs, ready for the device stages."""
+
+    def __init__(self, datatype: str, cols: dict, bundle, planted: set):
+        self.datatype = datatype
+        self.cols = cols
+        self.bundle = bundle
+        self.planted = planted
+
+
+def _prepare(datatype: str, n_events: int, n_hosts: int, n_anomalies: int,
+             seed: int, gen_arrays) -> _Prepared:
+    """The host PREPARE stage: synthesize → word build → corpus build.
+    `campaign:prepare` is the fault site (a poisoned input batch); one
+    bounded retry absorbs a raise — the same recover-don't-crash rule
+    as the watcher's poison path — because the synthesizer is
+    deterministic in seed, so the retry reproduces the same batch."""
+    for attempt in (0, 1):
+        try:
+            faults.fire("campaign", "prepare")
+            break
+        except faults.InjectedFault:
+            counters.inc("campaign.prepare_retry")
+            if attempt:
+                raise
+    cols = gen_arrays[datatype](n_events, n_hosts=n_hosts,
+                                n_anomalies=n_anomalies, seed=seed)
+    wt = _words_from_cols(datatype, cols)
+    bundle = build_corpus(wt)
+    planted = set(cols["anomaly_idx"].tolist())
+    return _Prepared(datatype, cols, bundle, planted)
+
+
+def run_campaign(n_events: int, datatypes=DATATYPES, n_hosts: int | None = None,
+                 n_anomalies: int | None = None, n_sweeps: int = 8,
+                 n_topics: int = 20, max_results: int = 500, seed: int = 0,
+                 n_chains: int = 1, overlap: bool = True,
+                 overlap_depth: int = 1, merge_form: str = "sync",
+                 merge_staleness: int = 1, dp: int = 0,
+                 generator: str = "mixture",
+                 resume_dir: str | pathlib.Path | None = None,
+                 out_path: str | pathlib.Path | None = None) -> dict:
+    """One orchestrated ingest→fit→score→OA campaign over `datatypes`.
+
+    `overlap=True` pipelines datatype d+1's host prepare against
+    datatype d's device fit/score (bounded at `overlap_depth` prepared
+    datatypes in flight); `overlap=False` is the sequential control —
+    the SAME stages on the driver thread, so the two arms' artifacts
+    are identical (deterministic in seed) and the accounting delta is
+    pure orchestration. `merge_form`/`merge_staleness` select the
+    sharded engine's count-merge arm (LDAConfig r14 gate). `dp=0`
+    shards the fit over every visible device."""
+    import jax
+
+    from onix.parallel.mesh import make_mesh
+    from onix.parallel.sharded_gibbs import ShardedGibbsLDA
+
+    if generator == "sessions":
+        from onix.pipelines.synth2 import SYNTH2_ARRAYS as gen_arrays
+    elif generator == "mixture":
+        gen_arrays = SYNTH_ARRAYS
+    else:
+        raise ValueError(f"unknown generator {generator!r}; "
+                         "expected 'mixture' or 'sessions'")
+    datatypes = tuple(datatypes)
+    unknown = set(datatypes) - set(DATATYPES)
+    if unknown:
+        raise ValueError(f"unknown datatypes {sorted(unknown)}")
+    if n_hosts is None:
+        n_hosts = max(120, min(200_000, n_events // 500))
+    if n_anomalies is None:
+        n_anomalies = _default_anomalies(n_events)
+
+    n_dev = len(jax.devices()) if dp <= 0 else dp
+    mesh = make_mesh(dp=n_dev, mp=1, devices=jax.devices()[:n_dev])
+    from onix.models.lda_gibbs import SUPERSTEP_DEFAULT
+    cfg = LDAConfig(n_topics=n_topics, n_sweeps=n_sweeps,
+                    burn_in=max(1, n_sweeps // 2),
+                    block_size=1 << 17, seed=seed, n_chains=n_chains,
+                    merge_form=merge_form, merge_staleness=merge_staleness,
+                    # Superstep-cadence checkpoints whenever a resume
+                    # dir exists: preemptions land on superstep (==
+                    # merge-flush) boundaries and resume from the last
+                    # completed one instead of repaying the fit. Capped
+                    # at half the sweep budget so harness-scale runs
+                    # (sweeps < SUPERSTEP_DEFAULT) still checkpoint —
+                    # a cadence past n_sweeps would never save and a
+                    # preempted tiny fit would replay from scratch.
+                    checkpoint_every=(min(SUPERSTEP_DEFAULT,
+                                          max(1, n_sweeps // 2))
+                                      if resume_dir is not None else 0))
+
+    clock = OccupancyClock()
+    per_dt: dict[str, dict] = {}
+    dp1_fast = None
+    fit_preemptions = 0
+
+    def seed_of(i: int) -> int:
+        # Distinct per-datatype streams; deterministic across arms.
+        return seed + 7919 * i
+
+    # -- the prepare pipeline (worker thread, bounded in-order queue) --
+    handoff: queue.Queue = queue.Queue(maxsize=max(1, overlap_depth))
+
+    def producer():
+        for i, dt in enumerate(datatypes):
+            try:
+                with clock.busy(f"{dt}.prepare"):
+                    item = _prepare(dt, n_events, n_hosts, n_anomalies,
+                                    seed_of(i), gen_arrays)
+            except BaseException as e:          # noqa: BLE001 — relayed
+                counters.inc("campaign.prepare_failed")
+                handoff.put((dt, e))            # relayed to the driver,
+                return                          # which raises it in-order
+            handoff.put((dt, item))
+
+    worker = None
+    if overlap:
+        worker = threading.Thread(target=producer, name="campaign-prepare",
+                                  daemon=True)
+        worker.start()
+
+    def next_prepared(i: int, dt: str) -> _Prepared:
+        if not overlap:
+            with clock.busy(f"{dt}.prepare"):
+                return _prepare(dt, n_events, n_hosts, n_anomalies,
+                                seed_of(i), gen_arrays)
+        with clock.blocked("prepare_wait"):
+            got_dt, item = handoff.get()
+        assert got_dt == dt, f"prepare handoff out of order: {got_dt}!={dt}"
+        if isinstance(item, BaseException):
+            raise item
+        return item
+
+    t_loop = time.perf_counter()
+    events_total = 0
+    for i, dt in enumerate(datatypes):
+        prep = next_prepared(i, dt)
+        corpus = prep.bundle.corpus
+        model = ShardedGibbsLDA(cfg, corpus.n_vocab, mesh=mesh)
+        dp1_fast = bool(getattr(model, "dp1_fast", False))
+        ckpt_dir = (pathlib.Path(resume_dir) / dt / "fit_ckpt"
+                    if resume_dir is not None else None)
+        with clock.busy(f"{dt}.fit"):
+            from onix.checkpoint import SimulatedPreemption
+            attempts = 0
+            while True:
+                try:
+                    fit = model.fit(corpus, checkpoint_dir=ckpt_dir)
+                    break
+                except SimulatedPreemption:
+                    # The drill: resume from the last superstep-boundary
+                    # checkpoint (or replay deterministically without
+                    # one) instead of dying like the reference's MPI job.
+                    counters.inc("campaign.fit_preempted")
+                    fit_preemptions += 1
+                    attempts += 1
+                    if attempts >= _MAX_FIT_ATTEMPTS:
+                        raise
+        theta, phi_wk = fit["theta"], fit["phi_wk"]
+        with clock.busy(f"{dt}.score"):
+            top = select_suspicious_events(prep.bundle, theta, phi_wk,
+                                           n_events, tol=1.0,
+                                           max_results=max_results)
+            idx = np.asarray(top.indices)
+            scores = np.asarray(top.scores)
+        with clock.busy(f"{dt}.oa"):
+            keep = idx >= 0
+            hits = len(prep.planted & set(idx[keep].tolist()))
+            finite = scores[np.isfinite(scores)]
+            per_dt[dt] = {
+                "n_events": n_events,
+                "n_docs": int(corpus.n_docs),
+                "n_vocab": int(corpus.n_vocab),
+                "n_tokens": int(corpus.n_tokens),
+                "planted_anomalies": len(prep.planted),
+                "planted_in_bottom_k": hits,
+                "selected_score_range": (
+                    [float(finite.min()), float(finite.max())]
+                    if len(finite) else None),
+                "ll_final": round(float(fit["ll_history"][-1][1]), 6),
+                "winner_indices": idx[keep].tolist(),
+                "winner_scores": [float(s) for s in scores[keep]],
+            }
+        events_total += n_events
+    driver_span = time.perf_counter() - t_loop
+    if worker is not None:
+        worker.join(timeout=60)
+
+    # -- overlap-exact accounting + the stage-sum identity ---------------
+    occ = clock.snapshot()
+    per_stage = {dt: {st: occ["busy_s"].get(f"{dt}.{st}", 0.0)
+                      for st in ("prepare", "fit", "score", "oa")}
+                 for dt in datatypes}
+    prepare_total = sum(w["prepare"] for w in per_stage.values())
+    blocked_total = sum(occ["blocked_s"].values())
+    # Driver-thread stages: everything except the worker's prepares.
+    driver_stages = [f"{dt}.{st}" for dt in datatypes
+                     for st in (("fit", "score", "oa") if overlap else
+                                ("prepare", "fit", "score", "oa"))]
+    ok, idle = clock.check_stage_sum(driver_stages, span_s=driver_span,
+                                     tol_s=0.25 + 0.02 * driver_span)
+    assert ok, (
+        f"stage-sum identity violated: driver stages + blocked exceed the "
+        f"driver span by {-idle:.3f}s (accounting must never exceed wall)")
+    # Barrier stall: seconds the device-feeding thread sat waiting for
+    # stage inputs. Sequential arm: every prepare second is on the
+    # critical path; overlapped arm: only the consumer-blocked residue.
+    stall_s = blocked_total if overlap else prepare_total
+
+    manifest = {
+        "campaign_schema": CAMPAIGN_SCHEMA,
+        "orchestration": {
+            "datatypes": list(datatypes),
+            "overlap": bool(overlap),
+            "overlap_depth": int(overlap_depth) if overlap else 0,
+            "merge_form": merge_form,
+            "merge_staleness": (int(merge_staleness)
+                                if merge_form == "async" else 0),
+            "lda_superstep": cfg.superstep or SUPERSTEP_DEFAULT,
+            "dp1_fast_path": dp1_fast,
+            "mesh": dict(mesh.shape),
+            "n_sweeps": n_sweeps, "n_topics": n_topics,
+            "n_chains": n_chains, "seed": seed,
+            "generator": generator,
+            "per_datatype_stage_walls_s": {
+                dt: {st: round(v, 3) for st, v in walls.items()}
+                for dt, walls in per_stage.items()},
+        },
+        "per_datatype": per_dt,
+        "aggregate": {
+            "events_total": events_total,
+            "wall_seconds": round(driver_span, 3),
+            "events_per_second": round(events_total
+                                       / max(driver_span, 1e-9), 1),
+            "barrier_stall_s": round(stall_s, 3),
+            "prepare_busy_s": round(prepare_total, 3),
+            "driver_idle_s": round(max(idle, 0.0), 3),
+            "stage_sum_identity_ok": True,
+            "fit_preemptions": fit_preemptions,
+        },
+        "occupancy": occ,
+    }
+    resil = {**counters.snapshot("ingest"), **counters.snapshot("salvage"),
+             **counters.snapshot("faults"), **counters.snapshot("ckpt"),
+             **counters.snapshot("campaign")}
+    if resil:
+        manifest["resilience"] = resil
+    if out_path is not None:
+        out_path = pathlib.Path(out_path)
+        out_path.parent.mkdir(parents=True, exist_ok=True)
+        out_path.write_text(json.dumps(manifest, indent=2) + "\n")
+    return manifest
+
+
+def winners_identical(a: dict, b: dict) -> bool:
+    """Exact per-datatype winner-set/score identity between two
+    campaign manifests — the cross-arm parity check bench and the
+    chaos smoke assert (deterministic stages ⇒ identical artifacts)."""
+    if set(a["per_datatype"]) != set(b["per_datatype"]):
+        return False
+    for dt, pa in a["per_datatype"].items():
+        pb = b["per_datatype"][dt]
+        if (pa["winner_indices"] != pb["winner_indices"]
+                or pa["winner_scores"] != pb["winner_scores"]):
+            return False
+    return True

@@ -197,10 +197,12 @@ def run_rehearsal(n_events: int = 100_000, n_sweeps: int = 300,
 
 def summarize_cells(cells: dict) -> dict:
     """Per-datatype min-over-seeds summary of rehearsal cells keyed
-    "<datatype>/seed<N>" — ONE implementation shared by the study
-    driver (scripts/overlap_r03.py) and the artifact merge tool
-    (scripts/overlap_merge.py), so the judged-bar aggregation cannot
-    drift between them."""
+    "<datatype>/seed<N>". The r03–r05 study drivers and the artifact
+    merge tool that consumed this were consolidated in r14 (their
+    recipes live in the committed docs/OVERLAP_r0*.json artifacts;
+    single cells re-run via `scripts/exp_campaign.py --rehearsal-cell`
+    — docs/PERF.md "overlap study drivers, consolidated"); this stays
+    the ONE judged-bar aggregation for any future study."""
     per_dt = {}
     for dt in sorted({k.split("/")[0] for k in cells}):
         mine = [c for k, c in cells.items() if k.startswith(dt + "/")]

@@ -64,7 +64,8 @@ def run_scale(n_events: int, n_hosts: int | None = None,
               n_topics: int = 20, max_results: int = 3000, seed: int = 0,
               train_events: int | None = None, datatype: str = "flow",
               n_chains: int = 1, resume_dir: str | None = None,
-              generator: str = "mixture",
+              generator: str = "mixture", merge_form: str = "sync",
+              merge_staleness: int = 1,
               out_path: str | pathlib.Path | None = None) -> dict:
     """End-to-end scale run; returns (and optionally writes) the manifest.
 
@@ -82,6 +83,7 @@ def run_scale(n_events: int, n_hosts: int | None = None,
     """
     import jax
 
+    from onix.models.lda_gibbs import merge_fingerprint as _merge_fp
     from onix.parallel.mesh import make_mesh
     from onix.parallel.sharded_gibbs import ShardedGibbsLDA
     from onix.utils.obs import enable_compile_cache
@@ -114,6 +116,12 @@ def run_scale(n_events: int, n_hosts: int | None = None,
             "datatype": datatype, "n_chains": n_chains,
             "max_results": max_results, "generator": generator,
             "words_mode": "host" if host_words_forced() else "device",
+            # r14: the merge arm changes the fitted model for τ>0 (and
+            # the spec refuses crossing even the bit-identical τ=0), so
+            # a resume across a merge-form/τ change starts clean — the
+            # SHARED identity rule, so the stage cache and the fit
+            # checkpoint can never disagree about what "same run" means.
+            **_merge_fp(merge_form, merge_staleness),
         })
         meta = ckpt.load("meta")
         if meta is not None:
@@ -162,6 +170,11 @@ def run_scale(n_events: int, n_hosts: int | None = None,
                     # 2^17 measured fastest on v5e (36.8M tokens/s vs
                     # 33.8M at 2^16, 26.5M at 2^18).
                     block_size=1 << 17, seed=seed, n_chains=n_chains,
+                    # r14 count-merge arm: "async" swaps the full-
+                    # barrier psum fold for the bounded-staleness
+                    # exchange (sharded_gibbs module doc); τ=0 is the
+                    # bit-identity cross-check arm.
+                    merge_form=merge_form, merge_staleness=merge_staleness,
                     # Sweep-granular resume INSIDE the fit stage: with a
                     # resume_dir, checkpoint at every superstep boundary
                     # (the fit loop's natural host-sync points) so a
@@ -268,6 +281,25 @@ def run_scale(n_events: int, n_hosts: int | None = None,
         # behind the gibbs_fit wall this manifest reports.
         "lda_superstep": cfg.superstep or SUPERSTEP_DEFAULT,
         "dp1_fast_path": bool(getattr(model, "dp1_fast", False)),
+        # Orchestration topology stamp (r14): downstream evidence JSONs
+        # must be self-describing — which merge arm fitted the model,
+        # at what staleness, under which orchestration — instead of the
+        # r3-era bare-walls SCALE_1B layout. scale.py is the sequential
+        # single-datatype runner (overlap 0); the overlapped
+        # three-datatype form is pipelines/campaign.py, which stamps
+        # the same block.
+        "orchestration": {
+            "runner": "scale_sequential",
+            "overlap": False,
+            "overlap_depth": 0,
+            "merge_form": getattr(model, "merge_form", "sync"),
+            "merge_staleness": int(getattr(model, "merge_tau", 0)),
+            "lda_superstep": cfg.superstep or SUPERSTEP_DEFAULT,
+            "dp1_fast_path": bool(getattr(model, "dp1_fast", False)),
+            "mesh": dict(mesh.shape),
+            "per_datatype_stage_walls_s": {
+                datatype: {k: round(v, 2) for k, v in walls.items()}},
+        },
         "devices": [str(d) for d in jax.devices()],
         "mesh": dict(mesh.shape),
         "walls_seconds": {k: round(v, 2) for k, v in walls.items()},
@@ -686,6 +718,14 @@ def main(argv: list[str] | None = None) -> int:
                          "mid-way (severed TPU tunnel window) resumes "
                          "from the last completed stage / stream chunk "
                          "instead of restarting")
+    ap.add_argument("--merge-form", choices=("sync", "async"),
+                    default="sync",
+                    help="sharded-engine count-merge arm (r14): sync "
+                         "full-barrier psum fold, or the AD-LDA-style "
+                         "bounded-staleness exchange")
+    ap.add_argument("--merge-staleness", type=int, default=1,
+                    help="merge windows a peer delta may lag in the "
+                         "async arm (0 = the bit-identity arm)")
     args = ap.parse_args(argv)
     m = run_scale(int(args.events), n_hosts=args.hosts,
                   n_sweeps=args.sweeps, seed=args.seed,
@@ -693,6 +733,8 @@ def main(argv: list[str] | None = None) -> int:
                                 else int(args.train_events)),
                   datatype=args.datatype, n_chains=args.chains,
                   resume_dir=args.resume_dir, generator=args.generator,
+                  merge_form=args.merge_form,
+                  merge_staleness=args.merge_staleness,
                   out_path=args.out)
     print(json.dumps(m, indent=2))
     return 0

@@ -85,7 +85,7 @@ def main() -> int:
                     help="force the CPU backend (the ambient "
                          "sitecustomize pins the tunneled accelerator "
                          "even with JAX_PLATFORMS=cpu in the env — same "
-                         "trap as bench.py/overlap_r03.py)")
+                         "trap as bench.py/exp_campaign.py)")
     ap.add_argument("--batches", type=int, default=60)
     ap.add_argument("--batch-events", type=int, default=250_000)
     ap.add_argument("--attack-from", type=int, default=30,

@@ -1,0 +1,153 @@
+"""The r14 campaign orchestrator (onix/pipelines/campaign.py) and its
+overlap-exact accounting (obs.OccupancyClock).
+
+test_campaign_smoke is the tier-1 rot guard the CI satellite asks for:
+three datatypes at tiny shape, overlap ON, an ACTIVE fault plan
+(prepare poison + a fit preemption at a merge boundary + a torn
+checkpoint), and the chaos-run artifacts asserted identical to the
+fault-free sequential control in the exact (τ=0-equivalent sync) arm.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from onix.pipelines.campaign import run_campaign, winners_identical
+from onix.utils import faults
+from onix.utils.obs import OccupancyClock, counters
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_state():
+    faults.reset()
+    counters.reset("campaign")
+    counters.reset("faults")
+    counters.reset("ckpt")
+    yield
+    faults.reset()
+
+
+def _tiny(**kw):
+    base = dict(n_events=5000, n_sweeps=4, max_results=80, seed=3)
+    base.update(kw)
+    return run_campaign(**base)
+
+
+def test_occupancy_clock_accounting():
+    """busy/blocked bookkeeping, the union/overlap split, and the
+    stage-sum identity the campaign asserts."""
+    import threading
+
+    clock = OccupancyClock()
+    with clock.busy("a.prepare"):
+        time.sleep(0.05)
+    with clock.blocked("wait"):
+        time.sleep(0.02)
+
+    def worker():
+        with clock.busy("b.prepare"):
+            time.sleep(0.1)
+
+    t = threading.Thread(target=worker)
+    with clock.busy("a.fit"):
+        t.start()
+        time.sleep(0.1)
+    t.join()
+    snap = clock.snapshot()
+    assert snap["busy_s"]["a.prepare"] >= 0.04
+    assert snap["blocked_s"]["wait"] >= 0.015
+    # a.fit and b.prepare ran concurrently: overlap is real, and the
+    # union can never exceed the span.
+    assert snap["overlap_s"] > 0.05
+    assert snap["union_busy_s"] <= snap["span_s"] + 0.01
+    total = sum(snap["busy_s"].values())
+    assert snap["union_busy_s"] <= total + 1e-9
+    ok, idle = clock.check_stage_sum(["a.prepare", "a.fit"],
+                                     blocked_names=["wait"])
+    assert ok and idle >= -0.25
+    # Accounted time exceeding the span must fail the identity.
+    ok_bad, _ = clock.check_stage_sum(
+        ["a.prepare", "a.fit", "b.prepare"], blocked_names=["wait"],
+        span_s=0.05, tol_s=0.01)
+    assert not ok_bad
+
+
+def test_campaign_smoke(tmp_path):
+    """Tier-1 rot guard: overlap on, fault plan active (poisoned
+    prepare batch, preemption at a merge/superstep boundary, torn
+    checkpoint), resume through the per-datatype checkpoint dirs —
+    and every artifact identical to the fault-free SEQUENTIAL control
+    in the exact arm."""
+    control = _tiny(overlap=False)
+    assert control["aggregate"]["stage_sum_identity_ok"]
+
+    plan = faults.install_plan(
+        "campaign:prepare@2=raise,fit:sweep@2=preempt,ckpt:save@1=torn")
+    chaos = _tiny(overlap=True, resume_dir=tmp_path,
+                  out_path=tmp_path / "campaign.json")
+    assert not plan.pending(), f"rules never fired: {plan.pending()}"
+    faults.reset()
+
+    # Artifacts: winner sets AND scores identical per datatype, planted
+    # hits identical — a fault-riddled overlapped campaign converges to
+    # the fault-free sequential run's numbers in the exact arm.
+    assert winners_identical(control, chaos)
+    for dt in ("flow", "dns", "proxy"):
+        assert (chaos["per_datatype"][dt]["planted_in_bottom_k"]
+                == control["per_datatype"][dt]["planted_in_bottom_k"])
+        assert chaos["per_datatype"][dt]["planted_in_bottom_k"] > 0
+
+    # The chaos run recorded its recovery: the preemption retried, the
+    # prepare poison was absorbed by the bounded retry, the torn
+    # checkpoint was skipped by the digest/pair discipline.
+    assert chaos["aggregate"]["fit_preemptions"] >= 1
+    resil = chaos["resilience"]
+    assert resil["faults.campaign.prepare"] == 1
+    assert resil["faults.fit.sweep"] == 1
+    assert resil["faults.ckpt.save"] == 1
+    assert resil["campaign.prepare_retry"] == 1
+
+    # Orchestration stamp: self-describing manifest (the satellite's
+    # "no more r3-era bare-walls artifacts" contract).
+    orch = chaos["orchestration"]
+    assert orch["overlap"] and orch["overlap_depth"] == 1
+    assert orch["merge_form"] == "sync"
+    assert set(orch["per_datatype_stage_walls_s"]) == {"flow", "dns",
+                                                       "proxy"}
+    for walls in orch["per_datatype_stage_walls_s"].values():
+        assert {"prepare", "fit", "score", "oa"} <= set(walls)
+    assert (tmp_path / "campaign.json").exists()
+
+    # Overlap-exact accounting: the stage-sum identity held (asserted
+    # in-run too), and consumer-blocked stall is what the overlapped
+    # arm reports as its barrier stall.
+    assert chaos["aggregate"]["stage_sum_identity_ok"]
+    assert "prepare_wait" in chaos["occupancy"]["blocked_s"]
+
+
+def test_campaign_async_arm_runs_and_stays_in_band():
+    """The async arm through the WHOLE campaign. At dp=1 the fast path
+    makes async ≡ sync bit-for-bit — the cross-arm identity is exact;
+    at dp=2 (the conftest virtual mesh) τ=1 is genuinely a different
+    chain and the contract is the loose harness parity: finite lls,
+    planted anomalies still surfacing. The multi-shard τ>0 in-band ll
+    contract proper lives in tests/test_merge_async.py."""
+    sync = _tiny(merge_form="sync", dp=1)
+    asy = _tiny(merge_form="async", merge_staleness=1, dp=1)
+    assert asy["orchestration"]["merge_form"] == "async"
+    assert asy["orchestration"]["merge_staleness"] == 1
+    assert asy["orchestration"]["dp1_fast_path"]
+    assert winners_identical(sync, asy)
+
+    asy2 = _tiny(merge_form="async", merge_staleness=1, dp=2,
+                 datatypes=("flow",))
+    d = asy2["per_datatype"]["flow"]
+    assert np.isfinite(d["ll_final"])
+    assert d["planted_in_bottom_k"] > 0
+    assert asy2["orchestration"]["mesh"] == {"dp": 2, "mp": 1}
+
+
+def test_campaign_rejects_unknown_datatype():
+    with pytest.raises(ValueError, match="unknown datatypes"):
+        run_campaign(1000, datatypes=("flow", "nope"))

@@ -1,0 +1,252 @@
+"""The r14 bounded-staleness merge arm (lda.merge_form="async"):
+
+  * τ=0 bit-identity against the r7 synchronous psum fold — dp=1 fast
+    path, dp=2, dp=2×mp=2, with the chains vmap engaged;
+  * the staleness bound — a peer delta folds exactly τ merge windows
+    after production, never later (ring_push unit contract), and the
+    superstep flush restores exact global counts at every boundary;
+  * resume refusal across a merge-form/τ change (fingerprint
+    separation, mirroring the sparse-arm rule), pre-r14 sync
+    checkpoints unaffected;
+  * fault-plan preemption at a merge (superstep) boundary replaying
+    clean: bit-identical artifacts in the τ=0 arm, in-band artifacts
+    in the τ>0 arm (its chain is segmentation-dependent by design).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from onix.config import LDAConfig
+from onix.corpus import synthetic_lda_corpus
+from onix.models.lda_gibbs import LL_PARITY_BAND, merge_fingerprint
+from onix.parallel.mesh import make_mesh
+from onix.parallel.sharded_gibbs import ShardedGibbsLDA, ring_push
+
+
+@pytest.fixture(scope="module")
+def corpus_and_truth():
+    return synthetic_lda_corpus(n_docs=160, n_vocab=120, n_topics=5,
+                                mean_doc_len=80, alpha=0.2, eta=0.05,
+                                seed=0)
+
+
+def _cfg(**kw):
+    base = dict(n_topics=5, alpha=0.5, eta=0.05, n_sweeps=6, burn_in=3,
+                block_size=1024, seed=0)
+    base.update(kw)
+    return LDAConfig(**base)
+
+
+def _states_equal(a, b, context):
+    for name in a._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a, name)), np.asarray(getattr(b, name)),
+            err_msg=f"{name} diverged ({context})")
+
+
+def test_ring_push_staleness_bound():
+    """A delta pushed at window t emerges at window t+τ — exactly τ
+    late, never later: the FIFO IS the staleness bound."""
+    tau = 3
+    ring = jnp.zeros((tau, 2), jnp.int32)
+    emitted = []
+    for t in range(8):
+        delta = jnp.full((2,), t + 1, jnp.int32)     # tag window t+1
+        out, ring = ring_push(ring, delta)
+        emitted.append(int(np.asarray(out)[0]))
+    # First tau windows emit the zero fill (peers' deltas arrive late);
+    # window t then emits the delta produced at window t - tau.
+    assert emitted == [0, 0, 0, 1, 2, 3, 4, 5]
+    # Pending entries are exactly the last tau pushes, oldest first.
+    np.testing.assert_array_equal(np.asarray(ring)[:, 0], [6, 7, 8])
+    # tau=0 spelling: immediate emission, no ring.
+    out, none_ring = ring_push(None, jnp.full((2,), 9, jnp.int32))
+    assert none_ring is None and int(np.asarray(out)[0]) == 9
+
+
+def test_merge_fingerprint_contract():
+    assert merge_fingerprint("sync", 0) == {}        # pre-r14 resumes
+    assert merge_fingerprint("sync", 3) == {}
+    a0 = merge_fingerprint("async", 0)
+    a1 = merge_fingerprint("async", 1)
+    a2 = merge_fingerprint("async", 2)
+    assert a0 == {"merge": ["async", 0]}
+    assert a0 != a1 != a2                            # τ change refuses
+
+
+@pytest.mark.parametrize("dp,mp", [(2, 1), (2, 2)])
+def test_async_tau0_bit_identical_to_sync_fold(eight_devices,
+                                               corpus_and_truth, dp, mp):
+    """The τ=0 async program (device-varying carry, deferred-fold
+    structure, boundary flush) must be bit-identical to the r7
+    synchronous fold — every state field, both ll points — with the
+    chains vmap engaged."""
+    corpus, _, _ = corpus_and_truth
+    cfg_s = _cfg(n_chains=2)
+    cfg_a = _cfg(n_chains=2, merge_form="async", merge_staleness=0)
+    mesh = make_mesh(dp=dp, mp=mp, devices=jax.devices()[:dp * mp])
+    m_sync = ShardedGibbsLDA(cfg_s, corpus.n_vocab, mesh=mesh)
+    m_async = ShardedGibbsLDA(cfg_a, corpus.n_vocab, mesh=mesh)
+    sc = m_sync.prepare(corpus)
+    docs, words, mask = m_sync.device_corpus(sc)
+
+    s_sync, ll0_s, ll_s = m_sync._superstep_shardmap(
+        m_sync.init_state(sc), docs, words, mask, 0,
+        n_steps=cfg_s.n_sweeps, with_initial_ll=True)
+    s_async, ll0_a, ll_a = m_async._superstep_shardmap(
+        m_async.init_state(sc), docs, words, mask, 0,
+        n_steps=cfg_s.n_sweeps, with_initial_ll=True)
+    _states_equal(s_sync, s_async, f"tau=0 vs sync, dp={dp} mp={mp}")
+    np.testing.assert_allclose(float(ll_a), float(ll_s), rtol=1e-6)
+    np.testing.assert_allclose(float(ll0_a), float(ll0_s), rtol=1e-6)
+
+
+def test_async_tau0_dp1_fast_path(corpus_and_truth):
+    """At dp=1 the fast path IS the τ=0 degenerate (no peers): the
+    async model engages it and its fit artifacts are bit-identical to
+    the sync model's."""
+    corpus, _, _ = corpus_and_truth
+    mesh = make_mesh(dp=1, mp=1, devices=jax.devices()[:1])
+    m_sync = ShardedGibbsLDA(_cfg(), corpus.n_vocab, mesh=mesh)
+    m_async = ShardedGibbsLDA(_cfg(merge_form="async", merge_staleness=0),
+                              corpus.n_vocab, mesh=mesh)
+    assert m_async.dp1_fast
+    r_s = m_sync.fit(corpus)
+    r_a = m_async.fit(corpus)
+    _states_equal(r_s["state"], r_a["state"], "dp=1 fast path")
+    np.testing.assert_array_equal(r_s["phi_wk"], r_a["phi_wk"])
+    # The wrapped (shard_map) async program at dp=1 also matches: one
+    # device means peer deltas are exactly zero at any τ.
+    sc = m_async.prepare(corpus)
+    docs, words, mask = m_async.device_corpus(sc)
+    w_a, _ = m_async._superstep_shardmap(m_async.init_state(sc), docs,
+                                         words, mask, 0, n_steps=6)
+    w_s, _ = m_sync._superstep_shardmap(m_sync.init_state(sc), docs,
+                                        words, mask, 0, n_steps=6)
+    _states_equal(w_s, w_a, "dp=1 wrapped async vs sync")
+
+
+@pytest.mark.parametrize("tau", [1, 2, 7])
+def test_async_staleness_counts_exact_at_boundary(eight_devices,
+                                                  corpus_and_truth, tau):
+    """At every superstep boundary the flush restores EXACT global
+    counts — for τ within the superstep, spanning sync groups
+    (sync_splits=2 doubles the merge windows), and for τ larger than
+    the whole superstep's window count (everything folds at the
+    flush)."""
+    corpus, _, _ = corpus_and_truth
+    cfg = _cfg(merge_form="async", merge_staleness=tau, sync_splits=2)
+    model = ShardedGibbsLDA(cfg, corpus.n_vocab,
+                            mesh=make_mesh(dp=2, mp=2,
+                                           devices=jax.devices()[:4]))
+    sc = model.prepare(corpus)
+    docs, words, mask = model.device_corpus(sc)
+    st, _ = model._superstep_shardmap(model.init_state(sc), docs, words,
+                                      mask, 0, n_steps=3)
+    n = corpus.n_tokens
+    assert int(np.asarray(st.n_k).sum()) == n
+    assert int(np.asarray(st.n_wk).sum()) == n
+    assert int(np.asarray(st.n_dk).sum()) == n
+    assert np.asarray(st.n_wk).min() >= 0
+    assert np.asarray(st.n_dk).min() >= 0
+
+
+def test_async_learns_within_ll_band(eight_devices, corpus_and_truth):
+    """τ=1 is a different chain with the same stationary target: it
+    must learn (ll improves) and land within the gate-arm parity band
+    of the sync arm on the same corpus."""
+    corpus, _, _ = corpus_and_truth
+    mesh = make_mesh(dp=4, mp=1, devices=jax.devices()[:4])
+    cfg_kw = dict(n_sweeps=30, burn_in=15)
+    r_sync = ShardedGibbsLDA(_cfg(**cfg_kw), corpus.n_vocab,
+                             mesh=mesh).fit(corpus)
+    r_async = ShardedGibbsLDA(
+        _cfg(**cfg_kw, merge_form="async", merge_staleness=1),
+        corpus.n_vocab, mesh=mesh).fit(corpus)
+    lls_a = [ll for _, ll in r_async["ll_history"]]
+    assert all(np.isfinite(lls_a))
+    assert lls_a[-1] > lls_a[0] + 0.05, f"async arm did not learn: {lls_a}"
+    ll_s = r_sync["ll_history"][-1][1]
+    ll_a = lls_a[-1]
+    assert abs(ll_a - ll_s) < LL_PARITY_BAND * abs(ll_s), (
+        f"async arm out of the sync ll band: {ll_a} vs {ll_s}")
+
+
+def test_async_resume_refused_on_merge_change(eight_devices,
+                                              corpus_and_truth, tmp_path):
+    """Checkpoints are fingerprint-separated by the RESOLVED merge
+    form/τ: a sync checkpoint is never adopted by an async run (and
+    vice versa), and τ=1 never resumes τ=2's state — each combination
+    starts clean in its own subdir rather than silently crossing."""
+    corpus, _, _ = corpus_and_truth
+    mesh = make_mesh(dp=2, mp=1, devices=jax.devices()[:2])
+
+    def fit(merge_form="sync", tau=1, n_sweeps=4):
+        cfg = _cfg(n_sweeps=n_sweeps, burn_in=2, checkpoint_every=2,
+                   merge_form=merge_form, merge_staleness=tau)
+        return ShardedGibbsLDA(cfg, corpus.n_vocab, mesh=mesh).fit(
+            corpus, checkpoint_dir=tmp_path)
+
+    fit("sync")                            # leaves sync checkpoints
+    before = {p.name for d in tmp_path.iterdir() for p in d.iterdir()}
+    r_async = fit("async", tau=1)
+    # A fresh (unresumed) run's history starts at the pre-sweep point.
+    assert r_async["ll_history"][0][0] == -1
+    after_dirs = {d.name for d in tmp_path.iterdir()}
+    assert len(after_dirs) >= 2, "async run reused the sync fingerprint"
+    r_tau2 = fit("async", tau=2)
+    assert r_tau2["ll_history"][0][0] == -1
+    assert len({d.name for d in tmp_path.iterdir()}) >= 3
+    # The sync checkpoints were neither adopted nor pruned.
+    now = {p.name for d in tmp_path.iterdir() for p in d.iterdir()}
+    assert before <= now
+
+
+@pytest.mark.faults
+def test_async_preempt_at_merge_boundary_replays(eight_devices,
+                                                 corpus_and_truth,
+                                                 tmp_path):
+    """A preemption at a merge (superstep) boundary, then a retry:
+
+      * τ=0 arm — artifacts bit-identical to the never-faulted run
+        (the τ=0 chain is segmentation-invariant like sync);
+      * τ=1 arm — the retry completes from the checkpoint with exact
+        counts and an ll inside the parity band of its own fault-free
+        run (the τ>0 chain re-segments at the fault boundary, so
+        identity is NOT the contract — the band is)."""
+    from onix.checkpoint import SimulatedPreemption
+    corpus, _, _ = corpus_and_truth
+    mesh = make_mesh(dp=2, mp=1, devices=jax.devices()[:2])
+
+    def run(tau, fault_sweep=None):
+        cfg = _cfg(n_sweeps=8, burn_in=4, checkpoint_every=2,
+                   merge_form="async", merge_staleness=tau)
+        model = ShardedGibbsLDA(cfg, corpus.n_vocab, mesh=mesh)
+        ckpt = tmp_path / f"tau{tau}" / ("faulted" if fault_sweep
+                                         else "clean")
+        if fault_sweep is not None:
+            with pytest.raises(SimulatedPreemption):
+                model.fit(corpus, checkpoint_dir=ckpt,
+                          fault_inject_sweep=fault_sweep)
+        return ShardedGibbsLDA(cfg, corpus.n_vocab, mesh=mesh).fit(
+            corpus, checkpoint_dir=ckpt)
+
+    clean0 = run(0)
+    replay0 = run(0, fault_sweep=3)
+    _states_equal(clean0["state"], replay0["state"],
+                  "tau=0 preempt replay")
+    np.testing.assert_array_equal(clean0["phi_wk"], replay0["phi_wk"])
+
+    clean1 = run(1)
+    replay1 = run(1, fault_sweep=3)
+    n = corpus.n_tokens
+    st = replay1["state"]
+    assert int(np.asarray(st.n_k).sum()) == n
+    assert int(np.asarray(st.n_wk).sum()) == n
+    ll_c = clean1["ll_history"][-1][1]
+    ll_r = replay1["ll_history"][-1][1]
+    assert abs(ll_r - ll_c) < LL_PARITY_BAND * abs(ll_c), (
+        f"tau=1 replay out of band: {ll_r} vs {ll_c}")
