@@ -736,12 +736,15 @@ def bench_model_bank(jax, jnp, small=False):
         "events_per_sec_sequential": round(n_events / best_seq, 1),
         "speedup_banked_vs_sequential": round(best_seq / best_bank, 3),
         "winners_bit_identical": True,
-        # The form(s) the timed dispatches ACTUALLY used (first element
-        # of each compiled shape key) — not a re-derivation, which can
-        # disagree with the per-wave padded resolution on backends with
-        # a nonzero crossover.
+        # The form(s) the timed dispatches ACTUALLY used (leading
+        # elements of each compiled shape key) — not a re-derivation,
+        # which can disagree with the per-wave padded resolution on
+        # backends with a nonzero crossover. serve_form is the r15
+        # serving-scan arm the same dispatches compiled (xla|fused).
         "form": ",".join(sorted({k[0] for k
                                  in service.bank.compiled_shapes})),
+        "serve_form": ",".join(sorted({k[1] for k
+                                       in service.bank.compiled_shapes})),
         "dispatch_collapse": (f"{seq['dispatches']} -> "
                               f"{banked['dispatches']}"),
         "n_tenants": spec.n_tenants, "n_requests": len(stream),
@@ -834,6 +837,32 @@ def bench_feedback_rescore(jax, jnp, small=False):
                    and not (fidx & suppressed))
     assert delta_exact, "winner delta is not exactly the suppressed set"
 
+    # Filter-size ladder (r15): the membership-search tax as a CURVE
+    # over 2^6..2^16 suppressed keys, not one point — the decision
+    # input for the fused serving arm's gate table
+    # (pallas_serve._SERVE_FUSED_MIN_EVENTS): the XLA search costs
+    # log2(F) gather steps per event, the fused kernel's compare-sweep
+    # costs O(F) lane-parallel compares, and where the two cross on a
+    # backend is exactly what the table entry needs. Keys are random
+    # uint64 pairs over the same id space (timing only — the winner
+    # semantics are proven above and in test_pallas_serve.py).
+    ladder = []
+    ladder_sizes = [1 << b for b in range(6, 17, 2)]
+    for n_keys in ladder_sizes:
+        keys = np.unique(pack_pair(
+            rng.integers(0, n_docs, n_keys).astype(np.uint32),
+            rng.integers(0, n_docs, n_keys).astype(np.uint32)))
+        ltab = HostFilter.empty().merged(pair_suppress=keys).tables()
+        _, dt_l = timed(lambda ltab=ltab: table_pair_bottom_k_filtered(
+            table, isrc, idst, wd, ph_d, pl_d, ltab,
+            tol=1.0, max_results=max_results))
+        ladder.append({
+            "n_keys_requested": n_keys,
+            "table_entries": int(ltab.pair_suppress[0].shape[0]),
+            "events_per_sec": round(n_events / dt_l, 1),
+            "overhead_frac_vs_unfiltered": round(dt_l / dt_ref - 1.0, 4),
+        })
+
     return {
         "events_per_sec_filtered": round(n_events / dt_filt, 1),
         "events_per_sec_unfiltered": round(n_events / dt_ref, 1),
@@ -843,10 +872,119 @@ def bench_feedback_rescore(jax, jnp, small=False):
         "winner_delta_exactly_suppressed_set": delta_exact,
         "n_suppressed_keys": int(len(filt.pair_suppress)),
         "n_winners_removed": len(removed),
+        "filter_size_ladder": ladder,
         "n_events": n_events, "n_docs": n_docs, "n_vocab": n_vocab,
         "n_topics": k, "max_results": max_results,
         "wall_seconds": round(dt_filt, 3),
         "wall_seconds_unfiltered": round(dt_ref, 3),
+    }
+
+
+def bench_fused_serve(jax, jnp, small=False):
+    """fused_serve: the r15 one-kernel serving path — the fused Pallas
+    score + filter-membership + bottom-M arm
+    (pallas_serve.fused_table_pair_bottom_k) vs the three-stage XLA
+    path (rescore.table_pair_bottom_k_filtered) over the SAME filtered
+    flow request batch, every run. Two proofs ride along, ASSERTED:
+
+      * winner bit-identity — the fused arm's winners (scores, indices,
+        order) equal the XLA arm's on the filtered batch;
+      * empty-filter identity — the fused arm under a filter of zero
+        entries is bit-identical to the UNFILTERED XLA scan (the
+        filter.py exactness contract carried through the kernel).
+
+    Off-TPU the fused wall is interpret-mode emulation (pallas_mode
+    records which, the r8 gibbs_sweep_pallas discipline) — the number
+    is a correctness-vehicle diagnostic there, and the compiled
+    crossover rows are queued (docs/TPU_QUEUE.json `fused_serve_tpu` /
+    `bench_fused_serve_tpu`). Roofline rides the fused byte model
+    (obs.fused_serve_bytes_per_event — filter search bytes included)
+    in _roofline_detail."""
+    from onix.feedback.filter import HostFilter, pack_pair, split_key
+    from onix.feedback.rescore import table_pair_bottom_k_filtered
+    from onix.models.pallas_gibbs import _default_interpret
+    from onix.models.pallas_serve import (fused_table_pair_bottom_k,
+                                          select_serve_form)
+    from onix.models.scoring import score_table, table_pair_bottom_k
+
+    n_docs, n_vocab, k = (20_000, 256, 20) if small else (50_000, 512, 20)
+    n_events = 1 << 17 if small else 1 << 19
+    max_results = 100 if small else 200
+    n_filter_keys = 1 << 8
+
+    rng = np.random.default_rng(11)
+    theta = _dirichlet(rng, k, n_docs)
+    phi_wk = _dirichlet(rng, k, n_vocab)
+    table = score_table(jnp.asarray(theta), jnp.asarray(phi_wk)).ravel()
+    d_src = rng.integers(0, n_docs, n_events).astype(np.int32)
+    d_dst = rng.integers(0, n_docs, n_events).astype(np.int32)
+    w = rng.integers(0, n_vocab, n_events).astype(np.int32)
+    isrc = jnp.asarray(d_src * n_vocab + w)
+    idst = jnp.asarray(d_dst * n_vocab + w)
+    pair = pack_pair(d_src.astype(np.uint32), d_dst.astype(np.uint32))
+    ph_h, pl_h = split_key(pair)
+    wd = jnp.asarray(w)
+    ph_d, pl_d = jnp.asarray(ph_h), jnp.asarray(pl_h)
+    filt = HostFilter.empty().merged(pair_suppress=np.unique(pack_pair(
+        rng.integers(0, n_docs, n_filter_keys).astype(np.uint32),
+        rng.integers(0, n_docs, n_filter_keys).astype(np.uint32))))
+    tabs = filt.tables()
+    interpret = _default_interpret()
+
+    def timed(fn):
+        np.asarray(fn().scores)         # compile + settle
+        best, out = float("inf"), None
+        for _ in range(2):
+            t0 = time.perf_counter()
+            out = fn()
+            np.asarray(out.scores)
+            best = min(best, time.perf_counter() - t0)
+        return out, best
+
+    xla_f, dt_xla = timed(lambda: table_pair_bottom_k_filtered(
+        table, isrc, idst, wd, ph_d, pl_d, tabs,
+        tol=1.0, max_results=max_results))
+    fused_f, dt_fused = timed(lambda: fused_table_pair_bottom_k(
+        table, isrc, idst, wd, ph_d, pl_d, tabs,
+        tol=1.0, max_results=max_results))
+    identical = (bool(np.array_equal(np.asarray(xla_f.scores),
+                                     np.asarray(fused_f.scores)))
+                 and bool(np.array_equal(np.asarray(xla_f.indices),
+                                         np.asarray(fused_f.indices))))
+    assert identical, "fused arm's winners diverged from the XLA scan"
+
+    ref_u, dt_xla_u = timed(lambda: table_pair_bottom_k(
+        table, isrc, idst, tol=1.0, max_results=max_results))
+    empty = HostFilter.empty().tables()
+    fused_e, dt_fused_e = timed(lambda: fused_table_pair_bottom_k(
+        table, isrc, idst, wd, ph_d, pl_d, empty,
+        tol=1.0, max_results=max_results))
+    empty_identical = (
+        bool(np.array_equal(np.asarray(ref_u.scores),
+                            np.asarray(fused_e.scores)))
+        and bool(np.array_equal(np.asarray(ref_u.indices),
+                                np.asarray(fused_e.indices))))
+    assert empty_identical, \
+        "fused empty-filter arm diverged from the unfiltered scan"
+
+    return {
+        "events_per_sec_fused": round(n_events / dt_fused, 1),
+        "events_per_sec_xla": round(n_events / dt_xla, 1),
+        "events_per_sec_xla_unfiltered": round(n_events / dt_xla_u, 1),
+        "events_per_sec_fused_empty_filter":
+            round(n_events / dt_fused_e, 1),
+        "speedup_fused_vs_xla": round(dt_xla / dt_fused, 3),
+        "winners_bit_identical": identical,
+        "empty_filter_bit_identical": empty_identical,
+        # interpret = XLA emulation of the kernel (any non-TPU host):
+        # the rate is a correctness diagnostic, never a perf claim.
+        "pallas_mode": "interpret" if interpret else "compiled",
+        "serve_form_resolved_auto": select_serve_form("auto", n_events),
+        "n_filter_entries": int(filt.n_entries),
+        "n_events": n_events, "n_docs": n_docs, "n_vocab": n_vocab,
+        "n_topics": k, "max_results": max_results,
+        "wall_seconds": round(dt_fused, 3),
+        "wall_seconds_xla": round(dt_xla, 3),
     }
 
 
@@ -1077,6 +1215,22 @@ def _roofline_detail(detail: dict) -> dict | None:
         out["model_bank"] = roofline(
             mb["n_events"], mb["wall_seconds"],
             bank_score_bytes_per_event(mb.get("n_topics", 20)), peak)
+    fs = detail.get("fused_serve")
+    if isinstance(fs, dict) and "wall_seconds" in fs:
+        # The fused serving kernel's own byte model
+        # (obs.fused_serve_bytes_per_event — gathered score columns,
+        # key stream, filter search bytes amortized per call, ONE
+        # winner flush). Off-TPU the wall is interpret emulation, so
+        # the fraction is a diagnostic (fs["pallas_mode"] says which).
+        from onix.utils.obs import fused_serve_bytes_per_event
+        out["fused_serve"] = roofline(
+            fs["n_events"], fs["wall_seconds"],
+            fused_serve_bytes_per_event(
+                fs.get("n_topics", 20),
+                n_filter_entries=fs.get("n_filter_entries", 0),
+                n_events=fs["n_events"],
+                max_results=fs.get("max_results", 0), mode="min2"),
+            peak)
     gf = detail.get("gibbs_fit_effective")
     if isinstance(gf, dict) and "wall_seconds" in gf:
         # Same byte model as the sweep kernel — the fit loop samples
@@ -1410,6 +1564,13 @@ def _measure() -> None:
     # queued in docs/TPU_QUEUE.json `feedback_rescore_tpu`).
     run("feedback_rescore",
         lambda: bench_feedback_rescore(jax, jnp, small=fallback))
+    # The r15 one-kernel serving path: fused Pallas
+    # score+membership+bottom-M vs the three-stage XLA path over the
+    # same filtered batch, winner + empty-filter identity asserted
+    # every run (off-TPU the fused wall is interpret emulation —
+    # pallas_mode records it; compiled rows queued in
+    # docs/TPU_QUEUE.json `fused_serve_tpu`/`bench_fused_serve_tpu`).
+    run("fused_serve", lambda: bench_fused_serve(jax, jnp, small=fallback))
     # The r14 campaign orchestrator: sequential vs overlapped
     # three-datatype runs over the same feeds, winner parity asserted,
     # barrier-stall + occupancy counters in detail (docs/PERF.md

@@ -14,11 +14,53 @@ from __future__ import annotations
 import dataclasses
 import json
 import hashlib
+import os
 import pathlib
 from dataclasses import dataclass, field
-from typing import Any
+from typing import Any, Callable
 
 DATATYPES = ("flow", "dns", "proxy")
+
+
+def resolve_form_gate(*, gate: str, choices: tuple[str, ...],
+                      explicit: str | None = None,
+                      env: str | None = None,
+                      env_var: str | None = None,
+                      measured: Callable[[], str | None] | None = None,
+                      default: str) -> str:
+    """The ONE precedence chain behind every measured performance gate —
+    `lda_gibbs.select_nwk_form`, `model_bank.select_bank_form`, and
+    `pallas_serve.select_serve_form` each resolve through this helper so
+    the three tables cannot drift in precedence order:
+
+        env override  >  explicit form  >  measured table  >  default
+
+    `env` is the raw override value (or `env_var` to read it here);
+    empty and "auto" both mean "no override" — exporting FOO=auto resets
+    an inherited override instead of crashing. Any other value outside
+    `choices` raises, for env and explicit alike: a typo'd override must
+    fail loudly, never silently mislabel an experiment's arms. The nwk
+    gate passes no env — its engines resolve ONIX_NWK_FORM themselves,
+    where an explicit test-arm pin must outrank an exported override
+    (make_block_step's documented contract), and hand the result in as
+    `explicit`. `measured` is the per-backend crossover-table lookup;
+    None (unmeasured platform, or below the crossover) falls to
+    `default` — never an unmeasured guess."""
+    if env is None and env_var is not None:
+        env = os.environ.get(env_var)
+    for value, what in ((env, f"{gate} (env override)"),
+                        (explicit, gate)):
+        if value is None or value in ("", "auto"):
+            continue
+        if value not in choices:
+            raise ValueError(
+                f"{what} must be auto|{'|'.join(choices)}, got {value!r}")
+        return value
+    if measured is not None:
+        got = measured()
+        if got is not None:
+            return got
+    return default
 
 
 @dataclass
@@ -365,6 +407,18 @@ class ServingConfig:
     # ONIX_BANK_FORM overrides for experiments). Bit-identical forms —
     # pure performance.
     bank_form: str = "auto"
+    # Serving-scan form: "xla" keeps the three-stage XLA path (batched
+    # gather/matmul scoring, feedback membership search, chunked
+    # bottom-M scan); "fused" engages the r15 one-kernel Pallas serving
+    # path (onix/models/pallas_serve.py — score + filter membership +
+    # bottom-M in one kernel, winners flushed once per request).
+    # "auto" defers to the measured per-backend crossover table
+    # (pallas_serve._SERVE_FUSED_MIN_EVENTS — deliberately EMPTY for
+    # every backend, tpu included, until the queued TPU_QUEUE rows
+    # land, so auto resolves to xla everywhere today);
+    # ONIX_SERVE_FORM overrides for experiments. Both arms are
+    # bit-identical (winners, scores, tie order) — pure performance.
+    serve_form: str = "auto"
     # Requests per batched dispatch at the service layer; the bank
     # further splits a batch that exceeds bank_capacity distinct
     # tenants in one shape class.
@@ -390,6 +444,10 @@ class ServingConfig:
             raise ValueError(
                 "serving.bank_form must be auto|vmap|gather, "
                 f"got {self.bank_form!r}")
+        if self.serve_form not in ("auto", "xla", "fused"):
+            raise ValueError(
+                "serving.serve_form must be auto|xla|fused, "
+                f"got {self.serve_form!r}")
         if self.max_batch_requests < 1:
             raise ValueError("serving.max_batch_requests must be >= 1")
         if self.winner_cache_size < 0:

@@ -127,3 +127,66 @@ def table_pair_bottom_k_filtered(
                           idx_src.shape[0], score_chunk,
                           max_results=max_results, chunk=chunk,
                           merge_buffer=merge_buffer)
+
+
+# ---------------------------------------------------------------------------
+# Serve-gated dispatchers (r15): each is its filtered scan above plus
+# the one-kernel fused arm behind `pallas_serve.select_serve_form`
+# (serving.serve_form / ONIX_SERVE_FORM; "auto" resolves to the XLA
+# scan on every backend until a measured crossover table entry lands).
+# Both arms are bit-identical — winners, scores, tie order — so the
+# dispatch is pure performance (tests/test_pallas_serve.py).
+# ---------------------------------------------------------------------------
+
+
+def top_suspicious_filtered_fast(theta, phi_wk, doc_ids, word_ids, mask,
+                                 pair_hi, pair_lo, filt: FilterTables, *,
+                                 tol: float, max_results: int,
+                                 serve_form: str = "auto") -> TopK:
+    """`top_suspicious_filtered` behind the serve gate. Chained tables
+    (theta.ndim == 3) always take the XLA scan — the fused arm covers
+    single-estimate tables only."""
+    from onix.models import pallas_serve
+    form = pallas_serve.select_serve_form(serve_form, doc_ids.shape[0])
+    if form == "fused" and jnp.asarray(theta).ndim == 2:
+        return pallas_serve.fused_top_suspicious(
+            theta, phi_wk, doc_ids, word_ids, mask, pair_hi, pair_lo,
+            filt, tol=tol, max_results=max_results)
+    return top_suspicious_filtered(theta, phi_wk, doc_ids, word_ids,
+                                   mask, pair_hi, pair_lo, filt,
+                                   tol=tol, max_results=max_results)
+
+
+def table_bottom_k_filtered_fast(table_flat, idx, word_ids, pair_hi,
+                                 pair_lo, filt: FilterTables, *,
+                                 tol: float, max_results: int,
+                                 serve_form: str = "auto") -> TopK:
+    """`table_bottom_k_filtered` behind the serve gate."""
+    from onix.models import pallas_serve
+    form = pallas_serve.select_serve_form(serve_form, idx.shape[0])
+    if form == "fused":
+        return pallas_serve.fused_table_bottom_k(
+            table_flat, idx, word_ids, pair_hi, pair_lo, filt,
+            tol=tol, max_results=max_results)
+    return table_bottom_k_filtered(table_flat, idx, word_ids, pair_hi,
+                                   pair_lo, filt, tol=tol,
+                                   max_results=max_results)
+
+
+def table_pair_bottom_k_filtered_fast(table_flat, idx_src, idx_dst,
+                                      word_ids, pair_hi, pair_lo,
+                                      filt: FilterTables, *, tol: float,
+                                      max_results: int,
+                                      serve_form: str = "auto") -> TopK:
+    """`table_pair_bottom_k_filtered` (the judged filtered flow path)
+    behind the serve gate."""
+    from onix.models import pallas_serve
+    form = pallas_serve.select_serve_form(serve_form, idx_src.shape[0])
+    if form == "fused":
+        return pallas_serve.fused_table_pair_bottom_k(
+            table_flat, idx_src, idx_dst, word_ids, pair_hi, pair_lo,
+            filt, tol=tol, max_results=max_results)
+    return table_pair_bottom_k_filtered(table_flat, idx_src, idx_dst,
+                                        word_ids, pair_hi, pair_lo,
+                                        filt, tol=tol,
+                                        max_results=max_results)

@@ -208,38 +208,49 @@ def select_nwk_form(*, backend: str, block_size: int, n_rows: int,
     gate shared by every engine (tests/test_pallas_gibbs.py exercises
     its edge cases directly).
 
-    Priority: explicit `nwk_form` ("scatter" | "matmul" | "pallas"),
-    then the legacy `nwk_matmul` bool, then the measured per-backend
-    collision-density tables (density = block_size / n_rows expected
-    colliding row-updates per count row per block) bounded by the
-    exactness/memory caps. All three forms are bit-identical; this
-    picks the measured-fastest one for the platform and shape.
+    Priority (config.resolve_form_gate — the ONE precedence chain
+    shared with `select_bank_form` and `select_serve_form`, so the
+    three gate tables cannot drift): explicit `nwk_form` ("scatter" |
+    "matmul" | "pallas"), then the legacy `nwk_matmul` bool, then the
+    measured per-backend collision-density tables (density =
+    block_size / n_rows expected colliding row-updates per count row
+    per block) bounded by the exactness/memory caps. No env layer
+    HERE: the engines resolve ONIX_NWK_FORM themselves (env_nwk_form),
+    where an explicit test-arm pin must outrank an exported override
+    (make_block_step's documented contract), and pass the result in as
+    `nwk_form`. All three forms are bit-identical; this picks the
+    measured-fastest one for the platform and shape.
     """
-    if nwk_form is not None:
-        if nwk_form not in ("scatter", "matmul", "pallas"):
-            raise ValueError(
-                f"nwk_form must be scatter|matmul|pallas, got {nwk_form!r}")
-        return nwk_form
-    if nwk_matmul is not None:
-        return "matmul" if nwk_matmul else "scatter"
-    pallas_density = _NWK_PALLAS_MIN_DENSITY.get(backend)
-    if (pallas_density is not None
-            and block_size >= pallas_density * n_rows
-            and n_rows <= _NWK_MATMUL_MAX_V):
-        return "pallas"
-    min_density = _NWK_MATMUL_MIN_DENSITY.get(backend)
-    if (min_density is not None
-            and block_size >= min_density * n_rows
-            and n_rows <= _NWK_MATMUL_MAX_V
-            # Exactness bound: every output of the f32 accumulation is
-            # a sum of block_size {-1,0,1} terms, so |output| <=
-            # block_size must stay below 2^24 or integers stop being
-            # representable exactly. MAX_ELEMS implies it for V >= 8
-            # only; the explicit bound covers tiny-V/huge-B days.
-            and block_size < (1 << 24)
-            and block_size * n_rows <= _NWK_MATMUL_MAX_ELEMS):
-        return "matmul"
-    return "scatter"
+    from onix.config import resolve_form_gate
+    explicit = nwk_form
+    if explicit is None and nwk_matmul is not None:
+        explicit = "matmul" if nwk_matmul else "scatter"
+
+    def measured() -> str | None:
+        pallas_density = _NWK_PALLAS_MIN_DENSITY.get(backend)
+        if (pallas_density is not None
+                and block_size >= pallas_density * n_rows
+                and n_rows <= _NWK_MATMUL_MAX_V):
+            return "pallas"
+        min_density = _NWK_MATMUL_MIN_DENSITY.get(backend)
+        if (min_density is not None
+                and block_size >= min_density * n_rows
+                and n_rows <= _NWK_MATMUL_MAX_V
+                # Exactness bound: every output of the f32 accumulation
+                # is a sum of block_size {-1,0,1} terms, so |output| <=
+                # block_size must stay below 2^24 or integers stop
+                # being representable exactly. MAX_ELEMS implies it for
+                # V >= 8 only; the explicit bound covers tiny-V/huge-B
+                # days.
+                and block_size < (1 << 24)
+                and block_size * n_rows <= _NWK_MATMUL_MAX_ELEMS):
+            return "matmul"
+        return None
+
+    return resolve_form_gate(gate="nwk_form",
+                             choices=("scatter", "matmul", "pallas"),
+                             explicit=explicit, measured=measured,
+                             default="scatter")
 
 
 # ---------------------------------------------------------------------------

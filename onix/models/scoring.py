@@ -550,11 +550,21 @@ def _screened_enabled() -> bool:
 
 
 def table_bottom_k_fast(table_flat, idx, table_bf16=None, *, tol: float,
-                        max_results: int) -> TopK:
-    """Drop-in `table_bottom_k`: the bf16-screened scan when enabled
-    (_screened_enabled: default on TPU, ONIX_SCREENED_SELECT
-    overrides), falling back to the f32 scan whenever the device-side
-    proof does not certify; plain f32 scan otherwise."""
+                        max_results: int, serve_form: str = "auto") -> TopK:
+    """Drop-in `table_bottom_k`: the r15 fused one-kernel arm when the
+    serve gate resolves to it (pallas_serve.select_serve_form —
+    `serve_form` lets config-bearing callers pass
+    serving.serve_form; "auto" resolves to "xla" on every backend
+    until a measured crossover lands, ONIX_SERVE_FORM overrides), else
+    the bf16-screened scan when enabled (_screened_enabled: default on
+    TPU, ONIX_SCREENED_SELECT overrides), falling back to the f32 scan
+    whenever the device-side proof does not certify; plain f32 scan
+    otherwise."""
+    from onix.models import pallas_serve
+    if pallas_serve.select_serve_form(serve_form,
+                                      idx.shape[0]) == "fused":
+        return pallas_serve.fused_table_bottom_k(
+            table_flat, idx, tol=tol, max_results=max_results)
     if _screened_enabled():
         scr = table_bottom_k_screened(table_flat, idx, table_bf16,
                                       tol=tol, max_results=max_results)
@@ -565,9 +575,17 @@ def table_bottom_k_fast(table_flat, idx, table_bf16=None, *, tol: float,
 
 
 def table_pair_bottom_k_fast(table_flat, idx_src, idx_dst, table_bf16=None,
-                             *, tol: float, max_results: int) -> TopK:
-    """Drop-in `table_pair_bottom_k` with the same screened/fallback
-    policy (and platform default) as `table_bottom_k_fast`."""
+                             *, tol: float, max_results: int,
+                             serve_form: str = "auto") -> TopK:
+    """Drop-in `table_pair_bottom_k` with the same serve-gate +
+    screened/fallback policy (and platform default) as
+    `table_bottom_k_fast`."""
+    from onix.models import pallas_serve
+    if pallas_serve.select_serve_form(
+            serve_form, idx_src.shape[0]) == "fused":
+        return pallas_serve.fused_table_pair_bottom_k(
+            table_flat, idx_src, idx_dst, tol=tol,
+            max_results=max_results)
     if _screened_enabled():
         scr = table_pair_bottom_k_screened(table_flat, idx_src, idx_dst,
                                            table_bf16, tol=tol,

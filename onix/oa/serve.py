@@ -632,7 +632,8 @@ class OAServer(ThreadingHTTPServer):
                                  loader=loader, bulk_loader=bulk_loader,
                                  host_capacity=cfg.serving.host_model_cache,
                                  filter_loader=filter_loader,
-                                 epoch_loader=epoch_loader)
+                                 epoch_loader=epoch_loader,
+                                 serve_form=cfg.serving.serve_form)
                 self._bank_service = BankService(
                     bank,
                     max_batch_requests=cfg.serving.max_batch_requests,

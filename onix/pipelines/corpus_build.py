@@ -227,7 +227,8 @@ def _single_token_layout(bundle: CorpusBundle, n_events: int) -> bool:
 
 def select_suspicious_events(bundle: CorpusBundle, theta, phi_wk,
                              n_events: int, *, tol: float,
-                             max_results: int):
+                             max_results: int,
+                             serve_form: str = "auto"):
     """Score every event and select the bottom-`max_results` under
     `tol`, returning a scoring.TopK of EVENT indices.
 
@@ -235,7 +236,9 @@ def select_suspicious_events(bundle: CorpusBundle, theta, phi_wk,
     has the flow [src|dst] token layout, the whole score→pair-min→
     select pipeline runs fused on device and only the winners transfer
     (scoring.table_pair_bottom_k). Otherwise fall back to token scoring
-    + host pair-min + device selection."""
+    + host pair-min + device selection. `serve_form` routes the table
+    paths through the r15 serve gate (serving.serve_form for
+    config-bearing callers; "auto"/ONIX_SERVE_FORM otherwise)."""
     import jax.numpy as jnp
 
     from onix.models import scoring
@@ -257,11 +260,11 @@ def select_suspicious_events(bundle: CorpusBundle, theta, phi_wk,
         if single:
             return scoring.table_bottom_k_fast(
                 table, jnp.asarray(idx.astype(np.int32)),
-                tol=tol, max_results=max_results)
+                tol=tol, max_results=max_results, serve_form=serve_form)
         return scoring.table_pair_bottom_k_fast(
             table, jnp.asarray(idx[:n_events].astype(np.int32)),
             jnp.asarray(idx[n_events:].astype(np.int32)),
-            tol=tol, max_results=max_results)
+            tol=tol, max_results=max_results, serve_form=serve_form)
     tok = scoring.score_all(theta, phi_wk, corpus.doc_ids[:n_real],
                             corpus.word_ids[:n_real])
     ev = event_scores(bundle, tok, n_events).astype(np.float32)

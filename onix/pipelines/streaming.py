@@ -793,30 +793,47 @@ class StreamingScorer:
         f = self.noise_filter
         if f is not None and f.empty_filter:
             f = None
-        if f is not None and p.wid_tok is not None:
-            tok_scores = f.apply_word(tok_scores,
-                                      p.wid_tok.astype(np.uint64))
-        if p.dev_flow:
-            # Device flow layout is [src|dst] tokens of the same events
-            # in order: the event min is one elementwise minimum, not an
-            # unbuffered scatter.
-            ev_scores = np.minimum(tok_scores[:n_events],
-                                   tok_scores[n_events:]).astype(np.float64)
-        else:
-            ev_scores = np.full(n_events, np.inf, np.float64)
-            np.minimum.at(ev_scores, p.event_idx, tok_scores)
-        if f is not None and p.ev_pair is not None:
-            before = ev_scores
-            ev_scores = f.apply_pair(ev_scores, p.ev_pair)
-            if ev_scores is not before:
-                counters.inc("feedback.rescored_events",
-                             int(np.sum(~np.isfinite(ev_scores)
-                                        & np.isfinite(before))))
-
         tol = self.cfg.pipeline.tol
-        hit = np.flatnonzero(ev_scores < tol)
-        hit = hit[np.argsort(ev_scores[hit], kind="stable")]
-        hit = hit[: self.cfg.pipeline.max_results]
+        ev_scores = hit = None
+        # r15 one-kernel serving tail (flow device layout only — the
+        # hot path): word adjust + min-reduce + pair adjust + tol
+        # screen + bottom-M in ONE fused pallas_serve program behind
+        # the serve gate (serving.serve_form / ONIX_SERVE_FORM; "auto"
+        # keeps the host tail until a measured crossover lands). The
+        # string-keyed fallback (no u32 pair identities under a
+        # non-empty filter) stays on the host tail, which can apply
+        # word-only filtering.
+        if p.dev_flow and p.wid_tok is not None \
+                and (f is None or p.ev_pair is not None):
+            from onix.models.pallas_serve import select_serve_form
+            if select_serve_form(self.cfg.serving.serve_form,
+                                 n_events) == "fused":
+                ev_scores, hit = self._fused_tail(p, tok_scores, f, tol)
+        if hit is None:
+            if f is not None and p.wid_tok is not None:
+                tok_scores = f.apply_word(tok_scores,
+                                          p.wid_tok.astype(np.uint64))
+            if p.dev_flow:
+                # Device flow layout is [src|dst] tokens of the same
+                # events in order: the event min is one elementwise
+                # minimum, not an unbuffered scatter.
+                ev_scores = np.minimum(
+                    tok_scores[:n_events],
+                    tok_scores[n_events:]).astype(np.float64)
+            else:
+                ev_scores = np.full(n_events, np.inf, np.float64)
+                np.minimum.at(ev_scores, p.event_idx, tok_scores)
+            if f is not None and p.ev_pair is not None:
+                before = ev_scores
+                ev_scores = f.apply_pair(ev_scores, p.ev_pair)
+                if ev_scores is not before:
+                    counters.inc("feedback.rescored_events",
+                                 int(np.sum(~np.isfinite(ev_scores)
+                                            & np.isfinite(before))))
+
+            hit = np.flatnonzero(ev_scores < tol)
+            hit = hit[np.argsort(ev_scores[hit], kind="stable")]
+            hit = hit[: self.cfg.pipeline.max_results]
         alerts = p.table.iloc[hit].copy()
         alerts.insert(0, "score", ev_scores[hit])
         alerts.insert(1, "event_idx", hit)
@@ -830,6 +847,60 @@ class StreamingScorer:
                            n_events=n_events,
                            n_new_docs=n_after - p.docs_before,
                            step=int(self.state.step))
+
+    def _fused_tail(self, p: "_Prep", tok_scores, f, tol):
+        """The one-kernel winner-selection tail (pallas_serve.
+        fused_stream_tail): returns (ev_scores float64, hit indices) in
+        the host tail's exact contract — winners ascending by (score,
+        event index), capped at max_results; scores are the fully
+        filter-adjusted stream. The kernel computes in f32 (the device
+        dtype): identical to the float64 host tail whenever boost_scale
+        is dyadic (the 0.25 default — the multiply is then exact in
+        both widths) and no score falls inside the one-ulp gap between
+        tol and f32(tol); the tier-1 parity test pins both."""
+        from onix.feedback.filter import split_key
+        from onix.models.pallas_serve import fused_stream_tail
+        n = p.n_events
+        if f is not None:
+            # HostFilter is immutable and REPLACED (never mutated) on
+            # every change, so an identity check keeps the device
+            # rendering cached across batches instead of re-padding +
+            # re-uploading four key families per batch.
+            cached = getattr(self, "_fused_tail_tables", None)
+            if cached is None or cached[0] is not f:
+                cached = (f, f.tables())
+                self._fused_tail_tables = cached
+            tabs = cached[1]
+            ph_, pl_ = split_key(p.ev_pair)
+        else:
+            tabs = ph_ = pl_ = None
+        topk, ev_dev = fused_stream_tail(
+            np.asarray(tok_scores[:n], np.float32),
+            np.asarray(tok_scores[n:], np.float32),
+            None if f is None else p.wid_tok[:n].astype(np.uint32),
+            None if f is None else p.wid_tok[n:].astype(np.uint32),
+            ph_, pl_, tabs, tol=float(tol),
+            max_results=self.cfg.pipeline.max_results)
+        ev_scores = np.asarray(ev_dev).astype(np.float64)
+        hit = np.asarray(topk.indices)
+        hit = hit[hit >= 0]
+        counters.inc("serve.fused_tail")
+        if f is not None:
+            # The SAME metric the host tail counts (events newly +inf
+            # at the PAIR stage): pair-suppress members whose score was
+            # still finite after the word stage — token scores are
+            # finite, so only both-tokens-word-suppressed events enter
+            # the pair stage already at +inf. Host-side membership over
+            # the tiny unpadded tables, so flipping the arm never zeroes
+            # the monitoring counter.
+            pair_sup = f.member(p.ev_pair, f.pair_suppress)
+            if pair_sup.any():
+                wkeys = p.wid_tok.astype(np.uint64)
+                word_sup = f.member(wkeys[:n], f.word_suppress) \
+                    & f.member(wkeys[n:], f.word_suppress)
+                counters.inc("feedback.rescored_events",
+                             int(np.sum(pair_sup & ~word_sup)))
+        return ev_scores, hit
 
     # -- analyst feedback (r13, onix/feedback/) ---------------------------
     #

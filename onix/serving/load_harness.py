@@ -101,9 +101,10 @@ def make_stream(spec: HarnessSpec) -> list[ScoreRequest]:
 
 
 def build_service(spec: HarnessSpec, models: dict[str, TenantModel],
-                  form: str = "auto") -> BankService:
+                  form: str = "auto", serve_form: str = "auto"
+                  ) -> BankService:
     cap = spec.capacity or spec.n_tenants
-    bank = ModelBank(capacity=cap, form=form)
+    bank = ModelBank(capacity=cap, form=form, serve_form=serve_form)
     for name, m in models.items():
         bank.add(name, m.theta, m.phi_wk)
     return BankService(bank, max_batch_requests=spec.batch_requests)

@@ -53,13 +53,13 @@ from __future__ import annotations
 
 import dataclasses
 import functools
-import os
 from collections import OrderedDict
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from onix.config import resolve_form_gate
 from onix.feedback.filter import (FILTER_FLOOR, FilterTables, HostFilter,
                                   _pad_sorted, apply_filter, split_key)
 from onix.models.compaction import pow2_bucket
@@ -97,24 +97,23 @@ def select_bank_form(form: str, n_requests: int, n_pad: int,
                      backend: str | None = None) -> str:
     """Resolve the batched scoring form for one dispatch.
 
-    Priority: ONIX_BANK_FORM env override > explicit config form >
-    the measured `_BANK_GATHER_MIN_EVENTS` table for this backend >
-    vmap. Mirrors `lda_gibbs.select_nwk_form`'s gate discipline: the
-    forms are bit-identical, so this is pure performance and safe to
-    flip between dispatches."""
-    env = os.environ.get("ONIX_BANK_FORM", "")
-    if env in ("vmap", "gather"):
-        return env
-    if form in ("vmap", "gather"):
-        return form
-    if form != "auto":
-        raise ValueError(f"bank form must be auto|vmap|gather, got {form!r}")
-    if backend is None:
-        backend = jax.default_backend()
-    min_events = _BANK_GATHER_MIN_EVENTS.get(backend)
-    if min_events is not None and n_requests * n_pad >= min_events:
-        return "gather"
-    return "vmap"
+    Priority (config.resolve_form_gate — the ONE precedence chain
+    shared with `select_nwk_form` and `pallas_serve.select_serve_form`
+    so the three gate tables cannot drift): ONIX_BANK_FORM env
+    override > explicit config form > the measured
+    `_BANK_GATHER_MIN_EVENTS` table for this backend > vmap. The forms
+    are bit-identical, so this is pure performance and safe to flip
+    between dispatches."""
+    def measured() -> str | None:
+        b = backend if backend is not None else jax.default_backend()
+        min_events = _BANK_GATHER_MIN_EVENTS.get(b)
+        if min_events is not None and n_requests * n_pad >= min_events:
+            return "gather"
+        return None
+
+    return resolve_form_gate(gate="bank form", choices=("vmap", "gather"),
+                             explicit=form, env_var="ONIX_BANK_FORM",
+                             measured=measured, default="vmap")
 
 
 class BankRefusal(ValueError):
@@ -249,6 +248,23 @@ def _bank_score_gather(theta_bank, phi_bank, slots, doc_ids, word_ids, mask,
 _BANK_KERNELS = {"vmap": _bank_score_vmap, "gather": _bank_score_gather}
 
 
+def _bank_kernel_for(form: str, serve: str):
+    """The compiled program for one (bank form, serve form) pair. The
+    "fused" serve arm swaps the scan+filter stages for the r15
+    one-kernel Pallas path (onix/models/pallas_serve.py) — same
+    gathers, same scores, same winners, bit-identical (tested); the
+    interpret/compile decision rides pallas_serve's shared
+    `_default_interpret` (Mosaic on real TPUs, XLA emulation
+    elsewhere)."""
+    if serve != "fused":
+        return _BANK_KERNELS[form]
+    from onix.models import pallas_gibbs, pallas_serve
+    fused = {"vmap": pallas_serve.bank_score_vmap_fused,
+             "gather": pallas_serve.bank_score_gather_fused}[form]
+    interpret = pallas_gibbs._default_interpret()
+    return functools.partial(fused, interpret=interpret)
+
+
 class _Shard:
     """One shape class's resident bank: [C, D_pad, K] / [C, V_pad, K]
     device arrays plus the tenant→slot LRU bookkeeping."""
@@ -282,13 +298,18 @@ class ModelBank:
 
     def __init__(self, capacity: int = 64, form: str = "auto",
                  loader=None, bulk_loader=None, host_capacity: int = 0,
-                 filter_loader=None, epoch_loader=None):
+                 filter_loader=None, epoch_loader=None,
+                 serve_form: str = "auto"):
         if capacity < 1:
             raise ValueError("bank capacity must be >= 1")
         if host_capacity < 0:
             raise ValueError("host_capacity must be >= 0 (0 = unbounded)")
         self.capacity = capacity
         self.form = form
+        # r15 serving-scan form (serving.serve_form): "xla" | "fused" |
+        # "auto" (pallas_serve.select_serve_form — resolves to xla on
+        # every backend until a measured crossover lands).
+        self.serve_form = serve_form
         self._loader = loader
         self._bulk_loader = bulk_loader
         self._filter_loader = filter_loader
@@ -680,10 +701,19 @@ class ModelBank:
             filt_rows, filt_dims = None, None
 
         form = select_bank_form(self.form, r_pad, n_pad)
-        shape_key = (form, shard.d_pad, shard.v_pad, shard.k, r_pad, n_pad,
-                     max_results, filt_dims)
+        from onix.models.pallas_serve import select_serve_form
+        # Gate on n_pad — the PER-LANE event count each fused kernel
+        # actually runs at — so the crossover table keeps one unit
+        # (per-scan events) across every consumer; the seeding bench
+        # row measures a single scan at exactly that unit.
+        serve = select_serve_form(self.serve_form, n_pad)
+        # The RESOLVED serve form joins the shape key so manifests and
+        # bench stamps record what actually compiled (acceptance: gate
+        # artifacts must name the arm, not the request).
+        shape_key = (form, serve, shard.d_pad, shard.v_pad, shard.k,
+                     r_pad, n_pad, max_results, filt_dims)
         self.compiled_shapes.add(shape_key)
-        res = _BANK_KERNELS[form](
+        res = _bank_kernel_for(form, serve)(
             shard.theta, shard.phi, jnp.asarray(slots), jnp.asarray(d),
             jnp.asarray(w), jnp.asarray(m), jnp.float32(tol),
             filt_rows, max_results=max_results)

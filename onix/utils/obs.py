@@ -378,6 +378,38 @@ def bank_score_bytes_per_event(k_topics: int, dtype_bytes: int = 4) -> float:
     return 2 * k_topics * dtype_bytes + 12 + 4 + 4
 
 
+def fused_serve_bytes_per_event(k_topics: int, n_filter_entries: int = 0,
+                                n_events: int = 0, max_results: int = 0,
+                                mode: str = "dot") -> float:
+    """Modeled HBM traffic per event for the r15 fused serving kernel
+    (onix/models/pallas_serve.py; bench.py `fused_serve` roofline).
+    Per event: the score operands — mode "dot": the two gathered
+    theta/phi rows written by the outside gather and read by the
+    kernel (2·2·K·4 B: the materialize-then-stream cost the kernel
+    pays for Mosaic's missing gather rule, charged honestly at both
+    ends); mode "min2"/"scores": the pre-gathered f32 score columns
+    (2·4 / 4 B) plus the same gather's read side (4 B each) — plus the
+    key stream (word lo half 4 B + pair halves 8 B) and the pad mask
+    (4 B). Per CALL, amortized over the events: the FILTER SEARCH
+    BYTES — every sentinel-padded table entry's (hi, lo) uint32 pair
+    streams HBM→VMEM exactly once (8 B/entry; the per-tile compare
+    sweep then re-reads it from VMEM for free, which is the fused
+    arm's membership claim) — and the single winner flush
+    (max_results·8 B, once per request instead of once per chunk).
+    The XLA arm's corresponding model re-reads candidates between its
+    three programs; the DIFFERENCE between the two models is the HBM
+    round-trip the fusion removes."""
+    if mode == "dot":
+        per_event = 4 * k_topics * 4
+    elif mode == "min2":
+        per_event = 2 * (4 + 4)
+    else:
+        per_event = 4 + 4
+    per_event += 4 + 8 + 4
+    per_call = n_filter_entries * 8 + max_results * 8
+    return per_event + per_call / max(n_events, 1)
+
+
 def svi_estep_bytes_per_pair(k_topics: int, iters: float) -> float:
     """Modeled memory traffic per deduped (doc, bucket) pair of the
     streaming SVI step (bench.py `streaming` roofline; docs/PERF.md

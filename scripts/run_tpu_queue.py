@@ -14,9 +14,12 @@ Behavior:
   * probes the backend first (subprocess with timeout, same machinery
     as bench.py) and refuses to burn the queue against a dead tunnel
     or a CPU fallback (--force runs anyway, e.g. for a dry CPU smoke);
-  * runs entries in manifest order, skipping those whose est_minutes
-    don't fit the remaining --max-minutes budget (critical-first is
-    expressed by manifest order);
+  * runs entries by ascending `priority` (absent = 5; ties keep
+    manifest order — a stable sort), skipping those whose est_minutes
+    don't fit the remaining --max-minutes budget. Priority 1 marks
+    rows that fill EMPTY gate tables (ROADMAP item 1: they change
+    codebase defaults the moment they land); pure-evidence reruns sit
+    at 6+, so a short window burns down the decision rows first;
   * each entry's stdout/stderr is captured to docs/tpu_queue_logs/<id>.log
     and entries with `stdout_json_to` get their LAST stdout JSON line
     written there (bench.py's judged line);
@@ -45,8 +48,16 @@ QUEUE = ROOT / "docs" / "TPU_QUEUE.json"
 LOG_DIR = ROOT / "docs" / "tpu_queue_logs"
 
 
+DEFAULT_PRIORITY = 5
+
+
 def load_queue() -> list[dict]:
-    return json.loads(QUEUE.read_text())["entries"]
+    entries = json.loads(QUEUE.read_text())["entries"]
+    # Ascending priority, stable: ties keep manifest order, absent
+    # priorities sit between the gate-table rows (1) and the
+    # pure-evidence reruns (6+).
+    return sorted(entries,
+                  key=lambda e: e.get("priority", DEFAULT_PRIORITY))
 
 
 def entry_argv(entry: dict) -> list[str]:
@@ -136,8 +147,9 @@ def main(argv: list[str] | None = None) -> int:
         entries = [e for e in entries if e["id"] in only]
     if args.list:
         for e in entries:
-            print(f"{e['id']:<20} ~{e.get('est_minutes', '?'):>4} min  "
-                  f"{e['decides'][:90]}")
+            print(f"p{e.get('priority', DEFAULT_PRIORITY)} "
+                  f"{e['id']:<22} ~{e.get('est_minutes', '?'):>4} min  "
+                  f"{e['decides'][:84]}")
         return 0
 
     from bench import _probe_backend
