@@ -434,12 +434,37 @@ class ServingConfig:
     # past it the LRU re-fetchable, non-resident host copy is dropped
     # (bank.host_evict) and reloads from models_dir on next reference.
     host_model_cache: int = 1024
+    # Admission control (r16, docs/ROBUSTNESS.md "serving resilience"):
+    # request batches in flight + queued at the service before new ones
+    # are SHED with 503 + Retry-After (`serve.shed`). 0 disables
+    # shedding (unbounded queue — the pre-r16 behavior). Shed requests
+    # never touch bank residency or winner caches.
+    max_queue_depth: int = 64
+    # Per-request wall-clock budget in milliseconds, measured from
+    # request receipt THROUGH the admission queue: a request whose
+    # budget expires before scoring starts is refused 503 + Retry-After
+    # (`serve.deadline_expired`) instead of burning device time on an
+    # answer the client has given up on. 0 disables the deadline. Once
+    # scoring starts the request runs to completion — partial winner
+    # sets are never served.
+    request_deadline_ms: float = 0.0
+    # Degradation ladder: a "fused" (r15 Pallas) serve-form dispatch
+    # that fails falls back to the bit-identical xla form, counted
+    # (`serve.form_fallback`) and stamped `degraded: true` on the
+    # response. Off = the failure propagates (debugging the kernel).
+    degrade_form_fallback: bool = True
 
     def validate(self) -> None:
         if self.bank_capacity < 1:
             raise ValueError("serving.bank_capacity must be >= 1")
         if self.host_model_cache < 0:
             raise ValueError("serving.host_model_cache must be >= 0")
+        if self.max_queue_depth < 0:
+            raise ValueError("serving.max_queue_depth must be >= 0 "
+                             "(0 = unbounded)")
+        if self.request_deadline_ms < 0:
+            raise ValueError("serving.request_deadline_ms must be >= 0 "
+                             "(0 = no deadline)")
         if self.bank_form not in ("auto", "vmap", "gather"):
             raise ValueError(
                 "serving.bank_form must be auto|vmap|gather, "

@@ -202,12 +202,15 @@ class OAHandler(SimpleHTTPRequestHandler):
                  "forwarding works) to use the editor")
         return True
 
-    def _send_json(self, status: int, obj) -> None:
+    def _send_json(self, status: int, obj,
+                   headers: dict | None = None) -> None:
         payload = json.dumps(obj).encode()
         self.send_response(status)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(payload)))
         self.send_header("Cache-Control", "no-store")
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(payload)
 
@@ -283,7 +286,7 @@ class OAHandler(SimpleHTTPRequestHandler):
         from onix.feedback.filter import filter_from_csv
         from onix.store import model_name
         base = model_name(datatype, date)
-        with self.server.bank_lock:
+        with service.lock:
             # Compile INSIDE the lock: an install always reflects the
             # CSV's state at install time and installs are serialized,
             # so two racing /feedback POSTs can never leave an older
@@ -311,6 +314,14 @@ class OAHandler(SimpleHTTPRequestHandler):
         if self._reject_cross_site():
             return
         from onix.serving.model_bank import BankRefusal, ScoreRequest
+        from onix.utils.resilience import (Deadline, DeadlineExceeded,
+                                           Overloaded)
+        # The deadline clock starts at request RECEIPT — time spent in
+        # the admission queue counts against the budget, so a request
+        # that queued past its deadline is refused instead of burning
+        # device time on an answer the client abandoned.
+        deadline = (Deadline(self.cfg.serving.request_deadline_ms / 1e3)
+                    if self.cfg.serving.request_deadline_ms > 0 else None)
         try:
             body = self._read_json_body()
             raw = body["requests"]
@@ -339,11 +350,31 @@ class OAHandler(SimpleHTTPRequestHandler):
         from onix.checkpoint import ModelIntegrityError
         service = self.server.bank_service(self.cfg)
         try:
-            # One writer at a time: residency + cache bookkeeping are
-            # host-side state shared across handler threads.
-            with self.server.bank_lock:
-                results = service.score(reqs, tol=tol,
-                                        max_results=max_results)
+            # submit() is the r16 admission-controlled entry: it takes
+            # the service's scoring lock itself (one writer at a time —
+            # residency + cache bookkeeping are host-side state shared
+            # across handler threads), sheds past max_queue_depth, and
+            # refuses deadline-expired requests before any device work.
+            results = service.submit(reqs, tol=tol,
+                                     max_results=max_results,
+                                     deadline=deadline)
+        except Overloaded as e:
+            # Load shed: 503 + Retry-After, nothing mutated
+            # (docs/ROBUSTNESS.md "serving resilience"). RFC 9110
+            # delay-seconds is a non-negative INTEGER — a fractional
+            # value makes spec-compliant clients (urllib3 Retry) choke
+            # on the header — so round the hint up to a whole second.
+            self._send_json(503, {"ok": False, "shed": True,
+                                  "error": str(e)},
+                            headers={"Retry-After":
+                                     str(max(1, math.ceil(
+                                         e.retry_after_s)))})
+            return
+        except DeadlineExceeded as e:
+            self._send_json(503, {"ok": False, "deadline_expired": True,
+                                  "error": str(e)},
+                            headers={"Retry-After": "1"})
+            return
         except (BankRefusal, ModelIntegrityError) as e:
             # Refusal semantics (docs/ROBUSTNESS.md): unknown tenant,
             # out-of-range ids, rotted model — rejected before any
@@ -355,7 +386,7 @@ class OAHandler(SimpleHTTPRequestHandler):
         # 8259 — JSON.parse in a browser throws). Null them instead.
         self._send_json(200, {"ok": True, "results": [
             {"tenant": req.tenant, "window": req.window,
-             "cached": res.cached,
+             "cached": res.cached, "degraded": res.degraded,
              "scores": [s if math.isfinite(s) else None
                         for s in np.asarray(res.topk.scores).tolist()],
              "indices": np.asarray(res.topk.indices).tolist()}
@@ -365,7 +396,7 @@ class OAHandler(SimpleHTTPRequestHandler):
         from onix.checkpoint import list_models
         from onix.utils.obs import counters
         service = self.server.bank_service(self.cfg)
-        with self.server.bank_lock:
+        with service.lock:
             stats = {
                 "tenants_registered": len(service.bank.tenants()),
                 "models_on_disk": len(list_models(
@@ -373,7 +404,9 @@ class OAHandler(SimpleHTTPRequestHandler):
                 "dispatches": service.bank.dispatches,
                 "compiled_shapes": len(service.bank.compiled_shapes),
                 "cache": service.cache_stats(),
-                "counters": counters.snapshot("bank"),
+                "admission": service.admission_stats(),
+                "counters": {**counters.snapshot("bank"),
+                             **counters.snapshot("serve")},
             }
         self._send_json(200, stats)
 
@@ -548,6 +581,10 @@ class OAServer(ThreadingHTTPServer):
         from onix.oa.kernel import KernelManager
         super().__init__(*args, **kw)
         self.kernels = KernelManager()
+        # Guards LAZY CONSTRUCTION of the bank service only (r16):
+        # scoring + filter installs serialize on the service's OWN
+        # lock (BankService.lock), which submit() takes itself after
+        # admission control — so a shed request never waits here.
         self.bank_lock = threading.Lock()
         self._bank_service = None
 
@@ -633,11 +670,16 @@ class OAServer(ThreadingHTTPServer):
                                  host_capacity=cfg.serving.host_model_cache,
                                  filter_loader=filter_loader,
                                  epoch_loader=epoch_loader,
-                                 serve_form=cfg.serving.serve_form)
+                                 serve_form=cfg.serving.serve_form,
+                                 degrade_form_fallback=(
+                                     cfg.serving.degrade_form_fallback))
                 self._bank_service = BankService(
                     bank,
                     max_batch_requests=cfg.serving.max_batch_requests,
-                    cache_size=cfg.serving.winner_cache_size)
+                    cache_size=cfg.serving.winner_cache_size,
+                    max_queue_depth=cfg.serving.max_queue_depth,
+                    request_deadline_s=(
+                        cfg.serving.request_deadline_ms / 1e3))
             return self._bank_service
 
     def server_close(self):

@@ -53,6 +53,8 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import threading
+import time
 from collections import OrderedDict
 
 import jax
@@ -64,7 +66,24 @@ from onix.feedback.filter import (FILTER_FLOOR, FilterTables, HostFilter,
                                   _pad_sorted, apply_filter, split_key)
 from onix.models.compaction import pow2_bucket
 from onix.models.scoring import TopK, _scan_bottom_k, _subscan_scores, score_events
+from onix.utils import faults
 from onix.utils.obs import counters
+from onix.utils.resilience import (Deadline, DeadlineExceeded, Overloaded,
+                                   RetryPolicy, retry_call)
+
+# Bounded absorb-and-replay budgets for the serve-path fault sites
+# (docs/ROBUSTNESS.md "serving resilience"). Injected faults fire at
+# ENTRY points — before any cache/residency/filter mutation — so one
+# bounded retry replays the call safely (the stream:batch discipline);
+# zero backoff because the sites are in-process, not I/O.
+_SERVE_RETRY = RetryPolicy(max_attempts=2, base_backoff_s=0.0, jitter=0.0,
+                           salvage_on_final=False)
+# Model loads ARE I/O (models_dir may be network-backed): transient
+# OSErrors get one backed-off retry, then the batch is REFUSED
+# (BankRefusal) instead of wedging on a dead filesystem.
+_LOAD_RETRY = RetryPolicy(max_attempts=2, base_backoff_s=0.05,
+                          max_backoff_s=1.0, jitter=0.0,
+                          salvage_on_final=False)
 
 # Pad floors for the bank shape ladder: smallest [D_pad]/[V_pad] a
 # tenant occupies. Low floors would mint a compiled shape class per
@@ -299,7 +318,8 @@ class ModelBank:
     def __init__(self, capacity: int = 64, form: str = "auto",
                  loader=None, bulk_loader=None, host_capacity: int = 0,
                  filter_loader=None, epoch_loader=None,
-                 serve_form: str = "auto"):
+                 serve_form: str = "auto",
+                 degrade_form_fallback: bool = True):
         if capacity < 1:
             raise ValueError("bank capacity must be >= 1")
         if host_capacity < 0:
@@ -330,7 +350,16 @@ class ModelBank:
         # logic): distinguishes "same file reloaded" from "new file
         # whose stamp trails the filter-inflated in-memory epoch".
         self._disk_epochs: dict[str, int] = {}
+        # Degradation ladder (r16): a failed "fused" dispatch re-runs
+        # through the bit-identical xla kernels instead of failing the
+        # wave (`serve.form_fallback`; docs/ROBUSTNESS.md "serving
+        # resilience"). Winners are identical by the r15 contract.
+        self.degrade_form_fallback = degrade_form_fallback
         self.dispatches = 0
+        # Per-BANK fallback tally: the service's degraded stamp keys on
+        # THIS bank's dispatches, never the process-global counter (two
+        # services in one process must not stamp each other degraded).
+        self.fallback_dispatches = 0
         self.compiled_shapes: set[tuple] = set()
 
     # -- registry ---------------------------------------------------------
@@ -439,12 +468,33 @@ class ModelBank:
             self.set_filter(t, filt)
         return self.epoch(base)
 
+    def _load_retried(self, what: str, fn):
+        """Drive a model load under the bounded `_LOAD_RETRY` policy.
+        Loads are the one serve-path stage that touches a filesystem
+        (models_dir may be network-backed), so transient OSErrors get
+        one backed-off retry; exhaustion REFUSES with BankRefusal
+        (`bank.load_refusal`) instead of wedging the batch — the
+        degradation ladder's refuse-never-wedge rung
+        (docs/ROBUSTNESS.md "serving resilience"). Non-I/O errors
+        (ModelIntegrityError, BankRefusal) propagate untouched: a
+        digest mismatch is not transient."""
+        try:
+            return retry_call(lambda strict: fn(), policy=_LOAD_RETRY,
+                              counter_prefix="bank.load",
+                              retry_on=OSError)
+        except OSError as e:
+            counters.inc("bank.load_refusal")
+            raise BankRefusal(
+                f"{what}: model load failed after "
+                f"{_LOAD_RETRY.max_attempts} attempts: {e}") from e
+
     def model(self, tenant: str) -> TenantModel:
         m = self._models.get(tenant)
         if m is not None:
             self._models.move_to_end(tenant)
         if m is None and self._loader is not None:
-            m = self._loader(tenant)
+            m = self._load_retried(f"tenant {tenant!r}",
+                                   lambda: self._loader(tenant))
             if m is not None:
                 self.add(tenant, m.theta, m.phi_wk, epoch=m.epoch)
                 self._loader_backed.add(tenant)
@@ -514,6 +564,10 @@ class ModelBank:
         into `shard`, LRU-evicting non-needed residents as required.
         Called only at request batch boundaries — the winners-identity
         argument for capped banks rests on that."""
+        # Chaos site `bank:admit` fires BEFORE any LRU mutation or H2D
+        # staging, so the bounded retry in _score_wave replays the
+        # whole admission safely (the stream:batch discipline).
+        faults.fire("bank", "admit")
         missing = [t for t in needed if t not in shard.lru]
         for t in needed:
             if t in shard.lru:
@@ -589,7 +643,10 @@ class ModelBank:
                         and req.tenant not in unknown:
                     unknown.append(req.tenant)
             if unknown:
-                for t, m in self._bulk_loader(unknown).items():
+                loaded = self._load_retried(
+                    f"{len(unknown)} tenants",
+                    lambda: self._bulk_loader(unknown))
+                for t, m in loaded.items():
                     self.add(t, m.theta, m.phi_wk, epoch=m.epoch)
                     self._loader_backed.add(t)
                     self._load_filter(t)
@@ -671,7 +728,12 @@ class ModelBank:
         for i in wave:
             if requests[i].tenant not in needed:
                 needed.append(requests[i].tenant)
-        self._ensure_resident(shard, needed)
+        # One bounded replay for injected admission faults (the site
+        # fires at _ensure_resident entry, pre-mutation); real load
+        # I/O failures are retried-then-refused inside _load_retried.
+        retry_call(lambda strict: self._ensure_resident(shard, needed),
+                   policy=_SERVE_RETRY, counter_prefix="bank.admit",
+                   retry_on=faults.InjectedFault)
 
         r = len(wave)
         n_events = [int(np.asarray(requests[i].doc_ids).size) for i in wave]
@@ -713,10 +775,26 @@ class ModelBank:
         shape_key = (form, serve, shard.d_pad, shard.v_pad, shard.k,
                      r_pad, n_pad, max_results, filt_dims)
         self.compiled_shapes.add(shape_key)
-        res = _bank_kernel_for(form, serve)(
-            shard.theta, shard.phi, jnp.asarray(slots), jnp.asarray(d),
-            jnp.asarray(w), jnp.asarray(m), jnp.float32(tol),
-            filt_rows, max_results=max_results)
+        args = (shard.theta, shard.phi, jnp.asarray(slots), jnp.asarray(d),
+                jnp.asarray(w), jnp.asarray(m), jnp.float32(tol),
+                filt_rows)
+        try:
+            res = _bank_kernel_for(form, serve)(
+                *args, max_results=max_results)
+        except Exception:                       # noqa: BLE001 — the
+            # degradation ladder's first rung: a fused-kernel failure
+            # (Mosaic lowering, VMEM overflow, injected chaos) falls
+            # back to the bit-identical xla kernels — same winners by
+            # the r15 identity contract — instead of failing the wave.
+            # Counted + stamped degraded upstream; never silent.
+            if serve != "fused" or not self.degrade_form_fallback:
+                raise
+            counters.inc("serve.form_fallback")
+            self.fallback_dispatches += 1
+            self.compiled_shapes.add(shape_key[:1] + ("xla",)
+                                     + shape_key[2:])
+            res = _bank_kernel_for(form, "xla")(
+                *args, max_results=max_results)
         self.dispatches += 1
         counters.inc("bank.dispatch")
         counters.inc("bank.requests", r)
@@ -729,9 +807,16 @@ class ModelBank:
 
 @dataclasses.dataclass
 class BankResult:
-    """One request's outcome through the service: winners + provenance."""
+    """One request's outcome through the service: winners + provenance.
+    `degraded` stamps a response served under the degradation ladder —
+    the service was past its soft overload watermark, or the wave fell
+    back from the fused to the xla kernel. Degraded NEVER means stale:
+    winners are current-epoch by the same cache contract as any other
+    response; the stamp is latency/arm provenance, not a correctness
+    hedge (docs/ROBUSTNESS.md "serving resilience")."""
     topk: TopK
     cached: bool
+    degraded: bool = False
 
 
 class BankService:
@@ -753,17 +838,144 @@ class BankService:
     (`bank.cache_conflict`) — never served stale."""
 
     def __init__(self, bank: ModelBank, max_batch_requests: int = 64,
-                 cache_size: int = 4096):
+                 cache_size: int = 4096, max_queue_depth: int = 0,
+                 request_deadline_s: float = 0.0):
         if max_batch_requests < 1:
             raise ValueError("max_batch_requests must be >= 1")
+        if max_queue_depth < 0 or request_deadline_s < 0:
+            raise ValueError("max_queue_depth and request_deadline_s "
+                             "must be >= 0 (0 = disabled)")
         self.bank = bank
         self.max_batch_requests = max_batch_requests
         self.cache_size = cache_size
         self._cache: OrderedDict[tuple[str, str, float, int],
                                  tuple[int, int, TopK]] = OrderedDict()
+        # r16 admission control (docs/ROBUSTNESS.md "serving
+        # resilience"): `lock` serializes scoring + filter installs
+        # (host-side cache/residency state is shared across handler
+        # threads — the serve layer used to hold its own lock here);
+        # `max_queue_depth` bounds in-flight + queued submit() calls,
+        # beyond which requests SHED (Overloaded → 503 + Retry-After)
+        # BEFORE touching any bank state; `request_deadline_s` bounds
+        # receipt→scoring-start wall (queue time included).
+        self.lock = threading.RLock()
+        self.max_queue_depth = max_queue_depth
+        self.request_deadline_s = request_deadline_s
+        self._admit_lock = threading.Lock()
+        self._pending = 0
+        self.peak_depth = 0
+        # EWMA of recent scoring walls — the Retry-After hint (how long
+        # until a queue slot likely frees). Seeded pessimistically low;
+        # the first real call corrects it.
+        self._ewma_wall_s = 0.05
+
+    # -- admission control + deadline (the submit path) -------------------
+
+    def submit(self, requests: list[ScoreRequest], *, tol: float,
+               max_results: int,
+               deadline: Deadline | None = None) -> list[BankResult]:
+        """The admission-controlled, deadline-bounded serve entry point
+        (`/score` and the load harness both come through here).
+
+        Order of refusals, all BEFORE any bank mutation:
+          1. depth — `max_queue_depth` submit() calls already in flight
+             or queued ⇒ shed (`serve.shed`, Overloaded → HTTP 503 with
+             Retry-After). A shed request never touches residency or
+             the winner cache (asserted by the overload cell).
+          2. deadline — the budget (passed in, or request_deadline_s
+             from admission) is checked once scoring WOULD start, i.e.
+             after the queue wait; expired ⇒ refused
+             (`serve.deadline_expired`, DeadlineExceeded → 503). Once
+             scoring starts the request runs to completion — partial
+             winner sets are never served.
+
+        Served responses past the soft watermark (depth > half the
+        max) or scored through the form-fallback rung are stamped
+        `degraded: true` (`serve.degraded`) — an explicit overload
+        signal, never stale winners: the epoch-keyed cache contract is
+        unchanged on every rung."""
+        with self._admit_lock:
+            if self.max_queue_depth \
+                    and self._pending >= self.max_queue_depth:
+                counters.inc("serve.shed")
+                counters.inc("serve.shed_requests", len(requests))
+                raise Overloaded(
+                    f"serving queue full ({self._pending} batches in "
+                    f"flight, max_queue_depth={self.max_queue_depth})",
+                    retry_after_s=max(
+                        0.1, round(self._pending * self._ewma_wall_s, 2)))
+            self._pending += 1
+            depth = self._pending
+            # Two scopes on purpose: peak_depth is THIS service's
+            # high-water (admission_stats / GET /bank/stats — one
+            # service per server); the registry gauge is the
+            # process-wide max across services (what bench's
+            # detail.resilience snapshot carries — a harness running
+            # several services reports the worst one).
+            self.peak_depth = max(self.peak_depth, depth)
+            counters.note_max("serve.queue_depth_peak", depth)
+            soft = bool(self.max_queue_depth
+                        and depth > max(1, self.max_queue_depth // 2))
+        if deadline is None and self.request_deadline_s > 0:
+            deadline = Deadline(self.request_deadline_s)
+        try:
+            with self.lock:
+                # Clock starts INSIDE the lock: the EWMA must track
+                # scoring wall only — folding queue wait in would make
+                # the Retry-After hint compound quadratically under
+                # sustained contention (wait ≈ depth × ewma ⇒ ewma ≈
+                # depth × service ⇒ hint ≈ depth² × service).
+                t0 = time.perf_counter()
+                if deadline is not None and deadline.expired():
+                    # counters: resilience.deadline_exceeded is inc'd
+                    # by Deadline.check; serve.deadline_expired is the
+                    # serve-tier view bench folds into artifacts.
+                    counters.inc("serve.deadline_expired")
+                    deadline.check("serve request (queued past its "
+                                   "deadline budget)")
+                fb0 = self.bank.fallback_dispatches
+                # Bounded replay for the injected `serve:score` site —
+                # it fires at score() entry, before any cache or
+                # residency mutation, so the retry is a safe replay.
+                results = retry_call(
+                    lambda strict: self.score(requests, tol=tol,
+                                              max_results=max_results),
+                    policy=_SERVE_RETRY, counter_prefix="serve.score",
+                    retry_on=faults.InjectedFault)
+                fell_back = self.bank.fallback_dispatches > fb0
+            wall = time.perf_counter() - t0
+            self._ewma_wall_s += 0.3 * (wall - self._ewma_wall_s)
+        finally:
+            with self._admit_lock:
+                self._pending -= 1
+        if soft or fell_back:
+            counters.inc("serve.degraded")
+            counters.inc("serve.degraded_requests", len(requests))
+            results = [dataclasses.replace(r, degraded=True)
+                       for r in results]
+        counters.inc("serve.served", len(requests))
+        return results
+
+    def admission_stats(self) -> dict:
+        with self._admit_lock:
+            depth = self._pending
+        return {"queue_depth": depth,
+                "queue_depth_peak": self.peak_depth,
+                "max_queue_depth": self.max_queue_depth,
+                "request_deadline_s": self.request_deadline_s,
+                "shed": counters.get("serve.shed"),
+                "shed_requests": counters.get("serve.shed_requests"),
+                "deadline_expired": counters.get("serve.deadline_expired"),
+                "degraded": counters.get("serve.degraded"),
+                "form_fallback": counters.get("serve.form_fallback"),
+                "served": counters.get("serve.served")}
 
     def score(self, requests: list[ScoreRequest], *, tol: float,
               max_results: int) -> list[BankResult]:
+        # Chaos site `serve:score`: entry, pre-mutation (before the
+        # disk-epoch probes and cache bookkeeping), so submit()'s
+        # bounded retry replays the whole call safely.
+        faults.fire("serve", "score")
         out: list[BankResult | None] = [None] * len(requests)
         # Out-of-process update probe, once per distinct tenant per
         # call (ModelBank.refresh_from_disk): a re-save by another
@@ -820,13 +1032,25 @@ class BankService:
         unknowable here, so its stale entries cannot be reached
         through epochs (its filter attaches, with a bump, when it next
         loads; but a cached pre-evict entry would hit before any load
-        runs). Returns base's new epoch."""
-        epoch = self.bank.set_filter_tree(base, filt)
-        prefix = base + "/"
-        for key in [k for k in self._cache
-                    if k[0] == base or k[0].startswith(prefix)]:
-            del self._cache[key]
-        return epoch
+        runs). Returns base's new epoch.
+
+        Chaos site `feedback:install` fires at entry — before the
+        filter, epochs, or cache are touched — and is absorbed by one
+        bounded in-place retry (the install is deterministic in its
+        inputs, so the replay installs the identical filter): a fault
+        can delay an install by one retry, never lose it or leave a
+        half-installed filter live."""
+        def _install(strict: bool = True) -> int:
+            faults.fire("feedback", "install")
+            epoch = self.bank.set_filter_tree(base, filt)
+            prefix = base + "/"
+            for key in [k for k in self._cache
+                        if k[0] == base or k[0].startswith(prefix)]:
+                del self._cache[key]
+            return epoch
+        return retry_call(_install, policy=_SERVE_RETRY,
+                          counter_prefix="serve.feedback_install",
+                          retry_on=faults.InjectedFault)
 
     def _put(self, key, value) -> None:
         self._cache[key] = value

@@ -16,6 +16,16 @@ Grammar: comma-separated rules `stage:point@N=action`.
                                     retried batch is safe)
                   fit:sweep       — run_fit_segments superstep boundary
                   ckpt:save       — checkpoint.save
+                  campaign:prepare— campaign.py host-prepare entry
+                  serve:score     — BankService.score entry (before
+                                    any cache/residency mutation, so
+                                    the bounded serve retry replays
+                                    safely — r16 serving resilience)
+                  bank:admit      — ModelBank._ensure_resident entry
+                                    (before any LRU mutation or H2D)
+                  feedback:install— BankService.apply_feedback_filter
+                                    entry (before the filter/epoch
+                                    install mutates anything)
   @N            for counted points (decode, batch, save): the Nth call
                 to that point. For indexed points (fit:sweep, which
                 passes the sweep number): the first boundary at or

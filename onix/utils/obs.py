@@ -45,6 +45,18 @@ class CounterRegistry:
         with self._lock:
             return self._counts.get(name, 0)
 
+    def note_max(self, name: str, value: int) -> int:
+        """High-water-mark counter: keep the LARGEST value ever noted
+        (e.g. `serve.queue_depth_peak`). Same namespace and snapshot
+        path as the event counters, so manifests carry gauges and
+        tallies through one registry."""
+        with self._lock:
+            cur = self._counts.get(name, 0)
+            if int(value) > cur:
+                self._counts[name] = int(value)
+                cur = int(value)
+            return cur
+
     def snapshot(self, prefix: str = "") -> dict[str, int]:
         """Copy of the current counts (optionally only names under
         `prefix`) — what manifests embed."""

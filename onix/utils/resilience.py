@@ -52,6 +52,19 @@ class DeadlineExceeded(TimeoutError):
     """A stage overran its wall-clock budget."""
 
 
+class Overloaded(RuntimeError):
+    """A request refused by admission control (load shed) — the serving
+    layer renders it as HTTP 503 with a Retry-After of
+    `retry_after_s`. Shedding is a REFUSAL, not a failure: the request
+    was never started, so it mutated nothing (no bank residency, no
+    winner-cache entries) and an immediate retry after the hint is
+    safe."""
+
+    def __init__(self, msg: str, retry_after_s: float = 1.0):
+        super().__init__(msg)
+        self.retry_after_s = float(retry_after_s)
+
+
 @dataclasses.dataclass(frozen=True)
 class RetryPolicy:
     """Bounded retries with exponential backoff + jitter.

@@ -68,6 +68,10 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--ladder", default="8,64",
                     help="comma list of bank sizes for the form-"
                          "crossover ladder ('' skips)")
+    ap.add_argument("--overload-cell", action="store_true",
+                    help="run the r16 overload SLO cell (shed + bounded "
+                         "p99 proof, docs/ROBUSTNESS.md 'serving "
+                         "resilience') and embed its artifact")
     ap.add_argument("--out", default=None)
     args = ap.parse_args(argv)
 
@@ -150,6 +154,7 @@ def main(argv: list[str] | None = None) -> int:
     try:
         peak, peak_src = device_peak_bytes_per_s()
     except Exception:                           # noqa: BLE001
+        counters.inc("bench.peak_probe_failed")
         peak, peak_src = None, "probe failed"
     rl = roofline(n_events, best[best_form],
                   bank_score_bytes_per_event(spec.n_topics), peak)
@@ -195,7 +200,16 @@ def main(argv: list[str] | None = None) -> int:
     if rows:
         doc["bank_size_ladder"] = rows
 
+    # -- overload SLO cell: shed + bounded-p99 proof (r16) ----------------
+    if args.overload_cell:
+        cell_spec = dataclasses.replace(
+            spec, n_windows=max(args.windows, 1),
+            n_requests=max(32, args.requests // 4),
+            batch_requests=min(args.batch, 8))
+        doc["overload_cell"] = lh.overload_cell(cell_spec, form=best_form)
+
     doc["bank_counters"] = counters.snapshot("bank")
+    doc["serve_counters"] = counters.snapshot("serve")
     out = json.dumps(doc, indent=2)
     print(out)
     if args.out:

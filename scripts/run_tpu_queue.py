@@ -37,6 +37,7 @@ import argparse
 import json
 import os
 import pathlib
+import signal
 import subprocess
 import sys
 import time
@@ -71,7 +72,29 @@ def entry_argv(entry: dict) -> list[str]:
     raise ValueError(f"unknown entry kind {entry['kind']!r}")
 
 
-def run_entry(entry: dict, timeout_scale: float) -> dict:
+def _kill_group(proc: subprocess.Popen) -> None:
+    """SIGKILL the entry's whole PROCESS GROUP. A bare proc.kill() only
+    reaches the direct child: a pytest/bench row that spawned its own
+    workers (subprocess probes, mp ingest pools) leaves grandchildren
+    holding the stdout pipe, and the parent's read blocks FOREVER after
+    the timeout — the hung row then burns the remaining tunnel window,
+    exactly what --max-minutes exists to prevent."""
+    try:
+        os.killpg(proc.pid, signal.SIGKILL)
+    except (ProcessLookupError, PermissionError):
+        proc.kill()
+
+
+def run_entry(entry: dict, timeout_scale: float,
+              budget_left_s: float | None = None) -> dict:
+    """One queue entry in a crash-isolated subprocess (its own session,
+    so a kill reaps the whole tree) under a hard per-entry timeout.
+    The timeout is the scaled estimate, CLAMPED to the remaining window
+    budget (`budget_left_s`) — a hung row can overrun its own estimate
+    but never the window (the Deadline.remaining discipline from
+    utils/resilience: children never outlive the stage budget). The
+    outcome lands in the manifest as `outcome`: ok | error (rc != 0) |
+    crash (killed by a signal) | timeout."""
     LOG_DIR.mkdir(parents=True, exist_ok=True)
     log_path = LOG_DIR / f"{entry['id']}.log"
     argv = entry_argv(entry)
@@ -80,21 +103,40 @@ def run_entry(entry: dict, timeout_scale: float) -> dict:
     # routinely run 2-3x a warm estimate, but a hang must not eat the
     # whole window (the bench watchdog lesson, bench.py main()).
     timeout = max(300.0, entry.get("est_minutes", 10) * 60 * timeout_scale)
+    if budget_left_s is not None:
+        # +60s grace: the clamp bounds a HANG, not a healthy row that
+        # finishes just past the line.
+        timeout = min(timeout, max(60.0, budget_left_s + 60.0))
     t0 = time.monotonic()
     rec = {"id": entry["id"], "cmd": argv, "log": str(log_path),
            "timeout_s": round(timeout, 0)}
+    proc = subprocess.Popen(argv, cwd=ROOT, env=env, text=True,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE,
+                            start_new_session=True)
     try:
-        r = subprocess.run(argv, cwd=ROOT, env=env, timeout=timeout,
-                           capture_output=True, text=True)
-        rec["rc"] = r.returncode
-        out, err = r.stdout, r.stderr
-    except subprocess.TimeoutExpired as e:
+        out, err = proc.communicate(timeout=timeout)
+        rec["rc"] = proc.returncode
+        if proc.returncode == 0:
+            rec["outcome"] = "ok"
+        elif proc.returncode < 0:
+            rec["outcome"] = "crash"
+            # strsignal, not Signals(): real-time signals (SIGRTMIN+n)
+            # are outside the enum and would crash the queue walker —
+            # the exact burn-the-window failure this path prevents.
+            rec["signal"] = (signal.strsignal(-proc.returncode)
+                             or f"signal {-proc.returncode}")
+        else:
+            rec["outcome"] = "error"
+    except subprocess.TimeoutExpired:
+        _kill_group(proc)
+        try:        # the group is dead, so the pipes close promptly
+            out, err = proc.communicate(timeout=30)
+        except subprocess.TimeoutExpired:
+            out, err = "", "(output unrecoverable after group kill)"
         rec["rc"] = None
         rec["timed_out"] = True
-        out = (e.stdout or b"").decode(errors="replace") \
-            if isinstance(e.stdout, bytes) else (e.stdout or "")
-        err = (e.stderr or b"").decode(errors="replace") \
-            if isinstance(e.stderr, bytes) else (e.stderr or "")
+        rec["outcome"] = "timeout"
     rec["wall_s"] = round(time.monotonic() - t0, 1)
     log_path.write_text(f"$ {' '.join(argv)}\n\n== stdout ==\n{out}\n"
                         f"== stderr ==\n{err}\n")
@@ -174,8 +216,10 @@ def main(argv: list[str] | None = None) -> int:
         out_path.write_text(json.dumps(results, indent=2) + "\n")
 
     for entry in entries:
+        budget_left_s = None
         if deadline is not None:
-            left_min = (deadline - time.monotonic()) / 60
+            budget_left_s = deadline - time.monotonic()
+            left_min = budget_left_s / 60
             if entry.get("est_minutes", 10) > left_min:
                 results["entries"].append(
                     {"id": entry["id"], "skipped":
@@ -185,7 +229,8 @@ def main(argv: list[str] | None = None) -> int:
                 continue
         print(f"== {entry['id']} (est ~{entry.get('est_minutes')} min)",
               flush=True)
-        rec = run_entry(entry, args.timeout_scale)
+        rec = run_entry(entry, args.timeout_scale,
+                        budget_left_s=budget_left_s)
         print(f"   rc={rec.get('rc')} wall={rec['wall_s']}s "
               f"log={rec['log']}", flush=True)
         results["entries"].append(rec)

@@ -21,6 +21,10 @@ def test_invalid_values_rejected():
         from_dict({"lda": {"n_topics": 1}})
     with pytest.raises(ValueError):
         from_dict({"pipeline": {"datatype": "netbios"}})
+    with pytest.raises(ValueError):
+        from_dict({"serving": {"max_queue_depth": -1}})
+    with pytest.raises(ValueError):
+        from_dict({"serving": {"request_deadline_ms": -5}})
 
 
 def test_load_with_overrides(tmp_path):
