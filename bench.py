@@ -1631,6 +1631,16 @@ def _measure() -> None:
                       for k in ("shed", "degraded", "form_fallback",
                                 "deadline_expired", "score.retries",
                                 "served")}
+    # r17: the contract-linter stamp — every bench artifact records
+    # the analyzer version and finding count over onix/ + bench.py +
+    # scripts/, so an evidence JSON also says the tree it was earned
+    # on was lint-clean (docs/ROBUSTNESS.md "The contract linter").
+    try:
+        from onix.analysis import lint_status
+        resil["lint"] = lint_status()
+    except Exception as e:
+        _counters.inc("bench.lint_status_failed")
+        resil["lint"] = {"error": repr(e)}
     detail["resilience"] = resil
     save()
 

@@ -1,0 +1,97 @@
+"""Generated documentation sections — the env-var registry and the
+counter-namespace table render into docs/ROBUSTNESS.md between marker
+comments, and the `faultdocs` pass verifies the rendered text is
+current, so the doc can never drift from the registries it documents.
+
+`python -m onix.analysis --write-docs` rewrites the sections in place.
+Rendering parses the registries from the AST (never imports), same as
+every pass.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+
+from onix.analysis.core import AnalysisContext
+from onix.analysis.passes import _module_dict, _str_const
+
+SECTIONS = ("env-registry", "counter-namespaces")
+
+
+def begin_marker(section: str) -> str:
+    return f"<!-- BEGIN GENERATED: {section} (python -m onix.analysis --write-docs) -->"
+
+
+def end_marker(section: str) -> str:
+    return f"<!-- END GENERATED: {section} -->"
+
+
+def _env_rows(ctx: AnalysisContext) -> list[tuple[str, str, str]]:
+    _, reg, _ = _module_dict(ctx, "ENV_REGISTRY")
+    rows = []
+    for name, value in sorted(reg.items()):
+        typ, doc = "", ""
+        if isinstance(value, ast.Tuple) and len(value.elts) == 2:
+            typ = _str_const(value.elts[0]) or ""
+            doc = _str_const(value.elts[1]) or ""
+        rows.append((name, typ, doc))
+    return rows
+
+
+def _counter_rows(ctx: AnalysisContext) -> list[tuple[str, str]]:
+    _, ns, _ = _module_dict(ctx, "COUNTER_NAMESPACES")
+    return [(name, _str_const(value) or "")
+            for name, value in sorted(ns.items())]
+
+
+def render_section(ctx: AnalysisContext, section: str) -> str:
+    if section == "env-registry":
+        lines = ["| env | type | meaning |", "|---|---|---|"]
+        lines += [f"| `{n}` | {t} | {d} |" for n, t, d in _env_rows(ctx)]
+        return "\n".join(lines)
+    if section == "counter-namespaces":
+        lines = ["| namespace | events counted under it |", "|---|---|"]
+        lines += [f"| `{n}.*` | {d} |" for n, d in _counter_rows(ctx)]
+        return "\n".join(lines)
+    raise ValueError(f"unknown generated section {section!r}")
+
+
+def extract_section(text: str, section: str) -> str | None:
+    """The current content between the section's markers, or None when
+    the markers are absent/unterminated."""
+    begin, end = begin_marker(section), end_marker(section)
+    i = text.find(begin)
+    if i < 0:
+        return None
+    j = text.find(end, i)
+    if j < 0:
+        return None
+    return text[i + len(begin):j]
+
+
+def write_docs(ctx: AnalysisContext) -> list[str]:
+    """Rewrite every stale generated section in docs/ROBUSTNESS.md.
+    Returns the sections actually rewritten."""
+    doc_path = ctx.root / "docs" / "ROBUSTNESS.md"
+    text = doc_path.read_text()
+    written = []
+    for section in SECTIONS:
+        current = extract_section(text, section)
+        if current is None:
+            continue        # markers absent: faultdocs reports it
+        want = render_section(ctx, section)
+        if current.strip() == want.strip():
+            continue
+        begin, end = begin_marker(section), end_marker(section)
+        i = text.find(begin) + len(begin)
+        j = text.find(end, i)
+        text = text[:i] + "\n" + want + "\n" + text[j:]
+        written.append(section)
+    if written:
+        doc_path.write_text(text)
+    return written
+
+
+def write_docs_at(root: str | pathlib.Path | None = None) -> list[str]:
+    return write_docs(AnalysisContext.from_root(root))

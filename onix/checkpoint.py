@@ -294,6 +294,57 @@ def list_models(models_dir: str | pathlib.Path) -> list[str]:
 _SAMPLING_FIELDS = ("n_topics", "alpha", "eta", "burn_in", "block_size",
                     "seed", "n_chains", "sync_splits")
 
+#: The fingerprint CONTRACT, machine-checked by `python -m
+#: onix.analysis` (the `fingerprints` pass): every LDAConfig field the
+#: engine modules read must appear here (value = where it joins a
+#: checkpoint fingerprint) or in FINGERPRINT_EXEMPT (value = why it is
+#: safe outside one). A new semantics-changing knob that reaches an
+#: engine without joining either table is a lint finding — the next
+#: `merge_staleness`-class knob cannot ship without resume refusal
+#: (the r11/r14 contract; resume-refusal behavior itself is covered by
+#: tests/test_sparse_gibbs.py, test_merge_async.py, test_scvb0.py).
+FINGERPRINT_FIELDS: dict[str, str] = {
+    "n_topics": "_SAMPLING_FIELDS (every fingerprint)",
+    "alpha": "_SAMPLING_FIELDS (every fingerprint)",
+    "eta": "_SAMPLING_FIELDS (every fingerprint)",
+    "burn_in": "_SAMPLING_FIELDS (every fingerprint)",
+    "block_size": "_SAMPLING_FIELDS (every fingerprint)",
+    "seed": "_SAMPLING_FIELDS (every fingerprint)",
+    "n_chains": "_SAMPLING_FIELDS (every fingerprint)",
+    "sync_splits": "_SAMPLING_FIELDS (every fingerprint)",
+    "superstep": "fingerprint(superstep=...) — the RESOLVED fused size",
+    "sampler_form": "lda_gibbs.sampler_fingerprint (sparse arm only)",
+    "sparse_active": "lda_gibbs.sampler_fingerprint (sparse arm only)",
+    "sparse_mh": "lda_gibbs.sampler_fingerprint (sparse arm only)",
+    "merge_form": "lda_gibbs.merge_fingerprint (async arm only)",
+    "merge_staleness": "lda_gibbs.merge_fingerprint (async arm only)",
+    "svi_tau0": "streaming _fingerprint svi list (layout 5)",
+    "svi_kappa": "streaming _fingerprint svi list (layout 5)",
+    "svi_local_iters": "streaming _fingerprint svi list (layout 5)",
+    "svi_meanchange_tol": "streaming _fingerprint svi list (layout 5)",
+    "svi_warm_iters": "streaming _fingerprint svi list (EFFECTIVE value)",
+    "stream_estep": "streaming _fingerprint svi list (layout 5)",
+}
+
+#: Fields engines may read WITHOUT fingerprinting, each with the reason
+#: it cannot silently change a resumed chain. Reviewed additions only.
+FINGERPRINT_EXEMPT: dict[str, str] = {
+    "n_sweeps": "run EXTENT, not chain semantics — extending a "
+                "preempted run is the whole point of resume",
+    "checkpoint_every": "save cadence: segments also break here, but "
+                        "ll entries land denser-never-sparser and the "
+                        "async τ>0 segmentation-dependence is the "
+                        "documented in-band contract (ROBUSTNESS.md)",
+    "nwk_form": "all three count-update forms are bit-identical "
+                "(tested) — pure performance, documented as NOT part "
+                "of the fingerprint in config.py",
+    "svi_batch_size": "batch SVI minibatch slicing; the batch engine "
+                      "has no checkpoint/resume path and the streaming "
+                      "scorer's minibatches are the file feed",
+    "svi_max_epochs": "batch SVI epoch cap — run extent, like n_sweeps",
+    "svi_epoch_tol": "batch SVI early-stop — run extent, like n_sweeps",
+}
+
 
 def fingerprint(config, n_docs: int, n_vocab: int, n_tokens: int,
                 extra: dict | None = None,

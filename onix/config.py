@@ -63,6 +63,103 @@ def resolve_form_gate(*, gate: str, choices: tuple[str, ...],
     return default
 
 
+#: The central registry of every `ONIX_*` environment variable the
+#: linted tree (onix/, bench.py, scripts/) reads: name -> (type, doc).
+#: Machine-checked by `python -m onix.analysis` (the `envs` pass): a
+#: literal ONIX_* read of an undeclared name is a finding, and so is a
+#: declaration nothing reads — this table can neither lag nor rot. The
+#: table also renders into docs/ROBUSTNESS.md (generated section
+#: `env-registry`). Leading-underscore names are internal parent/child
+#: handshakes, never operator knobs. Envs are OVERRIDES for
+#: experiments and drills; durable configuration belongs in the typed
+#: config below.
+ENV_REGISTRY: dict[str, tuple[str, str]] = {
+    "ONIX_BANK_FORM": (
+        "choice: auto|vmap|gather",
+        "model-bank batched-scoring form override (model_bank.select_bank_form)"),
+    "ONIX_BENCH_COMPONENTS": (
+        "csv of component names",
+        "bench.py: run only these components (debugging a single arm)"),
+    "ONIX_BENCH_TIMEOUT_S": (
+        "float seconds",
+        "bench.py child wall-clock budget before the parent kills it"),
+    "ONIX_CAMPAIGN_TPU": (
+        "flag: 1=keep ambient backend",
+        "exp_campaign.py: opt into the real TPU instead of pinning CPU"),
+    # lint: exempt[envs] -- read inside the generated notebook-cell SOURCE templates (oa/notebooks.py) and exported to kernels by oa/serve.py; no AST-visible read exists
+    "ONIX_CONFIG": (
+        "path",
+        "notebook kernels: resolved config file the OA cells load"),
+    # lint: exempt[envs] -- read inside the generated notebook-cell SOURCE templates (oa/notebooks.py); exported by oa/serve.py and the CLI
+    "ONIX_DATE": (
+        "string YYYY-MM-DD",
+        "notebook kernels: the scored date the OA cells read"),
+    "ONIX_DEVICE_WORDS": (
+        "flag: 0=host words",
+        "legacy spelling of ONIX_HOST_WORDS=1 (device_words gate)"),
+    "ONIX_DP1_FAST": (
+        "flag: 0=pin wrapped arm",
+        "sharded engine dp=1/mp=1 shard_map-bypass fast path override"),
+    "ONIX_FAULT_PLAN": (
+        "plan: stage:point@N=action,...",
+        "declarative chaos plan (utils/faults.py; docs/ROBUSTNESS.md)"),
+    "ONIX_FAULT_SWEEP": (
+        "int sweep number",
+        "legacy one-off fit:sweep preemption hook (pre-r9 chaos drills)"),
+    "ONIX_GTI_API_KEY": (
+        "secret",
+        "GTI reputation client credential (oa/repclients.py)"),
+    "ONIX_HOST_WORDS": (
+        "flag: 1=host builders",
+        "force the host word-build cross-check arm (device_words gate)"),
+    "ONIX_JAX_CACHE": (
+        "path",
+        "persistent XLA compile-cache dir (accelerators only; obs.py)"),
+    "ONIX_NWK_FORM": (
+        "choice: auto|scatter|matmul|pallas",
+        "n_wk count-update form override (lda_gibbs.select_nwk_form)"),
+    "ONIX_NWK_MATMUL": (
+        "legacy flag: 1=matmul, 0=scatter",
+        "pre-r8 spelling of ONIX_NWK_FORM (make_block_step only)"),
+    "ONIX_PALLAS_INTERPRET": (
+        "flag: 1=interpret, 0=compiled",
+        "Pallas kernels: force interpret/compiled mode (pallas_gibbs)"),
+    "ONIX_PREFETCH_DEPTH": (
+        "int >= 1",
+        "streaming ingest pipeline depth override (ColumnPrefetcher)"),
+    "ONIX_PREFETCH_MODE": (
+        "choice: auto|thread|process",
+        "streaming ingest pipeline worker mode override"),
+    "ONIX_PROBE_BUDGET_S": (
+        "float seconds",
+        "bench.py backend-probe total wall budget"),
+    "ONIX_PROFILE_DIR": (
+        "path",
+        "collect a jax profiler trace into this dir (obs.maybe_trace)"),
+    "ONIX_SAMPLER_FORM": (
+        "choice: auto|dense|sparse",
+        "Gibbs sampler-form override (lda_gibbs.select_sampler_form)"),
+    "ONIX_SCREENED_SELECT": (
+        "flag: 1=on, other=off",
+        "bf16-screened bottom-k scan override (models/scoring.py)"),
+    "ONIX_SERVE_FORM": (
+        "choice: auto|xla|fused",
+        "serving-scan form override (pallas_serve.select_serve_form)"),
+    "ONIX_TX_ACCESS_TOKEN": (
+        "secret",
+        "ThreatExchange reputation client credential (oa/repclients.py)"),
+    "_ONIX_BENCH_CHILD": (
+        "internal flag",
+        "bench.py parent->child marker (the child skips re-spawning)"),
+    "_ONIX_BENCH_PROGRESS": (
+        "internal path",
+        "bench.py child progress file the watchdog parent tails"),
+    "_ONIX_BENCH_T0": (
+        "internal float epoch-s",
+        "bench.py parent start time, for the child's deadline math"),
+}
+
+
 @dataclass
 class LDAConfig:
     """Topic-model hyperparameters.

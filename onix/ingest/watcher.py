@@ -66,6 +66,11 @@ class Ledger:
     "quarantined": {key: sig}}. The v1 flat {key: sig} layout loads as
     all-done (upgraded on first flush)."""
 
+    #: Lock discipline, machine-checked by the `locks` analysis pass:
+    #: claim/commit/release/quarantine race across worker threads.
+    GUARDED_BY = {"_done": "_lock", "_attempts": "_lock",
+                  "_quarantined": "_lock", "_inflight": "_lock"}
+
     def __init__(self, path: str | pathlib.Path):
         self.path = pathlib.Path(path)
         self._lock = threading.Lock()
@@ -189,6 +194,10 @@ class Ledger:
 class IngestWatcher:
     """Poll a landing directory; ingest new files via a worker pool."""
 
+    #: Lock discipline, machine-checked by the `locks` analysis pass:
+    #: the worker pool's threads all tally into stats.
+    GUARDED_BY = {"stats": "_stats_lock"}
+
     def __init__(self, cfg: OnixConfig, datatype: str,
                  landing_dir: str | pathlib.Path,
                  n_workers: int = 2, poll_interval: float = 0.5,
@@ -212,6 +221,8 @@ class IngestWatcher:
         self._polls = 0
         self._pool = concurrent.futures.ThreadPoolExecutor(n_workers)
         self._stop = threading.Event()
+        # GUARDED_BY is declared on the class (the `locks` pass reads
+        # it there); the pool's worker threads all tally into stats.
         self._stats_lock = threading.Lock()
         self.stats: dict[str, int] = {"files": 0, "rows": 0, "errors": 0,
                                       "retries": 0, "quarantined": 0,

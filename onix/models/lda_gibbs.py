@@ -185,6 +185,18 @@ _NWK_MATMUL_MIN_DENSITY = {"tpu": 32.0}
 _NWK_PALLAS_MIN_DENSITY: dict[str, float] = {}
 
 
+def nwk_pallas_auto_reachable(backend: str) -> bool:
+    """Whether the AUTO n_wk gate could resolve "pallas" on `backend` —
+    the capability probe ShardedGibbsLDA uses to drop the shard_map
+    static replication check (shard_map has no replication rule for
+    pallas_call) exactly when the pallas arm might trace. NOT a form
+    decision: the form itself still resolves through select_nwk_form's
+    resolve_form_gate chain — this only answers "is the pallas row of
+    that gate's table populated for this backend"."""
+    # lint: exempt[gates] -- capability probe next to the table it reads; the form decision still goes through select_nwk_form's resolve_form_gate chain
+    return _NWK_PALLAS_MIN_DENSITY.get(backend) is not None
+
+
 def env_nwk_form() -> str | None:
     """Resolve the ONIX_NWK_FORM experiment override. "auto" (and
     empty) mean None — the same spelling LDAConfig.nwk_form accepts for
@@ -309,20 +321,30 @@ def env_sampler_form() -> str | None:
 def select_sampler_form(*, backend: str, k_topics: int,
                         sampler_form: str | None = None) -> str:
     """Trace-time decision for the sampler form ("dense" | "sparse") —
-    the gate shared by GibbsLDA and ShardedGibbsLDA, mirroring
-    select_nwk_form. Priority: explicit form, then the measured
-    per-backend K crossover (_SAMPLER_SPARSE_MIN_K; unmeasured
-    platforms keep dense). An explicit "sparse" is honored at ANY K —
-    at tiny K the top-A block simply saturates (A == K)."""
-    if sampler_form is not None:
-        if sampler_form not in ("dense", "sparse"):
-            raise ValueError(
-                f"sampler_form must be dense|sparse, got {sampler_form!r}")
-        return sampler_form
-    min_k = _SAMPLER_SPARSE_MIN_K.get(backend)
-    if min_k is not None and k_topics >= min_k:
-        return "sparse"
-    return "dense"
+    the gate shared by GibbsLDA and ShardedGibbsLDA.
+
+    Priority (config.resolve_form_gate — the ONE precedence chain
+    shared with select_nwk_form / select_bank_form /
+    select_serve_form, r17: this gate was the last hand-rolled chain):
+    explicit `sampler_form`, then the measured per-backend K crossover
+    (_SAMPLER_SPARSE_MIN_K; unmeasured platforms keep dense). No env
+    layer HERE: the engines resolve ONIX_SAMPLER_FORM themselves
+    (_resolved_sampler_form), where the dense-pin deference must sit
+    BETWEEN the env and the measured table, and hand the result in as
+    `sampler_form`. An explicit "sparse" is honored at ANY K — at tiny
+    K the top-A block simply saturates (A == K)."""
+    from onix.config import resolve_form_gate
+
+    def measured() -> str | None:
+        min_k = _SAMPLER_SPARSE_MIN_K.get(backend)
+        if min_k is not None and k_topics >= min_k:
+            return "sparse"
+        return None
+
+    return resolve_form_gate(gate="sampler_form",
+                             choices=("dense", "sparse"),
+                             explicit=sampler_form, measured=measured,
+                             default="dense")
 
 
 def sampler_fingerprint(form: str, sparse_active: int,

@@ -177,6 +177,9 @@ class KernelSession:
 class KernelManager:
     """Session registry for the dashboard server."""
 
+    #: Lock discipline, machine-checked by the `locks` analysis pass.
+    GUARDED_BY = {"_sessions": "_lock"}
+
     def __init__(self, idle_timeout_s: float = 3600.0, max_sessions: int = 8):
         self._sessions: dict[str, KernelSession] = {}
         self._lock = threading.Lock()
@@ -225,6 +228,7 @@ class KernelManager:
         for s in sessions:
             s.close()
 
+    # lint: holds[_lock] -- the _locked suffix is the contract: every caller holds self._lock
     def _evict_locked(self) -> None:
         cutoff = time.time() - self.idle_timeout_s
         for sid in [sid for sid, s in self._sessions.items()

@@ -324,9 +324,8 @@ class ShardedGibbsLDA:
                     else lda_gibbs.env_nwk_form())
             maybe_pallas = (
                 form == "pallas"
-                or (form is None
-                    and lda_gibbs._NWK_PALLAS_MIN_DENSITY.get(
-                        jax.default_backend()) is not None))
+                or (form is None and lda_gibbs.nwk_pallas_auto_reachable(
+                    jax.default_backend())))
             return {_SHARD_MAP_CHECK_KW: False} if maybe_pallas else {}
 
         def _group_sweep(z_g, n_dk_l, n_wk_l, n_k_l, key_c,

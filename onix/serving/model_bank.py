@@ -837,6 +837,18 @@ class BankService:
     conflict: scored fresh, re-cached, and counted
     (`bank.cache_conflict`) — never served stale."""
 
+    #: Lock discipline, machine-checked by the `locks` analysis pass
+    #: (python -m onix.analysis): these attributes are shared across
+    #: handler threads and may only be mutated under their declared
+    #: lock. `_cache` mutators run under `lock` via submit()'s scoring
+    #: section and the serve layer's install path (methods marked
+    #: `# lint: holds[lock]`); the admission tallies live under the
+    #: separate `_admit_lock` so a shed request never waits on scoring.
+    GUARDED_BY = {"_cache": "lock",
+                  "_pending": "_admit_lock",
+                  "peak_depth": "_admit_lock",
+                  "_ewma_wall_s": "_admit_lock"}
+
     def __init__(self, bank: ModelBank, max_batch_requests: int = 64,
                  cache_size: int = 4096, max_queue_depth: int = 0,
                  request_deadline_s: float = 0.0):
@@ -944,7 +956,11 @@ class BankService:
                     retry_on=faults.InjectedFault)
                 fell_back = self.bank.fallback_dispatches > fb0
             wall = time.perf_counter() - t0
-            self._ewma_wall_s += 0.3 * (wall - self._ewma_wall_s)
+            # Under _admit_lock: concurrent submits racing this += would
+            # lose updates (read-modify-write), skewing the Retry-After
+            # hint shed responses derive from it (r17 locks-pass fix).
+            with self._admit_lock:
+                self._ewma_wall_s += 0.3 * (wall - self._ewma_wall_s)
         finally:
             with self._admit_lock:
                 self._pending -= 1
@@ -970,6 +986,7 @@ class BankService:
                 "form_fallback": counters.get("serve.form_fallback"),
                 "served": counters.get("serve.served")}
 
+    # lint: holds[lock] -- every production call arrives through submit()'s `with self.lock` scoring section; the bank/cache state it touches is serialized there
     def score(self, requests: list[ScoreRequest], *, tol: float,
               max_results: int) -> list[BankResult]:
         # Chaos site `serve:score`: entry, pre-mutation (before the
@@ -1024,6 +1041,7 @@ class BankService:
                          self.bank.epoch(req.tenant), topk))
         return out  # type: ignore[return-value]
 
+    # lint: holds[lock] -- the serve layer's /feedback handler wraps compile+install in `with service.lock` (oa/serve.py), serializing installs against scoring
     def apply_feedback_filter(self, base: str, filt) -> int:
         """The serve layer's one-call feedback install: filter + epoch
         bumps for every KNOWN tenant under `base`
@@ -1052,6 +1070,7 @@ class BankService:
                           counter_prefix="serve.feedback_install",
                           retry_on=faults.InjectedFault)
 
+    # lint: holds[lock] -- called only from score(), which holds it (see above)
     def _put(self, key, value) -> None:
         self._cache[key] = value
         self._cache.move_to_end(key)

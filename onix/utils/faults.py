@@ -89,6 +89,12 @@ class FaultRule:
 class FaultPlan:
     """A parsed set of one-shot fault rules."""
 
+    #: Lock discipline, machine-checked by the `locks` analysis pass.
+    #: The shared mutable state is the rule objects' one-shot counters
+    #: (calls/fired), mutated only inside consume() under _lock; the
+    #: rules list itself must never be rebound off-lock either.
+    GUARDED_BY = {"rules": "_lock"}
+
     def __init__(self, rules: list[FaultRule], spec: str = ""):
         self.rules = rules
         self.spec = spec

@@ -24,6 +24,32 @@ import threading
 import time
 
 
+#: Declared counter namespaces: the first dotted component of every
+#: literal `counters.inc`/`note_max`/`get` key (and every f-string
+#: key's literal prefix, and every `counter_prefix=` literal) must be a
+#: key here — machine-checked by `python -m onix.analysis` (the
+#: `counters` pass), because a typo'd namespace is a counter that
+#: silently never aggregates into the manifests that snapshot by
+#: prefix. Dead namespaces (declared, never used) are findings too.
+#: Renders into docs/ROBUSTNESS.md (generated section
+#: `counter-namespaces`).
+COUNTER_NAMESPACES: dict[str, str] = {
+    "bank": "model-bank residency/cache/dispatch events (onix/serving)",
+    "bench": "bench.py harness self-reporting (probe failures, stale artifacts)",
+    "campaign": "campaign orchestrator retries/preemptions (pipelines/campaign.py)",
+    "ckpt": "checkpoint/model integrity events (digest mismatches)",
+    "faults": "injected chaos-plan firings, as faults.<stage>.<point>",
+    "feedback": "analyst feedback loop events (rescored events, skipped nudges)",
+    "ingest": "watcher/mpingest retry + quarantine events",
+    "pallas": "Pallas kernel probe/fallback events",
+    "resilience": "RetryPolicy/Deadline events (utils/resilience.py)",
+    "salvage": "salvage-mode decode skip tallies, per format",
+    "scale": "scale-runner resume/discard events (pipelines/scale.py)",
+    "serve": "serving admission/degradation events (shed, deadline, fallback)",
+    "stream": "streaming scorer shape-lattice + prefetch events",
+}
+
+
 class CounterRegistry:
     """Process-wide named event counters — the one place every
     resilience event (retry, quarantine, salvage, injected fault,
@@ -31,6 +57,9 @@ class CounterRegistry:
     stage reports, and bench/scale manifests all read the same numbers
     instead of each keeping a private ledger. Thread-safe; names are
     dotted paths (`ingest.quarantined`, `salvage.skipped_records`)."""
+
+    #: Lock discipline, machine-checked by the `locks` analysis pass.
+    GUARDED_BY = {"_counts": "_lock"}
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -103,6 +132,12 @@ class OccupancyClock:
 
     Thread-safe; snapshot at quiescence (open busy spans are not yet
     in union_busy_s)."""
+
+    #: Lock discipline, machine-checked by the `locks` analysis pass:
+    #: stages run on several threads; every tally mutates under _lock.
+    GUARDED_BY = {"busy_s": "_lock", "blocked_s": "_lock",
+                  "_active": "_lock", "_active_since": "_lock",
+                  "union_busy_s": "_lock"}
 
     def __init__(self):
         self._lock = threading.Lock()

@@ -1,0 +1,10 @@
+"""Fixture (clean): both engine-read knobs covered — one contributes,
+one is exempt with a written reason."""
+
+FINGERPRINT_FIELDS: dict[str, str] = {
+    "covered_knob": "joins the fixture fingerprint",
+}
+
+FINGERPRINT_EXEMPT: dict[str, str] = {
+    "mystery_knob": "fixture: pure-performance knob, forms bit-identical",
+}

@@ -1,0 +1,21 @@
+"""Fixture (clean): every env read declared, every declaration read."""
+import os
+
+ENV_REGISTRY: dict[str, tuple[str, str]] = {
+    "ONIX_FIXTURE_DECLARED": ("flag", "declared and read"),
+}
+
+
+class LDAConfig:
+    mystery_knob: int = 1
+    covered_knob: int = 2
+
+
+def resolve_form_gate(**kw):
+    """Stand-in for config.resolve_form_gate (the gates pass matches
+    the call by name)."""
+    return kw.get("default")
+
+
+def read_envs():
+    return os.environ.get("ONIX_FIXTURE_DECLARED")

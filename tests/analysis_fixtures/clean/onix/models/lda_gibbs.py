@@ -1,0 +1,53 @@
+"""Fixture (clean): the violating module's fixed forms — lock held,
+hazards hoisted out of the traced body (the one that must stay carries
+a justified exemption), gate through resolve_form_gate, both config
+reads covered by the fingerprint tables."""
+import time
+
+import jax
+
+from onix.config import resolve_form_gate
+
+
+class Service:
+    GUARDED_BY = {"_cache": "_lock"}
+
+    def __init__(self):
+        self._cache = {}
+
+    def fixed_mutation(self, k):
+        with self._lock:
+            self._cache[k] = 1
+
+    # lint: holds[_lock] -- called only from fixed_mutation's locked section in the real shape this fixture mirrors
+    def _evict_locked(self, k):
+        self._cache.pop(k, None)
+
+
+def scan_body(carry, x):
+    # lint: exempt[tracehaz] -- fixture: trace-time constant by design, stamped once per program build
+    build_stamp = time.time()
+    return carry, (x, build_stamp)
+
+
+def run(xs):
+    t0 = time.time()        # host code outside the traced body: fine
+    out = jax.lax.scan(scan_body, 0, xs)
+    return out, time.time() - t0
+
+
+_FIXTURE_MIN_K = {"cpu": 1.0}
+
+
+def select_fixture_form(backend: str) -> str:
+    def measured():
+        return "a" if _FIXTURE_MIN_K.get(backend) else None
+
+    return resolve_form_gate(gate="fixture", choices=("a", "b"),
+                             measured=measured, default="b")
+
+
+def engine(cfg):
+    a = cfg.covered_knob        # in FINGERPRINT_FIELDS
+    b = cfg.mystery_knob        # in FINGERPRINT_EXEMPT
+    return a, b

@@ -1,0 +1,16 @@
+"""Fixture (clean): the swallow answers visibly; the fault site is in
+the doc table."""
+from onix.utils import faults
+from onix.utils.obs import counters
+
+
+def decode(path):
+    faults.fire("fixture", "documented")
+    return path
+
+
+def absorbed():
+    try:
+        decode("x")
+    except Exception:
+        counters.inc("used.decode_failed")

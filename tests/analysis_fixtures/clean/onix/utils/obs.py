@@ -1,0 +1,16 @@
+"""Fixture (clean): every counter key namespaced and declared; the
+caller-supplied-prefix pattern carries its exemption."""
+
+COUNTER_NAMESPACES: dict[str, str] = {
+    "used": "a namespace something increments",
+}
+
+counters = None
+
+
+def tally(counter_prefix: str) -> None:
+    counters.inc("used.ok")
+    counters.inc(f"used.{counter_prefix}")
+    # lint: exempt[counters] -- namespace arrives via counter_prefix; callers pass declared namespaces (validated at their call sites)
+    counters.inc(f"{counter_prefix}.count")
+    counters.note_max("used.peak", 3)

@@ -1,0 +1,8 @@
+"""Fixture: fingerprint contract tables — covered_knob declared,
+mystery_knob in neither table (the engine read of it is the finding)."""
+
+FINGERPRINT_FIELDS: dict[str, str] = {
+    "covered_knob": "joins the fixture fingerprint",
+}
+
+FINGERPRINT_EXEMPT: dict[str, str] = {}

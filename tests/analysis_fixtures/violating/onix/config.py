@@ -1,0 +1,19 @@
+"""Fixture: env-registry violations — one undeclared read, one dead
+declaration."""
+import os
+
+ENV_REGISTRY: dict[str, tuple[str, str]] = {
+    "ONIX_FIXTURE_DECLARED": ("flag", "declared and read — no finding"),
+    "ONIX_FIXTURE_DEAD": ("flag", "declared but never read — finding"),
+}
+
+
+class LDAConfig:
+    mystery_knob: int = 1
+    covered_knob: int = 2
+
+
+def read_envs():
+    ok = os.environ.get("ONIX_FIXTURE_DECLARED")
+    bad = os.environ.get("ONIX_FIXTURE_UNDECLARED")   # envs: finding
+    return ok, bad

@@ -1,0 +1,46 @@
+"""Fixture: gates / fingerprints / tracehaz / locks violations, laid
+out as an engine module (the basename puts it in the fingerprints
+pass's engine scope)."""
+import time
+
+import jax
+import numpy as np
+
+
+class Service:
+    GUARDED_BY = {"_cache": "_lock"}
+
+    def __init__(self):
+        self._cache = {}
+
+    def bad_mutation(self, k):
+        self._cache[k] = 1              # locks: finding (no lock held)
+
+    def good_mutation(self, k):
+        with self._lock:
+            self._cache.pop(k, None)    # under the declared lock: ok
+
+
+def scan_body(carry, x):
+    t = time.time()                     # tracehaz: host clock
+    r = np.random.rand()                # tracehaz: host RNG
+    v = x.item()                        # tracehaz: implicit sync
+    return carry, (t, r, v)
+
+
+def run(xs):
+    return jax.lax.scan(scan_body, 0, xs)
+
+
+_FIXTURE_MIN_K = {"cpu": 1.0}
+
+
+def select_fixture_form(backend: str) -> str:
+    # gates: finding x2 — hand-rolled chain + off-gate table consult
+    return "a" if _FIXTURE_MIN_K.get(backend) else "b"
+
+
+def engine(cfg):
+    a = cfg.covered_knob                # declared in FINGERPRINT_FIELDS
+    b = cfg.mystery_knob                # fingerprints: finding
+    return a, b

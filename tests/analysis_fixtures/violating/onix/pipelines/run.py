@@ -1,0 +1,15 @@
+"""Fixture: excepts + faultdocs violations — a silent swallow and a
+fault site missing from the doc table (plus the doc's ghost site)."""
+from onix.utils import faults
+
+
+def decode(path):
+    faults.fire("fixture", "undocumented")      # faultdocs: finding
+    return path
+
+
+def swallow():
+    try:
+        decode("x")
+    except Exception:
+        pass                                    # excepts: finding

@@ -1,0 +1,17 @@
+"""Fixture: counter-namespace violations — a typo'd namespace, a dead
+declaration, and a no-prefix dynamic key."""
+
+COUNTER_NAMESPACES: dict[str, str] = {
+    "used": "a namespace something increments",
+    "deadns": "declared but never used — finding",
+}
+
+counters = None     # stand-in receiver; the pass matches by name
+
+
+def tally(dynamic_prefix: str) -> None:
+    counters.inc("used.ok")
+    counters.inc("typo.count")                      # counters: finding
+    counters.inc(f"used.{dynamic_prefix}")          # literal prefix: ok
+    counters.inc(f"{dynamic_prefix}.count")         # no prefix: finding
+    counters.note_max("used.peak", 3)
