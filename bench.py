@@ -1631,6 +1631,19 @@ def _measure() -> None:
                       for k in ("shed", "degraded", "form_fallback",
                                 "deadline_expired", "score.retries",
                                 "served")}
+    # r18: the telemetry block, zeros included — every bench artifact
+    # records whether the live layer was on, how many spans it sampled,
+    # and whether the flight recorder dumped (a chaos-plan bench run's
+    # artifact names its own postmortems).
+    from onix.utils import telemetry as _telemetry
+    resil["telemetry"] = {
+        "enabled": _telemetry.TRACER.enabled,
+        "sample": _telemetry.TRACER.sample,
+        "spans_recorded": _counters.get("telemetry.spans_recorded"),
+        "recorder_dumps": _counters.get("telemetry.recorder_dumps"),
+        "recorder_dumps_unrouted":
+            _counters.get("telemetry.recorder_dump_unrouted"),
+    }
     # r17: the contract-linter stamp — every bench artifact records
     # the analyzer version and finding count over onix/ + bench.py +
     # scripts/, so an evidence JSON also says the tree it was earned

@@ -23,6 +23,9 @@ fires on a violation and stays silent on the fixed form):
                 config.ENV_REGISTRY; dead declarations flagged
   counters      literal counter keys / f-string prefixes must open with
                 a namespace declared in obs.COUNTER_NAMESPACES
+  spans         literal span names opened on the tracer must be
+                declared in telemetry.SPAN_REGISTRY (r18); dead
+                declarations and non-literal names flagged
   gates         select_*_form gates and _*_MIN_* crossover tables must
                 resolve through config.resolve_form_gate
   fingerprints  LDAConfig fields read inside the engine modules must be

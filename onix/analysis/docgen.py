@@ -16,7 +16,7 @@ import pathlib
 from onix.analysis.core import AnalysisContext
 from onix.analysis.passes import _module_dict, _str_const
 
-SECTIONS = ("env-registry", "counter-namespaces")
+SECTIONS = ("env-registry", "counter-namespaces", "span-registry")
 
 
 def begin_marker(section: str) -> str:
@@ -45,6 +45,12 @@ def _counter_rows(ctx: AnalysisContext) -> list[tuple[str, str]]:
             for name, value in sorted(ns.items())]
 
 
+def _span_rows(ctx: AnalysisContext) -> list[tuple[str, str]]:
+    _, reg, _ = _module_dict(ctx, "SPAN_REGISTRY")
+    return [(name, _str_const(value) or "")
+            for name, value in sorted(reg.items())]
+
+
 def render_section(ctx: AnalysisContext, section: str) -> str:
     if section == "env-registry":
         lines = ["| env | type | meaning |", "|---|---|---|"]
@@ -53,6 +59,10 @@ def render_section(ctx: AnalysisContext, section: str) -> str:
     if section == "counter-namespaces":
         lines = ["| namespace | events counted under it |", "|---|---|"]
         lines += [f"| `{n}.*` | {d} |" for n, d in _counter_rows(ctx)]
+        return "\n".join(lines)
+    if section == "span-registry":
+        lines = ["| span | one unit of |", "|---|---|"]
+        lines += [f"| `{n}` | {d} |" for n, d in _span_rows(ctx)]
         return "\n".join(lines)
     raise ValueError(f"unknown generated section {section!r}")
 

@@ -295,6 +295,69 @@ def check_counters(ctx: AnalysisContext) -> list[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# Pass 3b: span registry — literal span names opened on the tracer
+# (TRACER.span / TRACER.observe, utils/telemetry.py) must be declared
+# in telemetry.SPAN_REGISTRY; dead declarations are flagged, and a
+# non-literal span name is a finding (exempt it, or hoist the literal)
+# — the same discipline as the counters/envs passes, because a typo'd
+# span name is a latency series that silently never aggregates.
+# ---------------------------------------------------------------------------
+
+_SPAN_RECEIVERS = {"TRACER", "tracer", "_tracer"}
+_SPAN_METHODS = {"span", "observe"}
+
+
+def _span_uses(sf: SourceFile):
+    """Yield (name_or_None, line) for every tracer span/observe call:
+    name is the literal first argument, or None when dynamic."""
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if not (isinstance(fn, ast.Attribute)
+                and fn.attr in _SPAN_METHODS):
+            continue
+        recv = (_dotted(fn.value) or "").rsplit(".", 1)[-1]
+        if recv not in _SPAN_RECEIVERS or not node.args:
+            continue
+        yield _str_const(node.args[0]), node.lineno
+
+
+@register("spans", "literal span names must be declared in "
+          "telemetry.SPAN_REGISTRY; dead declarations flagged")
+def check_spans(ctx: AnalysisContext) -> list[Finding]:
+    reg_sf, reg, reg_lines = _module_dict(ctx, "SPAN_REGISTRY")
+    out = []
+    used: set[str] = set()
+    for sf in ctx.files:
+        for name, line in _span_uses(sf):
+            if name is None:
+                out.append(Finding(
+                    "spans", sf.rel, line,
+                    "span name is not a string literal — unverifiable "
+                    "statically (hoist the literal and carry the "
+                    "dynamic part as span attrs, or exempt with the "
+                    "naming contract)"))
+                continue
+            used.add(name)
+            if name not in reg:
+                out.append(Finding(
+                    "spans", sf.rel, line,
+                    f"span {name!r} is not declared in "
+                    "telemetry.SPAN_REGISTRY (name -> one-line doc) — "
+                    "an undeclared span is a latency series no one can "
+                    "find or alert on"))
+    for name, line in sorted(reg_lines.items()):
+        if name not in used:
+            out.append(Finding(
+                "spans", reg_sf.rel, line,
+                f"SPAN_REGISTRY declares {name!r} but no literal "
+                "tracer call opens it — dead declaration (delete it, "
+                "or the opener moved out of the linted tree)"))
+    return out
+
+
+# ---------------------------------------------------------------------------
 # Pass 4: gate discipline — select_*_form gates and _*_MIN_* crossover
 # tables resolve through config.resolve_form_gate, the ONE precedence
 # chain (env > explicit > measured > default).

@@ -127,6 +127,10 @@ def load_latest(ckpt_dir: str | pathlib.Path) -> Checkpoint | None:
                         h.update(chunk)
                 if h.hexdigest() != want:
                     counters.inc("ckpt.digest_mismatch")
+                    from onix.utils import telemetry
+                    telemetry.RECORDER.dump(
+                        "ckpt-digest-mismatch",
+                        extra={"path": str(npz_path)})
                     logging.getLogger("onix.checkpoint").warning(
                         "checkpoint %s fails its sha256 digest — skipping "
                         "to the previous checkpoint", npz_path)
@@ -235,6 +239,11 @@ def load_model(models_dir: str | pathlib.Path, name: str) -> Checkpoint | None:
             break
         if attempt:
             counters.inc("ckpt.model_digest_mismatch")
+            # r18 flight recorder: a rot refusal on a serving model is
+            # exactly the event an operator wants the runup to.
+            from onix.utils import telemetry
+            telemetry.RECORDER.dump("model-digest-mismatch",
+                                    extra={"model": name})
             raise ModelIntegrityError(
                 f"model {name!r} fails its sha256 digest — refusing to "
                 "serve from it")

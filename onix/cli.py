@@ -175,6 +175,14 @@ def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
     cfg = load_config(args.config, args.overrides)
 
+    # r18: route the telemetry layer (enablement, sampling, the
+    # flight-recorder dump dir) from the resolved config for EVERY
+    # command — a chaos drill on `onix score --fault-plan ...` must
+    # land its postmortem under <store.root>/telemetry, not count an
+    # unrouted dump.
+    from onix.utils import telemetry
+    telemetry.apply_config(cfg.telemetry)
+
     if args.command in ("score", "stream", "demo"):
         # Device-touching commands: persist compiled programs so daily
         # runs never re-pay cold-compile (obs.enable_compile_cache).

@@ -145,6 +145,12 @@ ENV_REGISTRY: dict[str, tuple[str, str]] = {
     "ONIX_SERVE_FORM": (
         "choice: auto|xla|fused",
         "serving-scan form override (pallas_serve.select_serve_form)"),
+    "ONIX_TELEMETRY": (
+        "flag: 0=off",
+        "kill-switch for the r18 telemetry layer (spans, flight recorder; utils/telemetry.py) — telemetry.* config is the durable knob"),
+    "ONIX_TELEMETRY_DIR": (
+        "path",
+        "flight-recorder dump dir fallback when no telemetry.recorder_dir was applied (utils/telemetry.py)"),
     "ONIX_TX_ACCESS_TOKEN": (
         "secret",
         "ThreatExchange reputation client credential (oa/repclients.py)"),
@@ -157,6 +163,9 @@ ENV_REGISTRY: dict[str, tuple[str, str]] = {
     "_ONIX_BENCH_T0": (
         "internal float epoch-s",
         "bench.py parent start time, for the child's deadline math"),
+    "_ONIX_TELEMETRY_SNAPSHOT": (
+        "internal path",
+        "run_tpu_queue per-entry handshake: the child writes a counters+histograms snapshot here at exit"),
 }
 
 
@@ -633,6 +642,43 @@ class FeedbackConfig:
 
 
 @dataclass
+class TelemetryConfig:
+    """The r18 telemetry layer (`onix/utils/telemetry.py`; operator
+    page docs/OBSERVABILITY.md): request-scoped spans, log-bucketed
+    latency histograms, the `/metrics` Prometheus exposition on
+    `onix serve`, and the chaos flight recorder. Host-side only by
+    construction — no knob here can change a device program, and
+    `enabled=false` / `sample=0` is asserted winner-bit-identical with
+    unchanged dispatch counts in tier-1 (tests/test_telemetry.py)."""
+
+    # Master switch: off = no spans recorded, no flight-ring events,
+    # no histogram observations, no recorder dumps. ONIX_TELEMETRY=0
+    # is the env kill-switch for drills.
+    enabled: bool = True
+    # Trace sampling probability in [0, 1], decided once per trace id
+    # (crc32 hash — deterministic, so a request's spans are all kept
+    # or all dropped). 1.0 records every request; production fleets
+    # drop this before they drop `enabled`.
+    sample: float = 1.0
+    # Flight-recorder ring capacity (recent span-close / counter-delta
+    # / fault events kept for the postmortem dump).
+    recorder_events: int = 1024
+    # Where flight-recorder dumps land. Empty = derive
+    # <store.root>/telemetry at validate() time. The recorder only
+    # writes when a dir is routed (this, or ONIX_TELEMETRY_DIR for
+    # processes that never applied a config) — unrouted dumps are
+    # counted, never scattered into cwd.
+    recorder_dir: str = ""
+
+    def validate(self) -> None:
+        if not 0.0 <= self.sample <= 1.0:
+            raise ValueError("telemetry.sample must be in [0, 1], "
+                             f"got {self.sample!r}")
+        if self.recorder_events < 16:
+            raise ValueError("telemetry.recorder_events must be >= 16")
+
+
+@dataclass
 class OAConfig:
     """Operational Analytics (SURVEY.md §2.1 #12-#13): enrichment inputs
     and the per-date UI data directory the dashboards read."""
@@ -659,6 +705,7 @@ class OnixConfig:
     oa: OAConfig = field(default_factory=OAConfig)
     serving: ServingConfig = field(default_factory=ServingConfig)
     feedback: FeedbackConfig = field(default_factory=FeedbackConfig)
+    telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
 
     def validate(self) -> "OnixConfig":
         self.lda.validate()
@@ -666,6 +713,7 @@ class OnixConfig:
         self.pipeline.validate()
         self.serving.validate()
         self.feedback.validate()
+        self.telemetry.validate()
         root = pathlib.Path(self.store.root)
         for attr, sub in (("feedback_dir", "feedback"),
                           ("results_dir", "results"),
@@ -676,6 +724,8 @@ class OnixConfig:
             self.oa.data_dir = str(root / "oa")
         if not self.serving.models_dir:
             self.serving.models_dir = str(root / "models")
+        if not self.telemetry.recorder_dir:
+            self.telemetry.recorder_dir = str(root / "telemetry")
         return self
 
     # -- serialization ----------------------------------------------------
@@ -744,6 +794,7 @@ _NESTED = {
     (OnixConfig, "oa"): OAConfig,
     (OnixConfig, "serving"): ServingConfig,
     (OnixConfig, "feedback"): FeedbackConfig,
+    (OnixConfig, "telemetry"): TelemetryConfig,
 }
 
 

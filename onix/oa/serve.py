@@ -95,6 +95,8 @@ class OAHandler(SimpleHTTPRequestHandler):
         path = self.path.split("?", 1)[0].split("#", 1)[0]
         if path == "/bank/stats":
             return self._bank_stats()
+        if path == "/metrics":
+            return self._metrics()
         # Editable notebook source (the in-dashboard editor's read
         # path): the installed per-datatype .ipynb as JSON.
         if path.startswith("/notebooks/") and path.endswith(".json"):
@@ -311,6 +313,19 @@ class OAHandler(SimpleHTTPRequestHandler):
     # models, so it keeps the wider (non-loopback) policy.
 
     def _score(self):
+        # r18 telemetry: the trace id arrives on X-Request-Id (or is
+        # minted here) and rides a contextvar through submit() -> the
+        # admission queue wait -> the bank wave dispatch, so one slow
+        # request decomposes into its named spans end-to-end. The id is
+        # echoed back (header + body) for client-side correlation.
+        from onix.utils import telemetry
+        trace_id = self.headers.get("X-Request-Id") \
+            or telemetry.new_trace_id()
+        with telemetry.TRACER.trace(trace_id):
+            with telemetry.TRACER.span("serve.request"):
+                return self._score_traced(trace_id)
+
+    def _score_traced(self, trace_id: str):
         if self._reject_cross_site():
             return
         from onix.serving.model_bank import BankRefusal, ScoreRequest
@@ -364,33 +379,44 @@ class OAHandler(SimpleHTTPRequestHandler):
             # delay-seconds is a non-negative INTEGER — a fractional
             # value makes spec-compliant clients (urllib3 Retry) choke
             # on the header — so round the hint up to a whole second.
+            # The trace id is echoed on EVERY outcome — refusals most
+            # of all: a shed 503 is exactly the response the operator
+            # wants to join against its serve-shed flight dump.
             self._send_json(503, {"ok": False, "shed": True,
+                                  "trace_id": trace_id,
                                   "error": str(e)},
                             headers={"Retry-After":
                                      str(max(1, math.ceil(
-                                         e.retry_after_s)))})
+                                         e.retry_after_s))),
+                                     "X-Request-Id": trace_id})
             return
         except DeadlineExceeded as e:
             self._send_json(503, {"ok": False, "deadline_expired": True,
+                                  "trace_id": trace_id,
                                   "error": str(e)},
-                            headers={"Retry-After": "1"})
+                            headers={"Retry-After": "1",
+                                     "X-Request-Id": trace_id})
             return
         except (BankRefusal, ModelIntegrityError) as e:
             # Refusal semantics (docs/ROBUSTNESS.md): unknown tenant,
             # out-of-range ids, rotted model — rejected before any
             # device work, never scored against wrong tables.
-            self._send_json(404, {"ok": False, "error": str(e)})
+            self._send_json(404, {"ok": False, "trace_id": trace_id,
+                                  "error": str(e)},
+                            headers={"X-Request-Id": trace_id})
             return
         # Unfilled TopK slots (index -1) carry +inf scores; json.dumps
         # would emit the non-standard token `Infinity` (invalid per RFC
         # 8259 — JSON.parse in a browser throws). Null them instead.
-        self._send_json(200, {"ok": True, "results": [
+        self._send_json(200, {"ok": True, "trace_id": trace_id,
+                              "results": [
             {"tenant": req.tenant, "window": req.window,
              "cached": res.cached, "degraded": res.degraded,
              "scores": [s if math.isfinite(s) else None
                         for s in np.asarray(res.topk.scores).tolist()],
              "indices": np.asarray(res.topk.indices).tolist()}
-            for req, res in zip(reqs, results)]})
+            for req, res in zip(reqs, results)]},
+                        headers={"X-Request-Id": trace_id})
 
     def _bank_stats(self):
         from onix.checkpoint import list_models
@@ -409,6 +435,64 @@ class OAHandler(SimpleHTTPRequestHandler):
                              **counters.snapshot("serve")},
             }
         self._send_json(200, stats)
+
+    def _metrics(self):
+        """GET /metrics — Prometheus text exposition (r18,
+        docs/OBSERVABILITY.md): every counter, every latency histogram
+        (span durations, log-bucketed), admission/queue gauges, bank
+        residency + epoch stats, and the build/config identity. Same
+        posture as /bank/stats (plain GET on the bound address; no
+        state changes, no code execution). Deadline-bounded: bank
+        internals are read under a 250 ms lock attempt — a scrape
+        landing mid-wave reports `onix_metrics_partial 1` instead of
+        stalling behind device work, and never instantiates the bank
+        on a dashboards-only server."""
+        from onix.utils import telemetry
+        from onix.utils.obs import counters
+        gauges: dict[str, float] = {
+            "telemetry.enabled": 1.0 if telemetry.TRACER.enabled else 0.0,
+            "telemetry.sample": telemetry.TRACER.sample,
+        }
+        service = self.server.peek_bank_service()
+        if service is not None:
+            adm = service.admission_stats()     # _admit_lock only
+            gauges["serve.queue_depth"] = adm["queue_depth"]
+            gauges["serve.queue_depth_high_water"] = adm["queue_depth_peak"]
+            gauges["serve.max_queue_depth"] = adm["max_queue_depth"]
+            got_lock = service.lock.acquire(timeout=0.25)
+            if got_lock:
+                try:
+                    bank = service.bank
+                    epochs = list(bank._epochs.values())
+                    gauges.update({
+                        "bank.tenants_registered": len(bank.tenants()),
+                        "bank.tenants_resident": sum(
+                            len(sh.lru) for sh in bank._shards.values()),
+                        "bank.shape_classes": len(bank._shards),
+                        "bank.compiled_shape_count":
+                            len(bank.compiled_shapes),
+                        "bank.dispatch_count": bank.dispatches,
+                        "bank.tenants_with_filters": len(bank._filters),
+                        "bank.model_epoch_max":
+                            max(epochs) if epochs else 0,
+                        "bank.winner_cache_entries": len(service._cache),
+                    })
+                finally:
+                    service.lock.release()
+            else:
+                gauges["metrics.partial"] = 1.0
+        body = telemetry.render_prometheus(
+            counters.snapshot(), telemetry.histograms, gauges,
+            info={"config_hash": self.cfg.config_hash,
+                  "store_root": self.cfg.store.root})
+        data = body.encode()
+        self.send_response(200)
+        self.send_header("Content-Type",
+                         "text/plain; version=0.0.4; charset=utf-8")
+        self.send_header("Content-Length", str(len(data)))
+        self.send_header("Cache-Control", "no-store")
+        self.end_headers()
+        self.wfile.write(data)
 
     def _run_notebook(self):
         """Execute the datatype's investigation notebook against the
@@ -689,6 +773,13 @@ class OAServer(ThreadingHTTPServer):
 
 def make_server(cfg: OnixConfig, port: int = DEFAULT_PORT,
                 host: str = "127.0.0.1") -> ThreadingHTTPServer:
+    # The server is where the resolved config meets the process-global
+    # telemetry singletons: enablement, sampling, and the flight-
+    # recorder dump dir (<store.root>/telemetry by default) all apply
+    # here, so a live `onix serve` records spans and routes postmortem
+    # dumps without any extra wiring.
+    from onix.utils import telemetry
+    telemetry.apply_config(cfg.telemetry)
     handler = type("BoundOAHandler", (OAHandler,), {"cfg": cfg})
     return OAServer((host, port), handler)
 

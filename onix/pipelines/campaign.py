@@ -53,7 +53,7 @@ from onix.config import DATATYPES, LDAConfig
 from onix.pipelines.corpus_build import build_corpus, select_suspicious_events
 from onix.pipelines.scale import _default_anomalies, _words_from_cols
 from onix.pipelines.synth import SYNTH_ARRAYS
-from onix.utils import faults
+from onix.utils import faults, telemetry
 from onix.utils.obs import OccupancyClock, counters
 
 #: Campaign manifest schema — stamped so downstream evidence JSONs are
@@ -168,13 +168,27 @@ def run_campaign(n_events: int, datatypes=DATATYPES, n_hosts: int | None = None,
         # Distinct per-datatype streams; deterministic across arms.
         return seed + 7919 * i
 
+    def trace_of(i: int, dt: str) -> str:
+        # Per-item trace id (r18): the prepare worker and the driver
+        # open the SAME id for one datatype's stages, so its span tree
+        # (campaign.prepare on the worker thread, fit/score/oa on the
+        # driver) reads as one trace. Deterministic in (seed, dt) —
+        # identical across the sequential/overlapped arms.
+        return f"campaign-{seed_of(i)}-{dt}"
+
     # -- the prepare pipeline (worker thread, bounded in-order queue) --
     handoff: queue.Queue = queue.Queue(maxsize=max(1, overlap_depth))
 
     def producer():
         for i, dt in enumerate(datatypes):
             try:
-                with clock.busy(f"{dt}.prepare"):
+                # The span FEEDS the clock (clock=/clock_name= enters
+                # clock.busy unconditionally) — occupancy accounting is
+                # identical with telemetry off.
+                with telemetry.TRACER.trace(trace_of(i, dt)), \
+                        telemetry.TRACER.span(
+                            "campaign.prepare", clock=clock,
+                            clock_name=f"{dt}.prepare", datatype=dt):
                     item = _prepare(dt, n_events, n_hosts, n_anomalies,
                                     seed_of(i), gen_arrays)
             except BaseException as e:          # noqa: BLE001 — relayed
@@ -191,7 +205,10 @@ def run_campaign(n_events: int, datatypes=DATATYPES, n_hosts: int | None = None,
 
     def next_prepared(i: int, dt: str) -> _Prepared:
         if not overlap:
-            with clock.busy(f"{dt}.prepare"):
+            with telemetry.TRACER.trace(trace_of(i, dt)), \
+                    telemetry.TRACER.span(
+                        "campaign.prepare", clock=clock,
+                        clock_name=f"{dt}.prepare", datatype=dt):
                 return _prepare(dt, n_events, n_hosts, n_anomalies,
                                 seed_of(i), gen_arrays)
         with clock.blocked("prepare_wait"):
@@ -210,7 +227,9 @@ def run_campaign(n_events: int, datatypes=DATATYPES, n_hosts: int | None = None,
         dp1_fast = bool(getattr(model, "dp1_fast", False))
         ckpt_dir = (pathlib.Path(resume_dir) / dt / "fit_ckpt"
                     if resume_dir is not None else None)
-        with clock.busy(f"{dt}.fit"):
+        with telemetry.TRACER.trace(trace_of(i, dt)), \
+                telemetry.TRACER.span("campaign.fit", clock=clock,
+                                      clock_name=f"{dt}.fit", datatype=dt):
             from onix.checkpoint import SimulatedPreemption
             attempts = 0
             while True:
@@ -227,13 +246,18 @@ def run_campaign(n_events: int, datatypes=DATATYPES, n_hosts: int | None = None,
                     if attempts >= _MAX_FIT_ATTEMPTS:
                         raise
         theta, phi_wk = fit["theta"], fit["phi_wk"]
-        with clock.busy(f"{dt}.score"):
+        with telemetry.TRACER.trace(trace_of(i, dt)), \
+                telemetry.TRACER.span("campaign.score", clock=clock,
+                                      clock_name=f"{dt}.score",
+                                      datatype=dt):
             top = select_suspicious_events(prep.bundle, theta, phi_wk,
                                            n_events, tol=1.0,
                                            max_results=max_results)
             idx = np.asarray(top.indices)
             scores = np.asarray(top.scores)
-        with clock.busy(f"{dt}.oa"):
+        with telemetry.TRACER.trace(trace_of(i, dt)), \
+                telemetry.TRACER.span("campaign.oa", clock=clock,
+                                      clock_name=f"{dt}.oa", datatype=dt):
             keep = idx >= 0
             hits = len(prep.planted & set(idx[keep].tolist()))
             finite = scores[np.isfinite(scores)]
@@ -309,6 +333,9 @@ def run_campaign(n_events: int, datatypes=DATATYPES, n_hosts: int | None = None,
             "fit_preemptions": fit_preemptions,
         },
         "occupancy": occ,
+        # r18: the live-telemetry view of the same run — per-stage span
+        # histograms (quantiles, not just sums) and recorder tallies.
+        "telemetry": telemetry.snapshot(),
     }
     resil = {**counters.snapshot("ingest"), **counters.snapshot("salvage"),
              **counters.snapshot("faults"), **counters.snapshot("ckpt"),

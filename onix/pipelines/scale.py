@@ -318,7 +318,12 @@ def run_scale(n_events: int, n_hosts: int | None = None,
     # Resilience events this run tallied (retries, salvage skips,
     # injected faults, checkpoint digest mismatches) — empty on a clean
     # run, and the chaos harness's evidence on a faulted one.
+    from onix.utils import telemetry
     from onix.utils.obs import counters
+    # r18: the telemetry view (span histograms + recorder tallies,
+    # zeros included) — every scale manifest says what was observed
+    # live, not just what summed post-hoc.
+    manifest["telemetry"] = telemetry.snapshot()
     resil = {**counters.snapshot("ingest"), **counters.snapshot("salvage"),
              **counters.snapshot("faults"), **counters.snapshot("ckpt"),
              **counters.snapshot("scale.resume_torn_discarded")}

@@ -1061,10 +1061,17 @@ class StreamingScorer:
         batch counter) is touched — so a caller that retries the batch
         (run_stream does, bounded) replays it against unchanged state
         and the stream's artifacts are identical to a fault-free run."""
-        from onix.utils import faults
+        from onix.utils import faults, telemetry
 
-        faults.fire("stream", "batch")
-        return self._process_one(table, cols)
+        # Per-batch trace id (r18), deterministic in the batch counter:
+        # a bounded retry replays under the SAME id, so a fault + its
+        # replay read as one trace in the flight ring. The fault site
+        # fires inside the span — an injected raise closes it as an
+        # error span, the postmortem breadcrumb.
+        with telemetry.TRACER.trace(f"stream-b{self._batch_no + 1}"), \
+                telemetry.TRACER.span("stream.batch", events=len(table)):
+            faults.fire("stream", "batch")
+            return self._process_one(table, cols)
 
     def _process_one(self, table: pd.DataFrame,
                      cols: dict | None) -> BatchResult:
@@ -1174,6 +1181,17 @@ class StreamingScorer:
         return out
 
     def _process_superstep(self, group: list) -> list[BatchResult]:
+        from onix.utils import telemetry
+
+        # Per-group trace id, deterministic in the batch counter (the
+        # per-batch analog lives in process()); one fused dispatch =
+        # one stream.superstep span.
+        with telemetry.TRACER.trace(f"stream-s{self._batch_no + 1}"), \
+                telemetry.TRACER.span("stream.superstep",
+                                      batches=len(group)):
+            return self._process_superstep_traced(group)
+
+    def _process_superstep_traced(self, group: list) -> list[BatchResult]:
         from onix.utils import faults
 
         # All fault hooks fire BEFORE any scorer state mutates, so a

@@ -37,6 +37,7 @@ import numpy as np
 
 from onix.serving.model_bank import (BankRefusal, BankService, ModelBank,
                                      ScoreRequest, TenantModel)
+from onix.utils import telemetry
 from onix.utils.obs import counters
 from onix.utils.resilience import DeadlineExceeded, Overloaded
 
@@ -130,10 +131,20 @@ def build_service(spec: HarnessSpec, models: dict[str, TenantModel],
 
 
 def _pctl(latencies: list[float]) -> dict:
-    lat = np.asarray(latencies)
+    """Quantiles via the r18 log-bucketed `telemetry.Histogram` — the
+    same machinery `/metrics` exposes, replacing the pre-r18 raw
+    index-into-sorted-list math whose p99 on small n was whatever
+    single sample the truncation landed on. The histogram's answer is
+    exact-to-the-bucket with a declared relative error bound
+    (`q_rel_error`), and parity against numpy nearest-rank percentile
+    is asserted in tests/test_telemetry.py."""
+    h = telemetry.Histogram()
+    for v in latencies:
+        h.observe(v)
     return {"n": len(latencies),
-            "p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 3),
-            "p99_ms": round(float(np.percentile(lat, 99)) * 1e3, 3)}
+            "p50_ms": round(h.quantile(0.50) * 1e3, 3),
+            "p99_ms": round(h.quantile(0.99) * 1e3, 3),
+            "q_rel_error": round(h.rel_error, 4)}
 
 
 def _slo(outcomes: dict[str, list[float]]) -> dict:
@@ -150,7 +161,7 @@ def _slo(outcomes: dict[str, list[float]]) -> dict:
 
 def replay(service: BankService, stream: list[ScoreRequest], *,
            tol: float, max_results: int, shed_retries: int = 0,
-           shed_backoff_s: float = 0.0) -> dict:
+           shed_backoff_s: float = 0.0, keep_raw: bool = False) -> dict:
     """Run the stream through the service in request batches via the
     admission-controlled submit() path; returns results + the serving
     numbers. A shed/deadline-refused batch is retried up to
@@ -223,6 +234,10 @@ def replay(service: BankService, stream: list[ScoreRequest], *,
         "latency_p50_ms": scored["p50_ms"],
         "latency_p99_ms": scored["p99_ms"],
         "slo": _slo(outcomes),
+        # Raw per-batch latencies, on request only (the histogram-vs-
+        # numpy parity test; artifacts carry the histograms instead).
+        **({"raw_latencies": {k: list(v) for k, v in outcomes.items()}}
+           if keep_raw else {}),
         "admission": admission,
         "dispatches": delta["dispatch"],
         "cache_hit_rate": (round(delta["cache_hit"] / cacheable, 4)

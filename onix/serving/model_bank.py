@@ -66,7 +66,7 @@ from onix.feedback.filter import (FILTER_FLOOR, FilterTables, HostFilter,
                                   _pad_sorted, apply_filter, split_key)
 from onix.models.compaction import pow2_bucket
 from onix.models.scoring import TopK, _scan_bottom_k, _subscan_scores, score_events
-from onix.utils import faults
+from onix.utils import faults, telemetry
 from onix.utils.obs import counters
 from onix.utils.resilience import (Deadline, DeadlineExceeded, Overloaded,
                                    RetryPolicy, retry_call)
@@ -566,8 +566,15 @@ class ModelBank:
         argument for capped banks rests on that."""
         # Chaos site `bank:admit` fires BEFORE any LRU mutation or H2D
         # staging, so the bounded retry in _score_wave replays the
-        # whole admission safely (the stream:batch discipline).
-        faults.fire("bank", "admit")
+        # whole admission safely (the stream:batch discipline). The
+        # span wraps the site: an injected admission fault closes as an
+        # error span, which is exactly the flight-recorder breadcrumb
+        # a faults-marker postmortem needs.
+        with telemetry.TRACER.span("bank.admit", tenants=len(needed)):
+            faults.fire("bank", "admit")
+            self._admit_locked(shard, needed)
+
+    def _admit_locked(self, shard: _Shard, needed: list[str]) -> None:
         missing = [t for t in needed if t not in shard.lru]
         for t in needed:
             if t in shard.lru:
@@ -778,29 +785,37 @@ class ModelBank:
         args = (shard.theta, shard.phi, jnp.asarray(slots), jnp.asarray(d),
                 jnp.asarray(w), jnp.asarray(m), jnp.float32(tol),
                 filt_rows)
-        try:
-            res = _bank_kernel_for(form, serve)(
-                *args, max_results=max_results)
-        except Exception:                       # noqa: BLE001 — the
-            # degradation ladder's first rung: a fused-kernel failure
-            # (Mosaic lowering, VMEM overflow, injected chaos) falls
-            # back to the bit-identical xla kernels — same winners by
-            # the r15 identity contract — instead of failing the wave.
-            # Counted + stamped degraded upstream; never silent.
-            if serve != "fused" or not self.degrade_form_fallback:
-                raise
-            counters.inc("serve.form_fallback")
-            self.fallback_dispatches += 1
-            self.compiled_shapes.add(shape_key[:1] + ("xla",)
-                                     + shape_key[2:])
-            res = _bank_kernel_for(form, "xla")(
-                *args, max_results=max_results)
-        self.dispatches += 1
-        counters.inc("bank.dispatch")
-        counters.inc("bank.requests", r)
-        counters.inc("bank.events", sum(n_events))
-        scores = np.asarray(res.scores)    # ONE fetch per dispatch
-        indices = np.asarray(res.indices)
+        # The dispatch span: one wave = one batched program + ONE
+        # winner fetch — the latency building block every serve-side
+        # quantile decomposes into. Attrs carry the resolved forms so
+        # a slow trace names the arm that compiled, not the request.
+        with telemetry.TRACER.span("bank.score_wave", form=form,
+                                   serve=serve, requests=r,
+                                   events=sum(n_events)):
+            try:
+                res = _bank_kernel_for(form, serve)(
+                    *args, max_results=max_results)
+            except Exception:                   # noqa: BLE001 — the
+                # degradation ladder's first rung: a fused-kernel
+                # failure (Mosaic lowering, VMEM overflow, injected
+                # chaos) falls back to the bit-identical xla kernels —
+                # same winners by the r15 identity contract — instead
+                # of failing the wave. Counted + stamped degraded
+                # upstream; never silent.
+                if serve != "fused" or not self.degrade_form_fallback:
+                    raise
+                counters.inc("serve.form_fallback")
+                self.fallback_dispatches += 1
+                self.compiled_shapes.add(shape_key[:1] + ("xla",)
+                                         + shape_key[2:])
+                res = _bank_kernel_for(form, "xla")(
+                    *args, max_results=max_results)
+            self.dispatches += 1
+            counters.inc("bank.dispatch")
+            counters.inc("bank.requests", r)
+            counters.inc("bank.events", sum(n_events))
+            scores = np.asarray(res.scores)    # ONE fetch per dispatch
+            indices = np.asarray(res.indices)
         for row, i in enumerate(wave):
             out[i] = TopK(scores=scores[row], indices=indices[row])
 
@@ -880,6 +895,21 @@ class BankService:
         # until a queue slot likely frees). Seeded pessimistically low;
         # the first real call corrects it.
         self._ewma_wall_s = 0.05
+        # r18: the REAL distribution behind the hint — a log-bucketed
+        # histogram of scoring walls (telemetry.Histogram, internally
+        # locked). Once it holds enough observations the Retry-After
+        # hint uses its median instead of the EWMA point estimate: a
+        # bimodal wall (cache hits vs cold waves) no longer averages
+        # into a hint that is wrong for both modes. Service-local on
+        # purpose — two services in one process must not blend walls.
+        self._wall_hist = telemetry.Histogram()
+
+    def _retry_hint_s(self, depth: int) -> float:
+        """Seconds until a queue slot likely frees: depth x the median
+        scoring wall (the histogram once seeded, the EWMA before)."""
+        wall = (self._wall_hist.quantile(0.5) if self._wall_hist.n >= 8
+                else self._ewma_wall_s)
+        return max(0.1, round(depth * wall, 2))
 
     # -- admission control + deadline (the submit path) -------------------
 
@@ -906,38 +936,62 @@ class BankService:
         `degraded: true` (`serve.degraded`) — an explicit overload
         signal, never stale winners: the epoch-keyed cache contract is
         unchanged on every rung."""
+        t_recv = time.perf_counter()
+        shed_pending = None
         with self._admit_lock:
             if self.max_queue_depth \
                     and self._pending >= self.max_queue_depth:
-                counters.inc("serve.shed")
-                counters.inc("serve.shed_requests", len(requests))
-                raise Overloaded(
-                    f"serving queue full ({self._pending} batches in "
-                    f"flight, max_queue_depth={self.max_queue_depth})",
-                    retry_after_s=max(
-                        0.1, round(self._pending * self._ewma_wall_s, 2)))
-            self._pending += 1
-            depth = self._pending
-            # Two scopes on purpose: peak_depth is THIS service's
-            # high-water (admission_stats / GET /bank/stats — one
-            # service per server); the registry gauge is the
-            # process-wide max across services (what bench's
-            # detail.resilience snapshot carries — a harness running
-            # several services reports the worst one).
-            self.peak_depth = max(self.peak_depth, depth)
-            counters.note_max("serve.queue_depth_peak", depth)
-            soft = bool(self.max_queue_depth
-                        and depth > max(1, self.max_queue_depth // 2))
+                shed_pending = self._pending
+            else:
+                self._pending += 1
+                depth = self._pending
+                # Two scopes on purpose: peak_depth is THIS service's
+                # high-water (admission_stats / GET /bank/stats — one
+                # service per server); the registry gauge is the
+                # process-wide max across services (what bench's
+                # detail.resilience snapshot carries — a harness
+                # running several services reports the worst one).
+                self.peak_depth = max(self.peak_depth, depth)
+        if shed_pending is not None:
+            counters.inc("serve.shed")
+            counters.inc("serve.shed_requests", len(requests))
+            # Flight-recorder trigger (r18): the ring at shed time IS
+            # the overload postmortem — what was in flight, which
+            # tenants, which counters moved in the runup. OUTSIDE
+            # _admit_lock on purpose: the dump is file I/O over ~1k
+            # ring events, and at peak overload every concurrent
+            # admission check would otherwise serialize behind it —
+            # inflating the served p99 exactly when the r16 bound is
+            # being measured.
+            telemetry.RECORDER.dump(
+                "serve-shed", extra={"pending": shed_pending,
+                                     "requests": len(requests)})
+            raise Overloaded(
+                f"serving queue full ({shed_pending} batches in "
+                f"flight, max_queue_depth={self.max_queue_depth})",
+                retry_after_s=self._retry_hint_s(shed_pending))
+        counters.note_max("serve.queue_depth_peak", depth)
+        soft = bool(self.max_queue_depth
+                    and depth > max(1, self.max_queue_depth // 2))
         if deadline is None and self.request_deadline_s > 0:
             deadline = Deadline(self.request_deadline_s)
         try:
-            with self.lock:
+            with telemetry.TRACER.span("serve.submit",
+                                       requests=len(requests),
+                                       depth=depth), \
+                    self.lock:
                 # Clock starts INSIDE the lock: the EWMA must track
                 # scoring wall only — folding queue wait in would make
                 # the Retry-After hint compound quadratically under
                 # sustained contention (wait ≈ depth × ewma ⇒ ewma ≈
                 # depth × service ⇒ hint ≈ depth² × service).
                 t0 = time.perf_counter()
+                # The admission queue wait, as its own span: receipt
+                # (submit entry) to scoring start. This is the "why was
+                # THIS request slow" number — a fat serve.submit with a
+                # fat serve.queue_wait is contention, without one it is
+                # scoring cost.
+                telemetry.TRACER.observe("serve.queue_wait", t0 - t_recv)
                 if deadline is not None and deadline.expired():
                     # counters: resilience.deadline_exceeded is inc'd
                     # by Deadline.check; serve.deadline_expired is the
@@ -955,7 +1009,10 @@ class BankService:
                     policy=_SERVE_RETRY, counter_prefix="serve.score",
                     retry_on=faults.InjectedFault)
                 fell_back = self.bank.fallback_dispatches > fb0
-            wall = time.perf_counter() - t0
+                wall = time.perf_counter() - t0
+            # Histogram first (internally locked): the Retry-After
+            # median must see every wall the EWMA sees.
+            self._wall_hist.observe(wall)
             # Under _admit_lock: concurrent submits racing this += would
             # lose updates (read-modify-write), skewing the Retry-After
             # hint shed responses derive from it (r17 locks-pass fix).
@@ -989,10 +1046,18 @@ class BankService:
     # lint: holds[lock] -- every production call arrives through submit()'s `with self.lock` scoring section; the bank/cache state it touches is serialized there
     def score(self, requests: list[ScoreRequest], *, tol: float,
               max_results: int) -> list[BankResult]:
-        # Chaos site `serve:score`: entry, pre-mutation (before the
-        # disk-epoch probes and cache bookkeeping), so submit()'s
-        # bounded retry replays the whole call safely.
-        faults.fire("serve", "score")
+        with telemetry.TRACER.span("serve.score", requests=len(requests)):
+            # Chaos site `serve:score`: entry, pre-mutation (before the
+            # disk-epoch probes and cache bookkeeping), so submit()'s
+            # bounded retry replays the whole call safely. Inside the
+            # span: an injected fault closes it as an error span.
+            faults.fire("serve", "score")
+            return self._score_locked(requests, tol=tol,
+                                      max_results=max_results)
+
+    # lint: holds[lock] -- called only from score(), which submit() serializes (see above)
+    def _score_locked(self, requests: list[ScoreRequest], *, tol: float,
+                      max_results: int) -> list[BankResult]:
         out: list[BankResult | None] = [None] * len(requests)
         # Out-of-process update probe, once per distinct tenant per
         # call (ModelBank.refresh_from_disk): a re-save by another

@@ -184,6 +184,20 @@ def fire(stage: str, point: str, index: int | None = None) -> str | None:
     if plan is None:
         return None
     action = plan.consume(stage, point, index)
+    if action is not None:
+        # r18 flight recorder: the firing itself lands in the ring
+        # (richer than the counter delta: action + index), and the ring
+        # is dumped NOW — the artifact holds what led UP to the fault,
+        # the postmortem every faults-marker failure ships with
+        # (docs/OBSERVABILITY.md). Lazy import: fault-free processes
+        # never pay it, and telemetry never imports faults back.
+        from onix.utils import telemetry
+        if telemetry.TRACER.enabled:    # off = no ring events, no dumps
+            telemetry.RECORDER.record("fault", site=f"{stage}:{point}",
+                                      action=action, index=index)
+            telemetry.RECORDER.dump(f"fault-{stage}-{point}",
+                                    extra={"action": action,
+                                           "index": index})
     if action == "raise":
         raise InjectedFault(f"injected fault at {stage}:{point}"
                             + (f" (index {index})" if index is not None

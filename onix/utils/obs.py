@@ -47,6 +47,7 @@ COUNTER_NAMESPACES: dict[str, str] = {
     "scale": "scale-runner resume/discard events (pipelines/scale.py)",
     "serve": "serving admission/degradation events (shed, deadline, fallback)",
     "stream": "streaming scorer shape-lattice + prefetch events",
+    "telemetry": "telemetry layer self-reporting (spans recorded, flight-recorder dumps; utils/telemetry.py)",
 }
 
 
@@ -59,16 +60,28 @@ class CounterRegistry:
     dotted paths (`ingest.quarantined`, `salvage.skipped_records`)."""
 
     #: Lock discipline, machine-checked by the `locks` analysis pass.
-    GUARDED_BY = {"_counts": "_lock"}
+    GUARDED_BY = {"_counts": "_lock", "_observer": "_lock"}
 
     def __init__(self):
         self._lock = threading.Lock()
         self._counts: dict[str, int] = {}
+        # Optional delta observer (utils/telemetry.py installs the
+        # flight-recorder feed here at import): called as
+        # observer(name, delta, total) AFTER the lock is released, so
+        # an observer can never deadlock the registry. None = off.
+        self._observer = None
+
+    def set_observer(self, fn) -> None:
+        with self._lock:
+            self._observer = fn
 
     def inc(self, name: str, n: int = 1) -> int:
         with self._lock:
-            self._counts[name] = self._counts.get(name, 0) + int(n)
-            return self._counts[name]
+            self._counts[name] = total = self._counts.get(name, 0) + int(n)
+        obs_fn = self._observer
+        if obs_fn is not None:
+            obs_fn(name, int(n), total)
+        return total
 
     def get(self, name: str) -> int:
         with self._lock:
@@ -79,12 +92,17 @@ class CounterRegistry:
         (e.g. `serve.queue_depth_peak`). Same namespace and snapshot
         path as the event counters, so manifests carry gauges and
         tallies through one registry."""
+        moved = False
         with self._lock:
             cur = self._counts.get(name, 0)
             if int(value) > cur:
                 self._counts[name] = int(value)
                 cur = int(value)
-            return cur
+                moved = True
+        obs_fn = self._observer
+        if moved and obs_fn is not None:
+            obs_fn(name, 0, cur)
+        return cur
 
     def snapshot(self, prefix: str = "") -> dict[str, int]:
         """Copy of the current counts (optionally only names under
@@ -504,3 +522,14 @@ class Meter:
     def rate(self) -> float:
         dt = self.seconds
         return self.items / dt if dt > 0 else 0.0
+
+
+# Bottom import on purpose: obs is the one module every stage already
+# imports, so pulling telemetry in here guarantees the flight-recorder
+# counter observer (telemetry installs it at its own import) is live in
+# EVERY process — chaos drills that only import faults/obs still get
+# ring events, and run_tpu_queue.py's per-entry exit snapshot (the
+# _ONIX_TELEMETRY_SNAPSHOT handshake) is registered no matter which
+# entry point the child runs. Safe against the obs<->telemetry cycle:
+# everything telemetry needs from obs is defined above this line.
+from onix.utils import telemetry as _telemetry  # noqa: E402,F401

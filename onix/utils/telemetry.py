@@ -1,0 +1,819 @@
+"""End-to-end telemetry: request-scoped spans, log-bucketed histograms,
+Prometheus exposition, and the chaos flight recorder.
+
+The pre-r18 observability stack is post-hoc only: `obs.CounterRegistry`
+counts events, `obs.OccupancyClock` sums stage walls, and serving
+quantiles were computed from ad-hoc latency lists after a harness run
+ended. Nothing answered the live operator questions — "why was THIS
+request slow", "what are the current p50/p99 per degradation rung", or
+"what happened in the seconds before that fault fired". This module is
+the live layer, four pieces sharing one discipline (near-zero cost when
+off, no device-program changes ever — telemetry off is asserted
+bit-identical in tier-1, tests/test_telemetry.py):
+
+* **Spans** (`Tracer`) — monotonic-clock spans carrying a `trace_id`
+  propagated through `contextvars` end-to-end: HTTP `X-Request-Id` on
+  `/score` → `BankService.submit` → admission queue wait → bank wave
+  dispatch; campaign stages and streaming batches get per-item trace
+  ids. The hot path is LOCK-FREE: a disabled or sampled-out span takes
+  no lock and allocates nothing beyond the context manager; a recorded
+  span-close pays one ring append (GIL-atomic `deque.append`) plus the
+  histogram observe. Spans FEED `OccupancyClock` accounting when given
+  a clock (`span(..., clock=, clock_name=)` enters `clock.busy`
+  unconditionally — occupancy numbers never depend on telemetry being
+  on) instead of duplicating it. Literal span names are a declared
+  contract: `SPAN_REGISTRY` below, machine-checked by the `spans`
+  analysis pass (python -m onix.analysis) exactly like counter
+  namespaces and env vars.
+
+* **Histograms** (`Histogram`, `HistogramRegistry`) — log-bucketed
+  (geometric buckets, growth `Histogram.GROWTH`): `observe(v)` lands v
+  in bucket ⌈log_g v⌉, so any quantile read back is exact-to-the-bucket
+  with a KNOWN relative error bound (`rel_error` = √g − 1, ~9% at the
+  default g = 2^(1/4)). Every closed span observes its duration into
+  the process registry under ``span.<name>`` (seconds), which is what
+  `/metrics` renders and what replaced the ad-hoc quantile lists in
+  `serving/load_harness.py` (parity-tested against numpy percentile).
+
+* **Exposition** — `render_prometheus` writes the Prometheus text
+  format (counters, histograms with cumulative `le` buckets, gauges,
+  an info metric); `parse_prometheus_text` is the strict in-tree
+  parser the tests and scripts/lint.sh check the output with, so the
+  exposition can never drift into something a real scraper rejects.
+  `GET /metrics` on `onix serve` (oa/serve.py) is the live endpoint.
+
+* **Flight recorder** (`FlightRecorder`) — a bounded ring of recent
+  span-close / counter-delta / fault events (counter deltas arrive via
+  the observer hook this module installs on `obs.counters` at import).
+  `dump(reason)` writes the ring + a full counter snapshot to a JSON
+  artifact; the wired triggers are: any fault-plan site firing
+  (faults.fire), a request shedding (BankService.submit), a model
+  digest mismatch refusing (checkpoint.py), and a `faults`-marker test
+  failing (tests/conftest.py) — so every chaos failure carries its own
+  postmortem. Dumps only land when a directory is routed (config
+  `telemetry.recorder_dir`, applied by `apply_config`, or the
+  ONIX_TELEMETRY_DIR env fallback); an unrouted dump is counted
+  (`telemetry.recorder_dump_unrouted`), never written into cwd.
+
+Kill switches: config `telemetry.enabled=false` / `telemetry.sample=0`
+(durable), ONIX_TELEMETRY=0 (env override for drills). Off means: no
+spans recorded, no ring events, no histogram observations, no dumps —
+and bit-identical winners with unchanged per-program dispatch counts,
+asserted (the hard constraint this layer ships under).
+
+docs/OBSERVABILITY.md is the operator page for all four pieces.
+"""
+
+from __future__ import annotations
+
+import atexit
+import collections
+import contextlib
+import contextvars
+import dataclasses
+import itertools
+import json
+import math
+import os
+import pathlib
+import re
+import threading
+import time
+import zlib
+
+from onix.utils.obs import counters
+
+#: Declared span names: the first argument of every literal
+#: `TRACER.span(...)`/`TRACER.observe(...)` call must be a key here —
+#: machine-checked by `python -m onix.analysis` (the `spans` pass),
+#: because a typo'd span name is a latency series that silently never
+#: aggregates with its siblings. Dead declarations (declared, never
+#: opened) are findings too. Renders into docs/ROBUSTNESS.md
+#: (generated section `span-registry`).
+SPAN_REGISTRY: dict[str, str] = {
+    "bank.admit": "ModelBank._ensure_resident: one wave's residency admission (LRU + H2D staging)",
+    "bank.score_wave": "one batched bank dispatch: kernel call + winner fetch for one wave",
+    "campaign.fit": "campaign orchestrator: one datatype's device fit (retries included)",
+    "campaign.oa": "campaign orchestrator: one datatype's OA stage",
+    "campaign.prepare": "campaign orchestrator: one datatype's host prepare (synth -> words -> corpus)",
+    "campaign.score": "campaign orchestrator: one datatype's scoring stage",
+    "serve.queue_wait": "BankService.submit: admitted-to-scoring-start wall (the admission queue wait)",
+    "serve.request": "oa/serve.py /score: one HTTP request, receipt to response",
+    "serve.score": "BankService.score body: cache lookups + bank dispatch for one batch",
+    "serve.submit": "BankService.submit: one admitted request batch, queue wait + scoring",
+    "stream.batch": "StreamingScorer.process: one streaming minibatch end-to-end",
+    "stream.superstep": "StreamingScorer: one fused S-batch superstep dispatch",
+}
+
+# ---------------------------------------------------------------------------
+# Histograms.
+# ---------------------------------------------------------------------------
+
+
+class Histogram:
+    """Log-bucketed histogram: bucket i covers (g^(i-1), g^i], values
+    <= 0 land in a dedicated underflow bucket with upper edge 0. A
+    quantile read returns the geometric midpoint of its bucket, so the
+    true quantile lies within the bucket's edges — `quantile_bounds`
+    returns them, and `rel_error` (= sqrt(g) - 1) bounds the midpoint's
+    relative error. Exact-to-the-bucket by construction: no sampling,
+    no decay, every observation counted. Thread-safe."""
+
+    GROWTH = 2 ** 0.25          # ~19% bucket width, ~9% midpoint error
+    _UNDERFLOW = -(10 ** 9)     # bucket index for values <= 0
+
+    #: Lock discipline, machine-checked by the `locks` analysis pass.
+    GUARDED_BY = {"_counts": "_lock", "n": "_lock", "sum": "_lock",
+                  "min": "_lock", "max": "_lock"}
+
+    def __init__(self, growth: float | None = None):
+        self.growth = float(growth if growth is not None else self.GROWTH)
+        if self.growth <= 1.0:
+            raise ValueError("histogram growth must be > 1")
+        self._log_g = math.log(self.growth)
+        self._lock = threading.Lock()
+        self._counts: dict[int, int] = {}
+        self.n = 0
+        self.sum = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+
+    @property
+    def rel_error(self) -> float:
+        """Worst-case relative error of `quantile`'s midpoint answer."""
+        return math.sqrt(self.growth) - 1.0
+
+    def _bucket(self, value: float) -> int:
+        if value <= 0.0:
+            return self._UNDERFLOW
+        # ceil(log_g v): the smallest i with g^i >= v.
+        return math.ceil(math.log(value) / self._log_g - 1e-12)
+
+    def edge(self, bucket: int) -> float:
+        """Upper edge of a bucket (0.0 for the underflow bucket)."""
+        return 0.0 if bucket == self._UNDERFLOW else self.growth ** bucket
+
+    def observe(self, value: float) -> None:
+        b = self._bucket(float(value))
+        with self._lock:
+            self._counts[b] = self._counts.get(b, 0) + 1
+            self.n += 1
+            self.sum += float(value)
+            if value < self.min:
+                self.min = float(value)
+            if value > self.max:
+                self.max = float(value)
+
+    def _sorted_counts(self) -> list[tuple[int, int]]:
+        with self._lock:
+            return sorted(self._counts.items())
+
+    def quantile_bounds(self, q: float) -> tuple[float, float]:
+        """(lower edge, upper edge) of the bucket holding the q-quantile
+        (nearest-rank): the true quantile of the observed values lies in
+        this closed interval. (0.0, 0.0) on an empty histogram."""
+        items = self._sorted_counts()
+        total = sum(c for _, c in items)
+        if total == 0:
+            return 0.0, 0.0
+        # rank <= total for q <= 1, so the loop always returns; clamp
+        # out-of-range q instead of walking past the last bucket.
+        rank = min(max(1, math.ceil(q * total)), total)
+        seen = 0
+        for b, c in items:
+            seen += c
+            if seen >= rank:
+                if b == self._UNDERFLOW:
+                    return 0.0, 0.0
+                return self.growth ** (b - 1), self.growth ** b
+        raise AssertionError("unreachable: rank clamped to total")
+
+    def quantile(self, q: float) -> float:
+        """Geometric bucket midpoint of the q-quantile; within
+        `rel_error` of the true nearest-rank quantile, clamped into the
+        observed [min, max] so tiny samples don't report an edge no
+        observation reached."""
+        lo, hi = self.quantile_bounds(q)
+        if hi == 0.0:
+            return 0.0
+        mid = math.sqrt(lo * hi)
+        if self.n:
+            mid = min(max(mid, self.min), self.max)
+        return mid
+
+    def snapshot(self) -> dict:
+        """Manifest-ready summary: count/sum/min/max, the three judged
+        quantiles, the error bound, and the (sparse) bucket table as
+        [upper_edge, count] rows."""
+        items = self._sorted_counts()
+        with self._lock:
+            n, s = self.n, self.sum
+            mn = self.min if self.n else None
+            mx = self.max if self.n else None
+        return {
+            "n": n,
+            "sum": round(s, 9),
+            "min": mn, "max": mx,
+            "p50": self.quantile(0.50),
+            "p99": self.quantile(0.99),
+            "p999": self.quantile(0.999),
+            "rel_error": round(self.rel_error, 4),
+            "buckets": [[self.edge(b), c] for b, c in items],
+        }
+
+
+class HistogramRegistry:
+    """Process-wide named histograms — the distribution analog of
+    `obs.CounterRegistry` (dotted names, same prefix-snapshot
+    discipline). `observe` is the one hot call: the per-name lookup
+    rides a plain dict read (GIL-atomic); only histogram CREATION takes
+    the registry lock."""
+
+    #: Lock discipline, machine-checked by the `locks` analysis pass.
+    GUARDED_BY = {"_hists": "_lock"}
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._hists: dict[str, Histogram] = {}
+
+    def observe(self, name: str, value: float) -> None:
+        h = self._hists.get(name)
+        if h is None:
+            with self._lock:
+                h = self._hists.setdefault(name, Histogram())
+        h.observe(value)
+
+    def get(self, name: str) -> Histogram | None:
+        return self._hists.get(name)
+
+    def names(self, prefix: str = "") -> list[str]:
+        return sorted(k for k in self._hists if k.startswith(prefix))
+
+    def snapshot(self, prefix: str = "", buckets: bool = False) -> dict:
+        """name -> histogram summary (bucket tables only on request —
+        manifests want quantiles, not 200 rows per series)."""
+        out = {}
+        for name in self.names(prefix):
+            h = self._hists.get(name)
+            if h is None:
+                continue
+            snap = h.snapshot()
+            if not buckets:
+                snap.pop("buckets")
+            out[name] = snap
+        return out
+
+    def reset(self, prefix: str = "") -> None:
+        with self._lock:
+            if not prefix:
+                self._hists.clear()
+            else:
+                for k in [k for k in self._hists if k.startswith(prefix)]:
+                    del self._hists[k]
+
+
+#: The process-global histogram registry (tests reset() it).
+histograms = HistogramRegistry()
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder.
+# ---------------------------------------------------------------------------
+
+
+class FlightRecorder:
+    """Bounded ring of recent telemetry events (span closes, counter
+    deltas, fault firings). `record` is lock-free — `deque.append` with
+    a maxlen is GIL-atomic, and losing strict ordering between racing
+    threads is acceptable for a postmortem buffer (each event carries
+    its own monotonic stamp). `dump` snapshots the ring plus a full
+    counter snapshot into a JSON artifact; dumps are capped per process
+    (`max_dumps`) so a fault storm cannot fill a disk, and are counted
+    either way (`telemetry.recorder_dumps` /
+    `telemetry.recorder_dump_skipped` / `..._unrouted`)."""
+
+    #: Dump bookkeeping is the only locked state; the ring itself is
+    #: deliberately lock-free (see class docstring).
+    GUARDED_BY = {"_dumps": "_dump_lock"}
+
+    def __init__(self, capacity: int = 1024, out_dir=None,
+                 max_dumps: int = 32):
+        self._ring: collections.deque = collections.deque(maxlen=capacity)
+        self.out_dir = pathlib.Path(out_dir) if out_dir else None
+        self.max_dumps = max_dumps
+        self._dump_lock = threading.Lock()
+        self._dumps = 0
+
+    def reconfigure(self, capacity: int | None = None,
+                    out_dir=None) -> None:
+        if capacity is not None and capacity != self._ring.maxlen:
+            self._ring = collections.deque(self._ring, maxlen=capacity)
+        if out_dir is not None:
+            self.out_dir = pathlib.Path(out_dir)
+
+    def record(self, kind: str, **fields) -> None:
+        self._ring.append({"mono": round(time.perf_counter(), 6),
+                           "t": round(time.time(), 3),
+                           "kind": kind, **fields})
+
+    def events(self) -> list[dict]:
+        return list(self._ring)
+
+    def clear(self) -> None:
+        self._ring.clear()
+        with self._dump_lock:
+            self._dumps = 0
+
+    def _resolve_dir(self) -> pathlib.Path | None:
+        if self.out_dir is not None:
+            return self.out_dir
+        env = os.environ.get("ONIX_TELEMETRY_DIR")
+        return pathlib.Path(env) if env else None
+
+    def dump(self, reason: str, extra: dict | None = None):
+        """Write the ring to `<dir>/flight-<pid>-<seq>-<reason>.json`.
+        Returns the path, or None when unrouted (no dir configured),
+        capped out, or telemetry is off — all counted, never silent."""
+        if not TRACER.enabled:
+            return None
+        out_dir = self._resolve_dir()
+        if out_dir is None:
+            counters.inc("telemetry.recorder_dump_unrouted")
+            return None
+        with self._dump_lock:
+            if self._dumps >= self.max_dumps:
+                counters.inc("telemetry.recorder_dump_skipped")
+                return None
+            self._dumps += 1
+            seq = self._dumps
+        slug = re.sub(r"[^A-Za-z0-9._-]+", "-", reason)[:80] or "dump"
+        path = out_dir / f"flight-{os.getpid()}-{seq:03d}-{slug}.json"
+        doc = {
+            "reason": reason,
+            "pid": os.getpid(),
+            "t": round(time.time(), 3),
+            "counters": counters.snapshot(),
+            "events": self.events(),
+        }
+        if extra:
+            doc["extra"] = extra
+        # Everything filesystem-shaped stays inside the except: an
+        # unwritable recorder dir must degrade to a counted skip, never
+        # leak an OSError into the TRIGGERING path's control flow (a
+        # shed would 500 instead of 503, an injected fault would raise
+        # the wrong class past its bounded retry).
+        try:
+            out_dir.mkdir(parents=True, exist_ok=True)
+            path.write_text(json.dumps(doc, indent=2, default=repr) + "\n")
+        except OSError:
+            counters.inc("telemetry.recorder_dump_failed")
+            return None
+        counters.inc("telemetry.recorder_dumps")
+        return path
+
+
+# ---------------------------------------------------------------------------
+# Spans.
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class SpanRecord:
+    """One closed span (what the ring and `Tracer.spans()` hold)."""
+    name: str
+    trace_id: str
+    span_id: int
+    parent_id: int | None
+    t0: float               # perf_counter at open
+    dur_s: float
+    error: str | None = None
+    attrs: dict = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass(frozen=True)
+class _TraceCtx:
+    trace_id: str
+    sampled: bool
+
+
+_TRACE: contextvars.ContextVar[_TraceCtx | None] = \
+    contextvars.ContextVar("onix_trace", default=None)
+_PARENT: contextvars.ContextVar[int | None] = \
+    contextvars.ContextVar("onix_span", default=None)
+
+_trace_seq = itertools.count(1)
+_span_seq = itertools.count(1)
+
+
+def new_trace_id() -> str:
+    """Process-unique, human-sortable trace id (no host RNG: the id
+    stream is deterministic per process, which keeps replays and tests
+    reproducible)."""
+    return f"t{os.getpid():x}-{next(_trace_seq):08d}"
+
+
+def current_trace_id() -> str | None:
+    ctx = _TRACE.get()
+    return ctx.trace_id if ctx is not None else None
+
+
+class Tracer:
+    """The span collector. `enabled=False` or `sample=0.0` turns every
+    span into a context manager that only runs its optional clock —
+    the lock-free hot path (no ring append, no histogram observe, no
+    counter inc). Sampling is deterministic per trace id (crc32 hash),
+    so one request's spans are all kept or all dropped together."""
+
+    def __init__(self, enabled: bool = True, sample: float = 1.0):
+        self.enabled = enabled and os.environ.get("ONIX_TELEMETRY",
+                                                  "1") != "0"
+        self.sample = float(sample)
+
+    def configure(self, enabled: bool | None = None,
+                  sample: float | None = None) -> None:
+        if enabled is not None:
+            self.enabled = bool(enabled) \
+                and os.environ.get("ONIX_TELEMETRY", "1") != "0"
+        if sample is not None:
+            if not 0.0 <= sample <= 1.0:
+                raise ValueError("telemetry sample must be in [0, 1]")
+            self.sample = float(sample)
+
+    def _sampled(self, trace_id: str) -> bool:
+        if not self.enabled or self.sample <= 0.0:
+            return False
+        if self.sample >= 1.0:
+            return True
+        return (zlib.crc32(trace_id.encode()) & 0xFFFFFFFF) \
+            < self.sample * 2 ** 32
+
+    @contextlib.contextmanager
+    def trace(self, trace_id: str | None = None):
+        """Open a trace scope on the current context (thread/task):
+        spans inside share the id and the sampling decision. Yields the
+        trace id (the one to echo in X-Request-Id responses)."""
+        tid = trace_id or new_trace_id()
+        tok = _TRACE.set(_TraceCtx(tid, self._sampled(tid)))
+        try:
+            yield tid
+        finally:
+            _TRACE.reset(tok)
+
+    @contextlib.contextmanager
+    def span(self, name: str, *, clock=None, clock_name: str | None = None,
+             **attrs):
+        """One named span. `clock`/`clock_name` FEED an
+        `obs.OccupancyClock` busy scope — entered unconditionally, so
+        occupancy accounting is identical with telemetry off (the
+        feeding-not-duplicating contract). A span that exits via an
+        exception is recorded with `error` set and re-raises."""
+        ctx = _TRACE.get()
+        if ctx is None:
+            # Root span with no surrounding trace (direct harness /
+            # library calls): open an implicit per-span trace so child
+            # spans still nest under one id.
+            tid = new_trace_id()
+            ctx = _TraceCtx(tid, self._sampled(tid))
+            trace_tok = _TRACE.set(ctx)
+        else:
+            trace_tok = None
+        clock_cm = (clock.busy(clock_name or name)
+                    if clock is not None else None)
+        if clock_cm is not None:
+            clock_cm.__enter__()
+        if not ctx.sampled:
+            try:
+                yield None
+            finally:
+                if clock_cm is not None:
+                    clock_cm.__exit__(None, None, None)
+                if trace_tok is not None:
+                    _TRACE.reset(trace_tok)
+            return
+        span_id = next(_span_seq)
+        parent_id = _PARENT.get()       # the span current BEFORE this one
+        parent_tok = _PARENT.set(span_id)
+        rec = SpanRecord(name=name, trace_id=ctx.trace_id, span_id=span_id,
+                         parent_id=parent_id, t0=time.perf_counter(),
+                         dur_s=0.0, attrs=attrs)
+        err: str | None = None
+        try:
+            yield rec
+        except BaseException as e:
+            err = repr(e)
+            raise
+        finally:
+            _PARENT.reset(parent_tok)
+            rec.dur_s = time.perf_counter() - rec.t0
+            rec.error = err
+            self._close(rec)
+            if clock_cm is not None:
+                clock_cm.__exit__(None, None, None)
+            if trace_tok is not None:
+                _TRACE.reset(trace_tok)
+
+    def observe(self, name: str, dur_s: float, **attrs) -> None:
+        """Synthesize a closed span of known duration (a wall measured
+        inline, e.g. the admission queue wait) — same ring + histogram
+        path as `span`, without restructuring the measured code."""
+        ctx = _TRACE.get()
+        if ctx is None or not ctx.sampled:
+            return
+        self._close(SpanRecord(
+            name=name, trace_id=ctx.trace_id, span_id=next(_span_seq),
+            parent_id=_PARENT.get(None), t0=time.perf_counter() - dur_s,
+            dur_s=dur_s, attrs=attrs))
+
+    def _close(self, rec: SpanRecord) -> None:
+        RECORDER.record("span", name=rec.name, trace_id=rec.trace_id,
+                        span_id=rec.span_id, parent_id=rec.parent_id,
+                        dur_s=round(rec.dur_s, 6), error=rec.error,
+                        **rec.attrs)
+        histograms.observe(f"span.{rec.name}", rec.dur_s)
+        counters.inc("telemetry.spans_recorded")
+
+    def spans(self, trace_id: str | None = None) -> list[SpanRecord]:
+        """Recently closed spans (from the flight ring), optionally for
+        one trace — what the end-to-end propagation tests assert on."""
+        out = []
+        for ev in RECORDER.events():
+            if ev.get("kind") != "span":
+                continue
+            if trace_id is not None and ev.get("trace_id") != trace_id:
+                continue
+            out.append(SpanRecord(
+                name=ev["name"], trace_id=ev["trace_id"],
+                span_id=ev["span_id"], parent_id=ev.get("parent_id"),
+                t0=0.0, dur_s=ev["dur_s"], error=ev.get("error"),
+                attrs={k: v for k, v in ev.items()
+                       if k not in ("mono", "t", "kind", "name", "trace_id",
+                                    "span_id", "parent_id", "dur_s",
+                                    "error")}))
+        return out
+
+
+#: Process-global singletons. `apply_config` (or `configure`) retunes
+#: them; tests use `reset_for_tests`.
+TRACER = Tracer()
+RECORDER = FlightRecorder()
+
+
+def configure(enabled: bool | None = None, sample: float | None = None,
+              recorder_dir=None, recorder_events: int | None = None) -> None:
+    TRACER.configure(enabled=enabled, sample=sample)
+    RECORDER.reconfigure(capacity=recorder_events, out_dir=recorder_dir)
+
+
+def apply_config(tcfg) -> None:
+    """Apply a `config.TelemetryConfig` (serve and the CLI entry points
+    call this once the resolved config exists)."""
+    configure(enabled=tcfg.enabled, sample=tcfg.sample,
+              recorder_dir=tcfg.recorder_dir or None,
+              recorder_events=tcfg.recorder_events)
+
+
+def reset_for_tests() -> None:
+    """Clear the ring, the histogram registry, and the telemetry
+    counters; re-enable with full sampling. Tests only."""
+    RECORDER.clear()
+    RECORDER.out_dir = None
+    histograms.reset()
+    counters.reset("telemetry")
+    TRACER.configure(enabled=True, sample=1.0)
+
+
+def snapshot(full: bool = False) -> dict:
+    """The manifest telemetry block: enablement, span/dump tallies, and
+    per-histogram quantile summaries (zeros included — an artifact that
+    recorded nothing says so explicitly). `full=True` adds the complete
+    counter snapshot and bucket tables (the TPU-queue per-entry
+    evidence record)."""
+    out = {
+        "enabled": TRACER.enabled,
+        "sample": TRACER.sample,
+        "spans_recorded": counters.get("telemetry.spans_recorded"),
+        "recorder_dumps": counters.get("telemetry.recorder_dumps"),
+        "recorder_dumps_unrouted":
+            counters.get("telemetry.recorder_dump_unrouted"),
+        "histograms": histograms.snapshot(buckets=full),
+    }
+    if full:
+        out["counters"] = counters.snapshot()
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition + the strict in-tree parser.
+# ---------------------------------------------------------------------------
+
+def _prom_name(dotted: str, suffix: str = "") -> str:
+    name = "onix_" + re.sub(r"[^a-zA-Z0-9_:]", "_", dotted) + suffix
+    return name
+
+
+def _prom_escape(value: str) -> str:
+    return value.replace("\\", r"\\").replace("\n", r"\n").replace('"', r'\"')
+
+
+def _fmt(v: float) -> str:
+    if v == math.inf:
+        return "+Inf"
+    f = float(v)
+    return repr(f) if f != int(f) else str(int(f))
+
+
+def render_prometheus(counter_snap: dict[str, int] | None = None,
+                      hist_reg: HistogramRegistry | None = None,
+                      gauges: dict[str, float] | None = None,
+                      info: dict[str, str] | None = None) -> str:
+    """The Prometheus text format (version 0.0.4): every counter as
+    `onix_<name>` (dots -> underscores), every histogram as
+    `onix_<name>_seconds` with cumulative `le` buckets + `_sum` +
+    `_count`, gauges as given, and one `onix_build_info{...} 1` info
+    metric. Output is validated by `parse_prometheus_text` in tests
+    and scripts/lint.sh."""
+    lines: list[str] = []
+    for name, value in sorted((counter_snap or {}).items()):
+        pn = _prom_name(name)
+        lines.append(f"# HELP {pn} onix counter {name}")
+        lines.append(f"# TYPE {pn} counter")
+        lines.append(f"{pn} {int(value)}")
+    for name, value in sorted((gauges or {}).items()):
+        pn = _prom_name(name)
+        lines.append(f"# HELP {pn} onix gauge {name}")
+        lines.append(f"# TYPE {pn} gauge")
+        lines.append(f"{pn} {_fmt(float(value))}")
+    reg = hist_reg if hist_reg is not None else histograms
+    for name in reg.names():
+        h = reg.get(name)
+        if h is None:
+            continue
+        pn = _prom_name(name, "_seconds")
+        lines.append(f"# HELP {pn} onix log-bucketed histogram {name} "
+                     f"(rel error <= {h.rel_error:.3f})")
+        lines.append(f"# TYPE {pn} histogram")
+        cum = 0
+        for b, c in h._sorted_counts():
+            cum += c
+            lines.append(f'{pn}_bucket{{le="{_fmt(h.edge(b))}"}} {cum}')
+        lines.append(f'{pn}_bucket{{le="+Inf"}} {cum}')
+        lines.append(f"{pn}_sum {_fmt(h.sum)}")
+        lines.append(f"{pn}_count {cum}")
+    kv = ",".join(f'{k}="{_prom_escape(str(v))}"'
+                  for k, v in sorted((info or {}).items()))
+    pn = "onix_build_info"
+    lines.append(f"# HELP {pn} build/config identity of this process")
+    lines.append(f"# TYPE {pn} gauge")
+    lines.append(f"{pn}{{{kv}}} 1" if kv else f"{pn} 1")
+    return "\n".join(lines) + "\n"
+
+
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>[^{}]*)\})?"
+    r"\s+(?P<value>[-+]?(?:[0-9]*\.?[0-9]+(?:[eE][-+]?[0-9]+)?|Inf|NaN))"
+    r"(?:\s+(?P<ts>[-+]?[0-9]+))?\s*$")
+_LABEL_RE = re.compile(
+    r'^(?P<k>[a-zA-Z_][a-zA-Z0-9_]*)="(?P<v>(?:[^"\\]|\\.)*)"$')
+
+
+def parse_prometheus_text(text: str) -> dict[str, dict]:
+    """Strict parser for the exposition format. Returns
+    family base name -> {"type": ..., "samples": [(name, labels, value)]}.
+    Raises ValueError on: malformed lines, samples typed before their
+    TYPE line, duplicate TYPE lines, non-monotone histogram buckets, a
+    histogram missing its +Inf bucket, or `_count` != the +Inf bucket.
+    Deliberately strict — the in-tree gate that keeps /metrics
+    scrapeable by real collectors."""
+    families: dict[str, dict] = {}
+    types: dict[str, str] = {}
+
+    def base_of(name: str) -> str:
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and name[:-len(suffix)] in types \
+                    and types[name[:-len(suffix)]] == "histogram":
+                return name[:-len(suffix)]
+        return name
+
+    for i, line in enumerate(text.splitlines(), start=1):
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) < 3 or parts[1] not in ("HELP", "TYPE"):
+                raise ValueError(f"line {i}: malformed comment {line!r}")
+            if parts[1] == "TYPE":
+                name, typ = parts[2], parts[3].strip()
+                if typ not in ("counter", "gauge", "histogram", "summary",
+                               "untyped"):
+                    raise ValueError(f"line {i}: unknown type {typ!r}")
+                if name in types:
+                    raise ValueError(f"line {i}: duplicate TYPE for {name}")
+                types[name] = typ
+                families[name] = {"type": typ, "samples": []}
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            raise ValueError(f"line {i}: malformed sample {line!r}")
+        labels: dict[str, str] = {}
+        raw = m.group("labels")
+        if raw:
+            for part in _split_labels(raw, i):
+                lm = _LABEL_RE.match(part)
+                if lm is None:
+                    raise ValueError(f"line {i}: malformed label {part!r}")
+                labels[lm.group("k")] = re.sub(
+                    r"\\(.)", lambda m: {"n": "\n"}.get(m.group(1),
+                                                        m.group(1)),
+                    lm.group("v"))
+        base = base_of(m.group("name"))
+        if base not in families:
+            raise ValueError(
+                f"line {i}: sample for {m.group('name')} precedes its "
+                "TYPE line")
+        value = float(m.group("value").replace("Inf", "inf"))
+        families[base]["samples"].append((m.group("name"), labels, value))
+    for name, fam in families.items():
+        if fam["type"] != "histogram":
+            continue
+        buckets = [(lab.get("le"), v) for n, lab, v in fam["samples"]
+                   if n == name + "_bucket"]
+        if not buckets or buckets[-1][0] != "+Inf":
+            raise ValueError(f"histogram {name}: missing +Inf bucket")
+        values = [v for _, v in buckets]
+        if any(b > a for b, a in zip(values, values[1:])):
+            raise ValueError(f"histogram {name}: non-cumulative buckets")
+        count = [v for n, _, v in fam["samples"] if n == name + "_count"]
+        if not count or count[0] != values[-1]:
+            raise ValueError(
+                f"histogram {name}: _count != +Inf bucket")
+        if not any(n == name + "_sum" for n, _, _ in fam["samples"]):
+            raise ValueError(f"histogram {name}: missing _sum")
+    return families
+
+
+def _split_labels(raw: str, line_no: int) -> list[str]:
+    """Split `k="v",k2="v2"` honoring escaped quotes inside values."""
+    out, buf, in_str, esc = [], [], False, False
+    for ch in raw:
+        if esc:
+            buf.append(ch)
+            esc = False
+            continue
+        if ch == "\\" and in_str:
+            buf.append(ch)
+            esc = True
+            continue
+        if ch == '"':
+            in_str = not in_str
+            buf.append(ch)
+            continue
+        if ch == "," and not in_str:
+            out.append("".join(buf))
+            buf = []
+            continue
+        buf.append(ch)
+    if in_str:
+        raise ValueError(f"line {line_no}: unterminated label string")
+    if buf:
+        out.append("".join(buf))
+    return [p for p in out if p]
+
+
+# ---------------------------------------------------------------------------
+# Process wiring: the counter observer and the exit snapshot.
+# ---------------------------------------------------------------------------
+
+
+def _counter_observer(name: str, delta: int, total: int) -> None:
+    """Installed on `obs.counters` at import: every counter delta lands
+    in the flight ring (the `counter-delta` event class), EXCEPT the
+    telemetry namespace itself (a dump incrementing recorder_dumps must
+    not re-enter the ring it just snapshotted)."""
+    if not TRACER.enabled or name.startswith("telemetry."):
+        return
+    RECORDER.record("counter", name=name, delta=delta, total=total)
+
+
+def _register_exit_snapshot() -> None:
+    # run_tpu_queue.py sets this to a per-entry path; the child process
+    # writes a full telemetry snapshot (counters + histograms) there at
+    # exit, so queue entries carry dispatch/compile evidence, not bare
+    # walls.
+    path = os.environ.get("_ONIX_TELEMETRY_SNAPSHOT")
+    if not path:
+        return
+
+    def _write():
+        try:
+            pathlib.Path(path).write_text(
+                json.dumps(snapshot(full=True), indent=2,
+                           default=repr) + "\n")
+        except OSError:
+            counters.inc("telemetry.snapshot_write_failed")
+
+    atexit.register(_write)
+
+
+counters.set_observer(_counter_observer)
+_register_exit_snapshot()

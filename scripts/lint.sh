@@ -21,3 +21,12 @@ python -m onix.analysis "$@"
 # with a visible message when no compiler toolchain is available.
 JAX_PLATFORMS=cpu python -m pytest tests/test_native_asan.py -q \
     -p no:cacheprovider
+
+# Telemetry invariants (r18, docs/OBSERVABILITY.md): the
+# telemetry-disabled bit-identity smoke (winners + dispatch counts
+# unchanged with the layer off — the hard constraint it ships under)
+# and the /metrics exposition checks against the strict in-tree
+# Prometheus parser.
+JAX_PLATFORMS=cpu python -m pytest tests/test_telemetry.py -q \
+    -k "disabled_bit_identity or metrics or render_parse or rejects" \
+    -p no:cacheprovider

@@ -99,6 +99,15 @@ def run_entry(entry: dict, timeout_scale: float,
     log_path = LOG_DIR / f"{entry['id']}.log"
     argv = entry_argv(entry)
     env = dict(os.environ, **entry.get("env", {}))
+    # r18: per-entry telemetry handshake — the child (any onix entry
+    # point; obs.py pulls telemetry in everywhere) writes a full
+    # counters + histograms snapshot here at exit, so a queue entry's
+    # result record carries dispatch/compile/span evidence instead of
+    # a bare wall. A child that died before atexit simply leaves no
+    # file; the record says so.
+    snap_path = LOG_DIR / f"{entry['id']}.telemetry.json"
+    snap_path.unlink(missing_ok=True)
+    env["_ONIX_TELEMETRY_SNAPSHOT"] = str(snap_path)
     # 3x the estimate (scaled) before the hard kill: tunnel compiles
     # routinely run 2-3x a warm estimate, but a hang must not eat the
     # whole window (the bench watchdog lesson, bench.py main()).
@@ -140,6 +149,14 @@ def run_entry(entry: dict, timeout_scale: float,
     rec["wall_s"] = round(time.monotonic() - t0, 1)
     log_path.write_text(f"$ {' '.join(argv)}\n\n== stdout ==\n{out}\n"
                         f"== stderr ==\n{err}\n")
+    try:
+        rec["telemetry"] = json.loads(snap_path.read_text())
+    except FileNotFoundError:
+        rec["telemetry"] = {"missing": "child wrote no exit snapshot "
+                                       "(died before atexit, or never "
+                                       "imported onix)"}
+    except (OSError, json.JSONDecodeError) as e:
+        rec["telemetry"] = {"error": f"snapshot unreadable: {e}"}
     target = entry.get("stdout_json_to")
     if target and rec.get("rc") == 0:
         doc = None

@@ -1,0 +1,17 @@
+"""Fixture: span-registry violations — an undeclared span, a dead
+declaration, and a non-literal span name."""
+
+SPAN_REGISTRY: dict[str, str] = {
+    "used.span": "declared and opened — no finding",
+    "dead.span": "declared but never opened — finding",
+}
+
+TRACER = None       # stand-in receiver; the pass matches by name
+
+
+def run(stage: str) -> None:
+    with TRACER.span("used.span"):
+        pass
+    with TRACER.span("undeclared.span"):        # spans: finding
+        pass
+    TRACER.observe(f"dyn.{stage}", 0.1)         # non-literal: finding

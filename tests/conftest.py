@@ -53,6 +53,24 @@ def pytest_collection_modifyitems(config, items):
             item.add_marker(skip_tpu)
 
 
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_makereport(item, call):
+    """Flight-recorder trigger (r18, docs/OBSERVABILITY.md): a FAILED
+    `faults`-marker test dumps the telemetry ring — the span closes,
+    counter deltas, and fault firings leading up to the assertion — so
+    every chaos failure ships its own postmortem artifact. Routed via
+    ONIX_TELEMETRY_DIR (or telemetry.recorder_dir if the test applied
+    a config); unrouted dumps are counted, not written."""
+    outcome = yield
+    rep = outcome.get_result()
+    if rep.when == "call" and rep.failed and "faults" in item.keywords:
+        from onix.utils import telemetry
+        path = telemetry.RECORDER.dump(f"chaos-test-failed-{item.name}")
+        if path is not None:
+            item.add_report_section(
+                "call", "flight-recorder", f"postmortem dumped to {path}")
+
+
 @pytest.fixture(scope="session")
 def eight_devices():
     devs = jax.devices()

@@ -74,6 +74,40 @@ def test_counters_silent_on_clean_tree_with_exemption():
     assert run_fixture("clean", ["counters"]) == []
 
 
+# -- pass 3b: span registry (r18) ------------------------------------------
+
+def test_spans_fires_on_undeclared_dead_and_dynamic_name():
+    found = run_fixture("violating", ["spans"])
+    msgs = messages(found)
+    assert "'undeclared.span'" in msgs          # opened, not declared
+    assert "'dead.span'" in msgs                # declared, never opened
+    assert "not a string literal" in msgs       # dynamic name
+    assert "'used.span'" not in msgs            # declared + opened: silent
+
+
+def test_spans_silent_on_clean_tree_with_exemption():
+    assert run_fixture("clean", ["spans"]) == []
+
+
+def test_spans_silent_on_tree_without_tracer(tmp_path):
+    # A tree with neither a SPAN_REGISTRY nor tracer calls (plain
+    # libraries, the miniature trees other tests stand up) must not
+    # produce findings.
+    (tmp_path / "m.py").write_text("def f():\n    return 1\n")
+    ctx = AnalysisContext.from_root(tmp_path, [tmp_path / "m.py"])
+    assert core.run_passes(ctx, only=["spans"]) == []
+
+
+def test_span_registry_matches_real_tree():
+    """Both directions over the repo itself, via the real module (the
+    fixture tests prove the pass; this pins the CONTRACT): every
+    declared span opens somewhere, every literal open is declared."""
+    from onix.utils import telemetry
+    ctx = AnalysisContext.from_root(REPO)
+    assert core.run_passes(ctx, only=["spans"]) == []
+    assert telemetry.SPAN_REGISTRY          # non-empty, really wired
+
+
 # -- pass 4: gate discipline ------------------------------------------------
 
 def test_gates_fires_on_handrolled_gate_and_offgate_table_consult():
