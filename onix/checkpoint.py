@@ -174,32 +174,66 @@ def model_path(models_dir: str | pathlib.Path, name: str) -> pathlib.Path:
     return target
 
 
+def model_content_digest(theta, phi_wk) -> str:
+    """Deterministic identity of a model's TABLES: sha256 over the raw
+    array bytes + shapes. This — not `npz_sha256` — is what model
+    LINEAGE chains on (`parent_digest`): npz bytes embed zip member
+    timestamps, so two byte-identical fits saved at different times
+    hash differently at the file level, while a crash-replayed daily
+    supervisor re-saving the same fit must provably produce the same
+    lineage (docs/ROBUSTNESS.md "continuous operation")."""
+    h = hashlib.sha256()
+    for a in (np.asarray(theta, np.float32), np.asarray(phi_wk, np.float32)):
+        h.update(repr((a.shape, str(a.dtype))).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()
+
+
 def save_model(models_dir: str | pathlib.Path, name: str,
                theta, arrays_phi_wk, meta: dict | None = None,
-               epoch: int = 0) -> pathlib.Path:
+               epoch: int = 0, parent_epoch: int | None = None,
+               parent_digest: str | None = None,
+               extra_arrays: dict | None = None) -> pathlib.Path:
     """Atomically persist one tenant's fitted tables (npz + sha256'd
     json meta, the checkpoint discipline).
 
     `epoch` is the MODEL EPOCH (meta key `model_epoch`): 0 for a fresh
     fit, bumped by every online feedback update
-    (feedback.online.OnlineUpdater.nudge_and_save). The serving bank
-    keys its winner cache on it, so a consumer that re-banks the file
-    can never serve winners computed under an older epoch."""
+    (feedback.online.OnlineUpdater.nudge_and_save) and by every daily
+    refit (pipelines/daily.py). The serving bank keys its winner cache
+    on it, so a consumer that re-banks the file can never serve winners
+    computed under an older epoch.
+
+    `parent_epoch`/`parent_digest` are the MODEL LINEAGE (r19): the
+    epoch and `content_sha256` of the model this fit warm-started
+    from, stamped so a day-N+1 model provably descends from day-N's —
+    None (fresh/cold chain start) omits the keys. `extra_arrays` ride
+    the npz next to theta/phi_wk (e.g. the daily supervisor's
+    vocab word-key table, which maps φ̂ rows across days); loaders
+    that only read theta/phi_wk are unaffected."""
     npz_path = model_path(models_dir, name)
     npz_path.parent.mkdir(parents=True, exist_ok=True)
     theta = np.asarray(theta, np.float32)
     phi_wk = np.asarray(arrays_phi_wk, np.float32)
     tmp = npz_path.with_suffix(".npz.tmp")
     with open(tmp, "wb") as f:
-        np.savez(f, theta=theta, phi_wk=phi_wk)
+        np.savez(f, theta=theta, phi_wk=phi_wk,
+                 **{k: np.asarray(v) for k, v in (extra_arrays or {}).items()})
     h = hashlib.sha256()
     with open(tmp, "rb") as f:
         for chunk in iter(lambda: f.read(1 << 22), b""):
             h.update(chunk)
+    lineage = {}
+    if parent_epoch is not None:
+        lineage["parent_epoch"] = int(parent_epoch)
+    if parent_digest is not None:
+        lineage["parent_digest"] = str(parent_digest)
     meta = dict(meta or {}, name=name,
                 n_docs=int(theta.shape[-2]), n_vocab=int(phi_wk.shape[-2]),
                 n_topics=int(theta.shape[-1]),
                 model_epoch=int(epoch),
+                content_sha256=model_content_digest(theta, phi_wk),
+                **lineage,
                 npz_sha256=h.hexdigest(), model_format=1)
     # Stage BOTH tmp files before either final rename, so the
     # npz/json-mismatch window on a re-save is just the two adjacent

@@ -94,6 +94,14 @@ ENV_REGISTRY: dict[str, tuple[str, str]] = {
     "ONIX_DATE": (
         "string YYYY-MM-DD",
         "notebook kernels: the scored date the OA cells read"),
+    "ONIX_DAILY_FORCE_COLD": (
+        "flag: 1=cold every day",
+        "daily supervisor drill override: ignore yesterday's model and "
+        "fit every day cold (pipelines/daily.py) — daily.force_cold is "
+        "the durable knob"),
+    "ONIX_DAILY_TPU": (
+        "flag: 1=keep ambient backend",
+        "exp_daily.py: opt into the real TPU instead of pinning CPU"),
     "ONIX_DEVICE_WORDS": (
         "flag: 0=host words",
         "legacy spelling of ONIX_HOST_WORDS=1 (device_words gate)"),
@@ -679,6 +687,57 @@ class TelemetryConfig:
 
 
 @dataclass
+class DailyConfig:
+    """The r19 continuous-operation supervisor (`onix/pipelines/daily.py`;
+    docs/ROBUSTNESS.md "continuous operation"): how a multi-day chain of
+    campaign runs warm-starts, drift-gates, and rolls back. Production
+    runs the pipeline EVERY day — these knobs govern the day-over-day
+    lifecycle, not any single day's fit."""
+
+    # Drift gate: max per-topic total-variation distance between
+    # today's warm-fitted φ̂ and yesterday's φ̂ over the shared
+    # vocabulary (columns renormalized over the matched rows). A warm
+    # refit whose drift exceeds this is DISCARDED and the day re-fits
+    # cold (counted `daily.drift_cold_refits`) — the bounded-staleness
+    # quality posture of arxiv 0909.4603 applied across days: a warm
+    # chain may coast on yesterday's posterior only while it provably
+    # stays near it. 0 disables the gate (warm fits always accepted).
+    drift_max: float = 0.5
+    # Sweep budget for a warm-started fit (φ̂-as-prior z-init, the
+    # Streaming Gibbs treatment of arxiv 1601.01142). 0 = auto: half
+    # the cold budget, floor 2 — the chain starts near the posterior,
+    # so the wall the daily loop pays is roughly halved (measured in
+    # docs/DAILY_r19_cpu.json; bench `daily_loop` tracks it per run).
+    warm_sweeps: int = 0
+    # Burn-in for a warm-started fit. 0 = auto: 1 sweep — the warm
+    # chain needs settling, not re-convergence, so posterior averaging
+    # starts almost immediately.
+    warm_burn_in: int = 0
+    # Per-day synthetic-feed seed offset: day d draws with
+    # seed + stride*(d-1). 0 = a stationary week (identical background
+    # every day — the dismissal-recurrence harness arm); 1 = fresh
+    # traffic daily.
+    day_seed_stride: int = 1
+    # Durable spelling of the ONIX_DAILY_FORCE_COLD drill: never warm-
+    # start, fit every day cold (the control arm of exp_daily.py).
+    force_cold: bool = False
+
+    def validate(self) -> None:
+        if not 0.0 <= self.drift_max <= 1.0:
+            raise ValueError("daily.drift_max must be in [0, 1] "
+                             "(per-topic total variation), "
+                             f"got {self.drift_max!r}")
+        if self.warm_sweeps < 0:
+            raise ValueError("daily.warm_sweeps must be >= 0 (0 = auto)")
+        if self.warm_burn_in < 0:
+            raise ValueError("daily.warm_burn_in must be >= 0 (0 = auto)")
+        if self.warm_sweeps and self.warm_burn_in >= self.warm_sweeps:
+            raise ValueError("daily.warm_burn_in must be < warm_sweeps")
+        if self.day_seed_stride < 0:
+            raise ValueError("daily.day_seed_stride must be >= 0")
+
+
+@dataclass
 class OAConfig:
     """Operational Analytics (SURVEY.md §2.1 #12-#13): enrichment inputs
     and the per-date UI data directory the dashboards read."""
@@ -706,6 +765,7 @@ class OnixConfig:
     serving: ServingConfig = field(default_factory=ServingConfig)
     feedback: FeedbackConfig = field(default_factory=FeedbackConfig)
     telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
+    daily: DailyConfig = field(default_factory=DailyConfig)
 
     def validate(self) -> "OnixConfig":
         self.lda.validate()
@@ -714,6 +774,7 @@ class OnixConfig:
         self.serving.validate()
         self.feedback.validate()
         self.telemetry.validate()
+        self.daily.validate()
         root = pathlib.Path(self.store.root)
         for attr, sub in (("feedback_dir", "feedback"),
                           ("results_dir", "results"),
@@ -795,6 +856,7 @@ _NESTED = {
     (OnixConfig, "serving"): ServingConfig,
     (OnixConfig, "feedback"): FeedbackConfig,
     (OnixConfig, "telemetry"): TelemetryConfig,
+    (OnixConfig, "daily"): DailyConfig,
 }
 
 

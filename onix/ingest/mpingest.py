@@ -111,6 +111,18 @@ class ClaimStore:
                 json.dump(meta, f)
         except FileExistsError:
             return None
+        # Close the claim/commit TOCTOU window (the r18 drain flake —
+        # one file ingested twice): the done check above can predate
+        # another worker's commit, whose claim→done rename FREES the
+        # claim path right before our O_EXCL create wins it. Re-check
+        # now that we hold the claim: commit/quarantine only ever
+        # create their markers BEFORE the claim path frees, so a
+        # marker present here proves an earlier attempt finished —
+        # drop ours instead of double-ingesting.
+        if (self.dir / f"{digest}.done").exists() \
+                or (self.dir / f"{digest}.quarantined").exists():
+            self.release(digest)
+            return None
         return digest
 
     def commit(self, digest: str) -> None:
@@ -286,10 +298,17 @@ def worker_loop(cfg: OnixConfig, datatype: str,
 
 
 def _worker_entry(cfg_dict: dict, datatype: str, landing: str,
-                  kwargs: dict, q) -> None:
+                  kwargs: dict, stats_path: str) -> None:
     from onix.config import from_dict
     stats = worker_loop(from_dict(cfg_dict), datatype, landing, **kwargs)
-    q.put(stats)
+    # Durable stats handoff: tmp + rename, so the parent reads either a
+    # complete report or nothing (the claims-dir discipline). A queue
+    # would be simpler but its feeder thread races process exit — the
+    # parent's bounded q.get() can miss stats that ARE in flight, which
+    # made the drain tests weather-dependent (the r18 flake).
+    tmp = pathlib.Path(f"{stats_path}.tmp")
+    tmp.write_text(json.dumps(stats))
+    os.replace(tmp, stats_path)
 
 
 def run_workers(cfg: OnixConfig, datatype: str,
@@ -304,51 +323,46 @@ def run_workers(cfg: OnixConfig, datatype: str,
     rendering of the reference's multi-node worker fleet — on a shared
     filesystem the same invocation on N hosts cooperates identically).
 
-    Returns the merged stats dict. A worker that dies without reporting
-    (OOM kill, native crash) is counted under `dead_workers` and as an
-    error — the parent never hangs waiting for a corpse's stats; its
-    claimed file is released to other workers by the lease takeover."""
-    import queue as queue_mod
+    Returns the merged stats dict. Each worker writes its stats to a
+    per-worker file (tmp + atomic rename) as its LAST act before exit,
+    and the parent joins every process before reading them — a
+    deterministic handoff with no sleep-bounded queue drain (the old
+    mp.Queue path raced the feeder thread against process exit and made
+    the drain tests weather-dependent). A worker that dies without
+    reporting (OOM kill, native crash) leaves no stats file, is counted
+    under `dead_workers` and as an error — the parent never hangs
+    waiting for a corpse's stats; its claimed file is released to other
+    workers by the lease takeover."""
+    import tempfile
 
     ctx = multiprocessing.get_context("spawn")   # fork is unsafe under JAX
-    q = ctx.Queue()
     kwargs = dict(patterns=patterns, poll_interval=poll_interval,
                   max_seconds=max_seconds, lease_seconds=lease_seconds,
                   settle_seconds=settle_seconds, idle_exit=idle_exit)
-    procs = [ctx.Process(target=_worker_entry,
-                         args=(cfg.to_dict(), datatype, str(landing),
-                               kwargs, q))
-             for _ in range(n_procs)]
-    for p in procs:
-        p.start()
     merged = {"files": 0, "rows": 0, "errors": 0, "retries": 0,
               "quarantined": 0, "salvaged": 0, "workers": n_procs,
               "dead_workers": 0}
-    reported = 0
-    while reported < n_procs:
-        try:
-            st = q.get(timeout=0.5)
-        except queue_mod.Empty:
-            if not any(p.is_alive() for p in procs):
-                # Last drain: a worker may have flushed its stats right
-                # before exiting.
-                try:
-                    while reported < n_procs:
-                        st = q.get(timeout=0.2)
-                        for k in ("files", "rows", "errors", "retries",
-                                  "quarantined", "salvaged"):
-                            merged[k] += st.get(k, 0)
-                        reported += 1
-                except queue_mod.Empty:
-                    pass
-                break   # remaining workers died without reporting
-            continue
-        for k in ("files", "rows", "errors", "retries", "quarantined",
-                  "salvaged"):
-            merged[k] += st.get(k, 0)
-        reported += 1
-    for p in procs:
-        p.join()
+    with tempfile.TemporaryDirectory(prefix="onix-mpingest-") as td:
+        stats_paths = [pathlib.Path(td) / f"worker-{i}.json"
+                       for i in range(n_procs)]
+        procs = [ctx.Process(target=_worker_entry,
+                             args=(cfg.to_dict(), datatype, str(landing),
+                                   kwargs, str(sp)))
+                 for sp in stats_paths]
+        for p in procs:
+            p.start()
+        for p in procs:
+            p.join()
+        reported = 0
+        for sp in stats_paths:
+            try:
+                st = json.loads(sp.read_text())
+            except (OSError, ValueError):
+                continue        # died before its atomic stats rename
+            for k in ("files", "rows", "errors", "retries", "quarantined",
+                      "salvaged"):
+                merged[k] += st.get(k, 0)
+            reported += 1
     dead = n_procs - reported
     if dead:
         log.error("%d ingest worker(s) died without reporting", dead)

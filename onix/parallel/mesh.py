@@ -92,6 +92,19 @@ def data_axes_of(mesh: Mesh) -> tuple[str, ...]:
     return tuple(a for a in DATA_AXES if a in mesh.shape)
 
 
+def _distributed_is_initialized() -> bool:
+    """Backend-safe "is jax.distributed up" probe. jax >= 0.5 exposes
+    `jax.distributed.is_initialized`; 0.4.x (this container's 0.4.37)
+    does not, so fall back to the distributed global state's client —
+    the same object is_initialized reads — which exists on every 0.4.x
+    and never instantiates the XLA backend."""
+    probe = getattr(jax.distributed, "is_initialized", None)
+    if probe is not None:
+        return bool(probe())
+    from jax._src import distributed as _dist
+    return getattr(_dist.global_state, "client", None) is not None
+
+
 def multihost_init(coordinator: str | None = None,
                    num_processes: int | None = None,
                    process_id: int | None = None,
@@ -118,7 +131,7 @@ def multihost_init(coordinator: str | None = None,
     # Probe via distributed.is_initialized, NOT process_count():
     # process_count() instantiates the XLA backend, after which
     # jax.distributed.initialize refuses to run at all.
-    if jax.distributed.is_initialized():
+    if _distributed_is_initialized():
         return jax.process_count() > 1
     explicit = coordinator is not None
     if explicit:

@@ -826,15 +826,53 @@ class ShardedGibbsLDA:
 
     # -- state construction ----------------------------------------------
 
-    def init_state(self, sc: ShardedCorpus) -> ShardedGibbsState:
+    def init_state(self, sc: ShardedCorpus,
+                   init_phi: np.ndarray | None = None) -> ShardedGibbsState:
         cfg = self.config
         k = cfg.n_topics
         C = cfg.n_chains
         p, m, nb, b = sc.doc_blocks.shape
         rng = np.random.default_rng(cfg.seed)
-        # Independent initial assignments per chain (the restart
-        # ensemble's whole point); padding shares the K sentinel.
-        z = rng.integers(0, k, size=(p, m, C, nb, b)).astype(np.int32)
+        if init_phi is None:
+            # Independent initial assignments per chain (the restart
+            # ensemble's whole point); padding shares the K sentinel.
+            z = rng.integers(0, k, size=(p, m, C, nb, b)).astype(np.int32)
+        else:
+            # φ̂-as-prior warm start (Streaming Gibbs, arxiv
+            # 1601.01142): draw each token's initial topic from
+            # p(k|w) ∝ init_phi[w, k] — yesterday's posterior word-
+            # topic distribution — instead of uniform, so the chain
+            # starts near the previous day's mode and needs a fraction
+            # of the cold sweep budget (daily.warm_sweeps). Host-side,
+            # deterministic in cfg.seed; counts build from z below
+            # exactly as in the cold path. init_phi rows are GLOBAL
+            # vocab ids; the blocked layout holds local chunk ids
+            # (word // n_mp for chunk word % n_mp).
+            init_phi = np.asarray(init_phi, np.float64)
+            if init_phi.shape[0] != sc.n_vocab:
+                raise ValueError(
+                    f"init_phi covers {init_phi.shape[0]} words, corpus "
+                    f"has {sc.n_vocab} — map the prior into TODAY's "
+                    "vocabulary first (campaign.map_phi_prior)")
+            z = np.empty((p, m, C, nb * b), np.int32)
+            flat_w = sc.word_blocks.reshape(p, m, -1)
+            step = 1 << 18       # bound the [T, K] cdf temp, not z
+            for q in range(p):
+                for c in range(m):
+                    w_global = flat_w[q, c].astype(np.int64) * m + c
+                    w_global = np.minimum(w_global, sc.n_vocab - 1)
+                    for s in range(0, w_global.shape[0], step):
+                        sl = slice(s, s + step)
+                        # The cdf depends only on the words — build it
+                        # once per slice, draw uniforms per chain.
+                        cdf = np.cumsum(init_phi[w_global[sl]], axis=1)
+                        cdf /= np.maximum(cdf[:, -1:], 1e-30)
+                        for ch in range(C):
+                            u = rng.random(cdf.shape[0])
+                            z[q, c, ch, sl] = np.minimum(
+                                (cdf < u[:, None]).sum(axis=1),
+                                k - 1).astype(np.int32)
+            z = z.reshape(p, m, C, nb, b)
         z = np.where(sc.mask_blocks[:, :, None] > 0, z, k)
         # Exact global counts built host-side once (init only).
         n_dk = np.zeros((p, C, sc.n_docs_local, k), np.int32)
@@ -901,7 +939,8 @@ class ShardedGibbsLDA:
 
     def fit(self, corpus: Corpus, n_sweeps: int | None = None,
             callback=None, checkpoint_dir=None, resume: bool = True,
-            fault_inject_sweep: int | None = None) -> dict:
+            fault_inject_sweep: int | None = None,
+            init_phi: np.ndarray | None = None) -> dict:
         """Sharded fit loop as fused supersteps, with optional
         checkpoint/resume — the recovery story the reference's MPI job
         lacks (SURVEY.md §5.3: "an MPI rank failure kills the LDA job");
@@ -920,7 +959,14 @@ class ShardedGibbsLDA:
         `fault_inject_sweep` (or env ONIX_FAULT_SWEEP) raises
         SimulatedPreemption right after completing that sweep — the
         same §5.3 fault hook GibbsLDA has, so scale runs on the sharded
-        engine can exercise their resume path too."""
+        engine can exercise their resume path too.
+
+        `init_phi` ([n_vocab, K], today's vocab order) warm-starts the
+        chain from a φ̂-as-prior z draw (init_state) — the r19 daily
+        supervisor's warm refit. A warm chain is a DIFFERENT chain from
+        the cold one, so the prior's content digest joins the checkpoint
+        fingerprint: a cold resume can never continue a warm run or
+        vice versa, and two different priors never share checkpoints."""
         import os
 
         from onix import checkpoint as ckpt
@@ -942,11 +988,23 @@ class ShardedGibbsLDA:
         # chained state layout (chain axis C behind the shard axes);
         # bumping rejects earlier layouts instead of crashing on
         # restore. n_chains is part of the config hash.
+        # Warm-init identity (r19): the prior changes the chain's
+        # initial state, so it must join the resume identity exactly
+        # like a sampler-arm change. Cold fits contribute nothing —
+        # pre-r19 checkpoints keep resuming.
+        warm_extra = {}
+        if init_phi is not None:
+            import hashlib
+            a = np.asarray(init_phi, np.float32)
+            hh = hashlib.sha256(repr(a.shape).encode())
+            hh.update(a.tobytes())
+            warm_extra["warm_init"] = hh.hexdigest()[:16]
         fp = ckpt.fingerprint(cfg,
                               sc.doc_map.shape[0] * sc.n_docs_local,
                               sc.n_vocab, corpus.n_tokens,
                               extra={"mesh": list(self.mesh.shape.values()),
                                      "layout": 4,
+                                     **warm_extra,
                                      # RESOLVED sampler arm: a resume
                                      # across an arm change is refused
                                      # (GibbsLDA.fit has the same rule).
@@ -975,7 +1033,7 @@ class ShardedGibbsLDA:
                 state = self.restore_state(saved.arrays)
                 start = saved.sweep + 1
         if state is None:
-            state = self.init_state(sc)
+            state = self.init_state(sc, init_phi=init_phi)
         from onix.models.lda_gibbs import run_fit_segments
         segments = plan_segments(
             start, n_sweeps, S_step,

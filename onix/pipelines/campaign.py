@@ -41,6 +41,7 @@ Nothing here is a special-cased benchmark kernel.
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import pathlib
 import queue
@@ -70,20 +71,31 @@ _MAX_FIT_ATTEMPTS = 8
 class _Prepared:
     """One datatype's host-side inputs, ready for the device stages."""
 
-    def __init__(self, datatype: str, cols: dict, bundle, planted: set):
+    def __init__(self, datatype: str, cols: dict, bundle, planted: set,
+                 words=None):
         self.datatype = datatype
         self.cols = cols
         self.bundle = bundle
         self.planted = planted
+        self.words = words
 
 
 def _prepare(datatype: str, n_events: int, n_hosts: int, n_anomalies: int,
-             seed: int, gen_arrays) -> _Prepared:
+             seed: int, gen_arrays, feedback=None, dupfactor: int = 1000,
+             edges: dict | None = None) -> _Prepared:
     """The host PREPARE stage: synthesize → word build → corpus build.
     `campaign:prepare` is the fault site (a poisoned input batch); one
     bounded retry absorbs a raise — the same recover-don't-crash rule
     as the watcher's poison path — because the synthesizer is
-    deterministic in seed, so the retry reproduces the same batch."""
+    deterministic in seed, so the retry reproduces the same batch.
+
+    `edges` applies a previously FITTED binning (the r19 daily chain
+    reuses day 1's edges all week so word identities stay comparable
+    across days); None fits fresh quantile edges from this feed.
+    `feedback` rows ((ip, word) dismissals) duplicate ×dupfactor into
+    the corpus — the reference's DUPFACTOR noise-filter loop, which is
+    what makes a mid-week dismissal stay suppressed through the NEXT
+    day's refit (the model itself learns the traffic is common)."""
     for attempt in (0, 1):
         try:
             faults.fire("campaign", "prepare")
@@ -94,10 +106,116 @@ def _prepare(datatype: str, n_events: int, n_hosts: int, n_anomalies: int,
                 raise
     cols = gen_arrays[datatype](n_events, n_hosts=n_hosts,
                                 n_anomalies=n_anomalies, seed=seed)
-    wt = _words_from_cols(datatype, cols)
-    bundle = build_corpus(wt)
+    wt = _words_from_cols(datatype, cols, edges=edges)
+    bundle = build_corpus(wt, feedback, dupfactor)
     planted = set(cols["anomaly_idx"].tolist())
-    return _Prepared(datatype, cols, bundle, planted)
+    return _Prepared(datatype, cols, bundle, planted, words=wt)
+
+
+def _winner_pairs(prep: _Prepared, winner_idx: np.ndarray, n_events: int,
+                  limit: int = 16) -> list[dict]:
+    """The top winners' (ip, word) string pairs — the handle an analyst
+    verdict needs (a dismissal is exactly such a pair, fed back through
+    build_corpus ×dupfactor). Flow events carry two pairs (src-doc and
+    dst-doc); dns/proxy one. Bounded at `limit` winners and gated by
+    collect_winner_pairs — the string render is per-unique-then-
+    broadcast but still O(rows)."""
+    wt = prep.words
+    if wt is None or len(winner_idx) == 0:
+        return []
+    from onix.pipelines.corpus_build import (_flow_pair_layout,
+                                             _single_token_layout)
+    bundle = prep.bundle
+    ips, words = wt.ip, wt.word
+    flow_pair = _flow_pair_layout(bundle, n_events)
+    single = _single_token_layout(bundle, n_events)
+    out = []
+    for e in winner_idx[:limit].tolist():
+        if flow_pair:
+            rows = (e, n_events + e)
+        elif single:
+            rows = (e,)
+        else:
+            rows = tuple(np.nonzero(bundle.token_event == e)[0].tolist())
+        out.append({"event": int(e),
+                    "pairs": [[str(ips[r]), str(words[r])] for r in rows]})
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Day-over-day model carry (r19, pipelines/daily.py): mapping yesterday's
+# φ̂ into today's vocabulary and measuring how far the warm chain drifted.
+# Both key arrays are the PACKED int64 word keys aligned to vocab ids
+# (vocab_word_keys), so rows match by word IDENTITY, not by id order.
+# ---------------------------------------------------------------------------
+
+
+def vocab_word_keys(bundle) -> np.ndarray | None:
+    """Packed int64 word key per vocab id ([V], today's id order), or
+    None when the bundle was built from the string path (no packed
+    keys — the warm carry then falls back to a cold fit, counted)."""
+    if bundle.word_key_sorted is None:
+        return None
+    keys = np.empty(len(bundle.word_key_sorted), np.int64)
+    keys[bundle.word_key_ids] = bundle.word_key_sorted
+    return keys
+
+
+def _prev_rows_of(key_new: np.ndarray, key_prev: np.ndarray
+                  ) -> tuple[np.ndarray, np.ndarray]:
+    """(prev_row, hit) per today-key: the previous model's row index
+    holding the same packed word key — ONE searchsorted pass through
+    the shared `_sorted_table_lookup` idiom (corpus_build), so the
+    edge handling lives in exactly one place."""
+    from onix.pipelines.corpus_build import _sorted_table_lookup
+    order = np.argsort(key_prev, kind="stable")
+    return _sorted_table_lookup(key_prev[order], key_new,
+                                ids=order.astype(np.int32))
+
+
+def map_phi_prior(key_today: np.ndarray, phi_prev: np.ndarray,
+                  key_prev: np.ndarray) -> tuple[np.ndarray, float]:
+    """Yesterday's φ̂ re-indexed into TODAY's vocabulary: row w gets the
+    prior topic distribution of the same packed word key, words unseen
+    yesterday get a flat row (uniform p(k|w) once normalized — the
+    φ̂-as-prior z-init only reads rows as unnormalized topic weights).
+    Returns (prior [V_today, K] float32, matched row fraction)."""
+    rows, hit = _prev_rows_of(key_today, key_prev)
+    k = int(phi_prev.shape[-1])
+    out = np.ones((len(key_today), k), np.float32)
+    if hit.any():
+        out[hit] = np.asarray(phi_prev, np.float32)[rows[hit]]
+    return out, float(hit.mean()) if len(hit) else 0.0
+
+
+def phi_topic_drift(phi_new: np.ndarray, key_new: np.ndarray,
+                    phi_prev: np.ndarray, key_prev: np.ndarray,
+                    exclude_keys: np.ndarray | None = None) -> float | None:
+    """Per-topic φ divergence day-over-day — the drift monitor's
+    number: over the SHARED vocabulary (matched packed keys), each
+    topic's column is renormalized and compared by total-variation
+    distance; the max over topics is returned (in [0, 1]). None when
+    fewer than 2 words are shared (nothing comparable). Surfaced in
+    the campaign manifest's per-datatype OA block, the day ledger, and
+    the `daily.drift` histogram `/metrics` renders.
+
+    `exclude_keys` drops those words from the comparison: the fit
+    stage passes the day's FEEDBACK words, because an analyst's
+    ×dupfactor dismissal deliberately moves p(word|·) by orders of
+    magnitude — a KNOWN intervention, not the organic drift the gate
+    exists to trip on (without this, every dismissal day would force a
+    spurious cold refit)."""
+    rows, hit = _prev_rows_of(key_new, key_prev)
+    if exclude_keys is not None and len(exclude_keys):
+        hit = hit & ~np.isin(key_new, exclude_keys)
+    if hit.sum() < 2:
+        return None
+    a = np.asarray(phi_new, np.float64)[hit]
+    b = np.asarray(phi_prev, np.float64)[rows[hit]]
+    a = a / np.maximum(a.sum(axis=0, keepdims=True), 1e-30)
+    b = b / np.maximum(b.sum(axis=0, keepdims=True), 1e-30)
+    tv = 0.5 * np.abs(a - b).sum(axis=0)
+    return float(tv.max())
 
 
 def run_campaign(n_events: int, datatypes=DATATYPES, n_hosts: int | None = None,
@@ -108,7 +226,13 @@ def run_campaign(n_events: int, datatypes=DATATYPES, n_hosts: int | None = None,
                  merge_staleness: int = 1, dp: int = 0,
                  generator: str = "mixture",
                  resume_dir: str | pathlib.Path | None = None,
-                 out_path: str | pathlib.Path | None = None) -> dict:
+                 out_path: str | pathlib.Path | None = None,
+                 feedback=None, dupfactor: int = 1000,
+                 edges: dict | None = None, edges_sink: dict | None = None,
+                 warm_start: dict | None = None, warm_sweeps: int = 0,
+                 warm_burn_in: int = 0, drift_max: float = 0.0,
+                 model_sink: dict | None = None,
+                 collect_winner_pairs: bool = False) -> dict:
     """One orchestrated ingest→fit→score→OA campaign over `datatypes`.
 
     `overlap=True` pipelines datatype d+1's host prepare against
@@ -118,11 +242,41 @@ def run_campaign(n_events: int, datatypes=DATATYPES, n_hosts: int | None = None,
     are identical (deterministic in seed) and the accounting delta is
     pure orchestration. `merge_form`/`merge_staleness` select the
     sharded engine's count-merge arm (LDAConfig r14 gate). `dp=0`
-    shards the fit over every visible device."""
+    shards the fit over every visible device.
+
+    The r19 daily-supervisor hooks (pipelines/daily.py drives these;
+    every one defaults off and single-day callers are unchanged):
+
+    * `feedback`/`dupfactor` — analyst dismissal rows for the corpus
+      build (the reference's ×DUPFACTOR noise-filter loop);
+    * `edges`/`edges_sink` — per-datatype fitted word-binning reuse
+      across days (in) and capture (out: edges_sink[dt] = the fitted
+      dict), so a multi-day chain's word identities stay comparable;
+    * `warm_start` — per-datatype {"phi": φ̂ [V_prev, K], "word_key":
+      int64 [V_prev]} from yesterday's persisted model: the fit
+      warm-starts from a φ̂-as-prior z draw under a reduced
+      `warm_sweeps`/`warm_burn_in` budget (0 = auto: half the cold
+      sweeps / 1), then the DRIFT MONITOR compares the warm fit's φ̂
+      to the prior per topic (phi_topic_drift); past `drift_max` (> 0
+      enables the gate) the warm fit is discarded and the datatype
+      re-fits cold, counted `daily.drift_cold_refits`. The decision is
+      the `daily:refit` fault site (pre-mutation, one bounded retry);
+    * `model_sink` — model_sink[dt] = {"theta", "phi_wk", "word_key"}
+      host arrays of the accepted fit (requires n_chains == 1 — the
+      persisted-model contract is single-estimate);
+    * `collect_winner_pairs` — per_dt gains the top winners' (ip,
+      word) string pairs, the handle an analyst dismissal needs.
+    """
     import jax
 
     from onix.parallel.mesh import make_mesh
     from onix.parallel.sharded_gibbs import ShardedGibbsLDA
+
+    if (model_sink is not None or warm_start) and n_chains != 1:
+        raise ValueError(
+            "the daily model carry (warm_start/model_sink) is "
+            "single-estimate by contract: combine chains upstream "
+            "(the model-bank rule) or fit with n_chains=1")
 
     if generator == "sessions":
         from onix.pipelines.synth2 import SYNTH2_ARRAYS as gen_arrays
@@ -179,6 +333,11 @@ def run_campaign(n_events: int, datatypes=DATATYPES, n_hosts: int | None = None,
     # -- the prepare pipeline (worker thread, bounded in-order queue) --
     handoff: queue.Queue = queue.Queue(maxsize=max(1, overlap_depth))
 
+    def prepare_of(i: int, dt: str) -> _Prepared:
+        return _prepare(dt, n_events, n_hosts, n_anomalies, seed_of(i),
+                        gen_arrays, feedback=feedback, dupfactor=dupfactor,
+                        edges=(edges or {}).get(dt))
+
     def producer():
         for i, dt in enumerate(datatypes):
             try:
@@ -189,8 +348,7 @@ def run_campaign(n_events: int, datatypes=DATATYPES, n_hosts: int | None = None,
                         telemetry.TRACER.span(
                             "campaign.prepare", clock=clock,
                             clock_name=f"{dt}.prepare", datatype=dt):
-                    item = _prepare(dt, n_events, n_hosts, n_anomalies,
-                                    seed_of(i), gen_arrays)
+                    item = prepare_of(i, dt)
             except BaseException as e:          # noqa: BLE001 — relayed
                 counters.inc("campaign.prepare_failed")
                 handoff.put((dt, e))            # relayed to the driver,
@@ -209,8 +367,7 @@ def run_campaign(n_events: int, datatypes=DATATYPES, n_hosts: int | None = None,
                     telemetry.TRACER.span(
                         "campaign.prepare", clock=clock,
                         clock_name=f"{dt}.prepare", datatype=dt):
-                return _prepare(dt, n_events, n_hosts, n_anomalies,
-                                seed_of(i), gen_arrays)
+                return prepare_of(i, dt)
         with clock.blocked("prepare_wait"):
             got_dt, item = handoff.get()
         assert got_dt == dt, f"prepare handoff out of order: {got_dt}!={dt}"
@@ -218,34 +375,115 @@ def run_campaign(n_events: int, datatypes=DATATYPES, n_hosts: int | None = None,
             raise item
         return item
 
+    def fit_with_resume(model, corpus, ckpt_dir, init_phi=None):
+        """One fit through the bounded preemption-retry drill: resume
+        from the last superstep-boundary checkpoint (or replay
+        deterministically without one) instead of dying like the
+        reference's MPI job."""
+        nonlocal fit_preemptions
+        from onix.checkpoint import SimulatedPreemption
+        attempts = 0
+        while True:
+            try:
+                return model.fit(corpus, checkpoint_dir=ckpt_dir,
+                                 init_phi=init_phi)
+            except SimulatedPreemption:
+                counters.inc("campaign.fit_preempted")
+                fit_preemptions += 1
+                attempts += 1
+                if attempts >= _MAX_FIT_ATTEMPTS:
+                    raise
+
     t_loop = time.perf_counter()
     events_total = 0
     for i, dt in enumerate(datatypes):
         prep = next_prepared(i, dt)
+        if edges_sink is not None and prep.words is not None:
+            edges_sink[dt] = prep.words.edges
         corpus = prep.bundle.corpus
-        model = ShardedGibbsLDA(cfg, corpus.n_vocab, mesh=mesh)
-        dp1_fast = bool(getattr(model, "dp1_fast", False))
+        key_today = vocab_word_keys(prep.bundle)
+        warm = (warm_start or {}).get(dt)
+        init_phi = matched_frac = None
+        if warm is not None:
+            if key_today is None or warm.get("word_key") is None:
+                # String-path bundle or a pre-r19 model without its
+                # word-key table: nothing to map the prior through.
+                counters.inc("daily.warm_unmappable")
+            else:
+                init_phi, matched_frac = map_phi_prior(
+                    key_today, warm["phi"], warm["word_key"])
+        refit_form, drift = "cold", None
+        ws_eff = None
         ckpt_dir = (pathlib.Path(resume_dir) / dt / "fit_ckpt"
                     if resume_dir is not None else None)
         with telemetry.TRACER.trace(trace_of(i, dt)), \
                 telemetry.TRACER.span("campaign.fit", clock=clock,
                                       clock_name=f"{dt}.fit", datatype=dt):
-            from onix.checkpoint import SimulatedPreemption
-            attempts = 0
-            while True:
-                try:
-                    fit = model.fit(corpus, checkpoint_dir=ckpt_dir)
-                    break
-                except SimulatedPreemption:
-                    # The drill: resume from the last superstep-boundary
-                    # checkpoint (or replay deterministically without
-                    # one) instead of dying like the reference's MPI job.
-                    counters.inc("campaign.fit_preempted")
-                    fit_preemptions += 1
-                    attempts += 1
-                    if attempts >= _MAX_FIT_ATTEMPTS:
-                        raise
+            if init_phi is not None:
+                # The r19 refit decision: warm fit under the reduced
+                # budget, drift check against yesterday's φ̂, cold
+                # fallback past the gate. `daily:refit` fires at the
+                # decision's entry — BEFORE any fit state mutates — so
+                # a raise is absorbed by one bounded retry (the
+                # decision is deterministic in its inputs).
+                with telemetry.TRACER.span("daily.refit", datatype=dt):
+                    for attempt in (0, 1):
+                        try:
+                            faults.fire("daily", "refit")
+                            break
+                        except faults.InjectedFault:
+                            counters.inc("daily.refit_retry")
+                            if attempt:
+                                raise
+                    ws_eff = warm_sweeps or max(2, n_sweeps // 2)
+                    wb_eff = min(warm_burn_in or 1, ws_eff - 1)
+                    wcfg = dataclasses.replace(
+                        cfg, n_sweeps=ws_eff, burn_in=wb_eff,
+                        checkpoint_every=(min(SUPERSTEP_DEFAULT,
+                                              max(1, ws_eff // 2))
+                                          if resume_dir is not None else 0))
+                    model = ShardedGibbsLDA(wcfg, corpus.n_vocab, mesh=mesh)
+                    fit = fit_with_resume(model, corpus, ckpt_dir,
+                                          init_phi=init_phi)
+                    counters.inc("daily.warm_fits")
+                    fb_keys = None
+                    if feedback is not None and len(feedback):
+                        wid = prep.bundle.vocab.ids(
+                            feedback["word"].astype(str).to_numpy(),
+                            strict=False)
+                        wid = np.unique(wid[wid >= 0])
+                        fb_keys = key_today[wid] if len(wid) else None
+                    drift = phi_topic_drift(
+                        np.asarray(fit["phi_wk"]), key_today,
+                        warm["phi"], warm["word_key"],
+                        exclude_keys=fb_keys)
+                    if drift is not None:
+                        telemetry.histograms.observe("daily.drift", drift)
+                    if (drift is not None and drift_max > 0
+                            and drift > drift_max):
+                        # The warm chain drifted past the bounded-
+                        # staleness band (arxiv 0909.4603's posture
+                        # across days): discard it, re-fit cold.
+                        counters.inc("daily.drift_cold_refits")
+                        refit_form = "cold_drift"
+                        model = ShardedGibbsLDA(cfg, corpus.n_vocab,
+                                                mesh=mesh)
+                        fit = fit_with_resume(model, corpus, ckpt_dir)
+                    else:
+                        refit_form = "warm"
+            else:
+                if warm_start is not None:
+                    counters.inc("daily.cold_fits")
+                model = ShardedGibbsLDA(cfg, corpus.n_vocab, mesh=mesh)
+                fit = fit_with_resume(model, corpus, ckpt_dir)
+        dp1_fast = bool(getattr(model, "dp1_fast", False))
         theta, phi_wk = fit["theta"], fit["phi_wk"]
+        if model_sink is not None:
+            model_sink[dt] = {
+                "theta": np.asarray(theta, np.float32),
+                "phi_wk": np.asarray(phi_wk, np.float32),
+                "word_key": key_today,
+            }
         with telemetry.TRACER.trace(trace_of(i, dt)), \
                 telemetry.TRACER.span("campaign.score", clock=clock,
                                       clock_name=f"{dt}.score",
@@ -271,10 +509,24 @@ def run_campaign(n_events: int, datatypes=DATATYPES, n_hosts: int | None = None,
                 "selected_score_range": (
                     [float(finite.min()), float(finite.max())]
                     if len(finite) else None),
+                "ll_initial": round(float(fit["ll_history"][0][1]), 6),
                 "ll_final": round(float(fit["ll_history"][-1][1]), 6),
                 "winner_indices": idx[keep].tolist(),
                 "winner_scores": [float(s) for s in scores[keep]],
+                # r19 continuous-operation surfacing: which refit arm
+                # produced this day's model and how far it drifted from
+                # yesterday's φ̂ — the OA-visible face of the drift
+                # monitor (ledger + /metrics carry the same numbers).
+                "refit_form": refit_form,
+                "drift": (round(drift, 6) if drift is not None else None),
+                "warm_sweeps": ws_eff,
+                "warm_matched_vocab_frac": (
+                    round(matched_frac, 4) if matched_frac is not None
+                    else None),
             }
+            if collect_winner_pairs:
+                per_dt[dt]["winner_pairs"] = _winner_pairs(
+                    prep, idx[keep], n_events)
         events_total += n_events
     driver_span = time.perf_counter() - t_loop
     if worker is not None:
@@ -339,7 +591,7 @@ def run_campaign(n_events: int, datatypes=DATATYPES, n_hosts: int | None = None,
     }
     resil = {**counters.snapshot("ingest"), **counters.snapshot("salvage"),
              **counters.snapshot("faults"), **counters.snapshot("ckpt"),
-             **counters.snapshot("campaign")}
+             **counters.snapshot("campaign"), **counters.snapshot("daily")}
     if resil:
         manifest["resilience"] = resil
     if out_path is not None:

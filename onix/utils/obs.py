@@ -38,6 +38,7 @@ COUNTER_NAMESPACES: dict[str, str] = {
     "bench": "bench.py harness self-reporting (probe failures, stale artifacts)",
     "campaign": "campaign orchestrator retries/preemptions (pipelines/campaign.py)",
     "ckpt": "checkpoint/model integrity events (digest mismatches)",
+    "daily": "continuous-operation supervisor events (warm/cold refits, drift fallbacks, ledger refusals, poison-day rollbacks; pipelines/daily.py)",
     "faults": "injected chaos-plan firings, as faults.<stage>.<point>",
     "feedback": "analyst feedback loop events (rescored events, skipped nudges)",
     "ingest": "watcher/mpingest retry + quarantine events",

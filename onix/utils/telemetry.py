@@ -97,6 +97,8 @@ SPAN_REGISTRY: dict[str, str] = {
     "campaign.oa": "campaign orchestrator: one datatype's OA stage",
     "campaign.prepare": "campaign orchestrator: one datatype's host prepare (synth -> words -> corpus)",
     "campaign.score": "campaign orchestrator: one datatype's scoring stage",
+    "daily.day": "daily supervisor: one simulated day end-to-end (campaign + model save + ledger write)",
+    "daily.refit": "daily supervisor: one datatype's warm/cold refit decision — warm fit, drift check, and any drift-forced cold refit",
     "serve.queue_wait": "BankService.submit: admitted-to-scoring-start wall (the admission queue wait)",
     "serve.request": "oa/serve.py /score: one HTTP request, receipt to response",
     "serve.score": "BankService.score body: cache lookups + bank dispatch for one batch",
@@ -611,6 +613,15 @@ def _prom_name(dotted: str, suffix: str = "") -> str:
     return name
 
 
+def _hist_suffix(name: str) -> str:
+    """Prometheus unit suffix for a registry histogram. Span histograms
+    are durations; anything else (e.g. the daily supervisor's
+    `daily.drift`, a total-variation ratio in [0, 1]) renders WITHOUT
+    the `_seconds` suffix — a unit suffix that lies about the unit is
+    worse than none."""
+    return "_seconds" if name.startswith("span.") else ""
+
+
 def _prom_escape(value: str) -> str:
     return value.replace("\\", r"\\").replace("\n", r"\n").replace('"', r'\"')
 
@@ -648,7 +659,7 @@ def render_prometheus(counter_snap: dict[str, int] | None = None,
         h = reg.get(name)
         if h is None:
             continue
-        pn = _prom_name(name, "_seconds")
+        pn = _prom_name(name, _hist_suffix(name))
         lines.append(f"# HELP {pn} onix log-bucketed histogram {name} "
                      f"(rel error <= {h.rel_error:.3f})")
         lines.append(f"# TYPE {pn} histogram")

@@ -27,6 +27,33 @@ def test_invalid_values_rejected():
         from_dict({"serving": {"request_deadline_ms": -5}})
 
 
+def test_daily_knobs_validate():
+    """The r19 continuous-operation section: defaults validate, every
+    knob is range-checked, and dotted overrides reach it."""
+    cfg = OnixConfig().validate()
+    assert cfg.daily.drift_max == 0.5
+    assert cfg.daily.warm_sweeps == 0 and cfg.daily.warm_burn_in == 0
+    assert cfg.daily.day_seed_stride == 1 and not cfg.daily.force_cold
+    with pytest.raises(ValueError):
+        from_dict({"daily": {"drift_max": -0.1}})
+    with pytest.raises(ValueError):
+        from_dict({"daily": {"drift_max": 1.5}})
+    with pytest.raises(ValueError):
+        from_dict({"daily": {"warm_sweeps": -1}})
+    with pytest.raises(ValueError):
+        from_dict({"daily": {"warm_burn_in": -2}})
+    with pytest.raises(ValueError):
+        from_dict({"daily": {"warm_sweeps": 4, "warm_burn_in": 4}})
+    with pytest.raises(ValueError):
+        from_dict({"daily": {"day_seed_stride": -1}})
+    with pytest.raises(KeyError):
+        from_dict({"daily": {"bogus": 1}})
+    cfg = from_dict({"daily": {"drift_max": 0.2, "warm_sweeps": 6,
+                               "warm_burn_in": 2, "force_cold": True}})
+    assert cfg.daily.drift_max == 0.2 and cfg.daily.warm_sweeps == 6
+    assert cfg.daily.force_cold
+
+
 def test_load_with_overrides(tmp_path):
     p = tmp_path / "c.json"
     p.write_text(json.dumps({"lda": {"n_topics": 10}}))
