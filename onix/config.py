@@ -77,6 +77,12 @@ ENV_REGISTRY: dict[str, tuple[str, str]] = {
     "ONIX_BANK_FORM": (
         "choice: auto|vmap|gather",
         "model-bank batched-scoring form override (model_bank.select_bank_form)"),
+    "ONIX_BANK_SHARD": (
+        "choice: auto|single|sharded",
+        "model-bank mesh placement override (model_bank.select_shard_form)"),
+    "ONIX_BANK_TPU": (
+        "flag: 1=keep ambient backend",
+        "exp_model_bank.py: opt into the real TPU instead of pinning CPU"),
     "ONIX_BENCH_COMPONENTS": (
         "csv of component names",
         "bench.py: run only these components (debugging a single arm)"),
@@ -567,6 +573,27 @@ class ServingConfig:
     # (`serve.form_fallback`) and stamped `degraded: true` on the
     # response. Off = the failure propagates (debugging the kernel).
     degrade_form_fallback: bool = True
+    # Mesh placement (r20): "single" keeps every tenant's bank on one
+    # device (the pre-r20 shape); "sharded" spreads shape-class banks
+    # over the visible device mesh by tenant hash — per-device waves,
+    # no cross-device collective, winners bit-identical. "auto"
+    # defers to the measured per-backend crossover table
+    # (model_bank._BANK_SHARD_MIN_TENANTS — deliberately EMPTY until
+    # the queued docs/TPU_QUEUE.json `bank_sharded_tpu` rows land, so
+    # auto resolves single everywhere today); ONIX_BANK_SHARD
+    # overrides for experiments.
+    bank_shard: str = "auto"
+    # Host-RAM tier prefetch budget (r20): tenants promoted from disk
+    # into the host registry per request-batch boundary, ranked by the
+    # bank's decayed Zipf demand estimate. 0 disables prefetch (misses
+    # load on demand — the pre-r20 shape).
+    prefetch_depth: int = 0
+    # Serve replicas behind one front (r20, onix/serving/replicas.py):
+    # N independent BankService replicas, tenant-hash routed, with the
+    # epoch bulletin guaranteeing an out-of-band bump (feedback, daily
+    # refit) reaches a tenant's serving replica before its next score.
+    # 1 = a bare BankService (the pre-r20 shape).
+    replicas: int = 1
 
     def validate(self) -> None:
         if self.bank_capacity < 1:
@@ -591,6 +618,15 @@ class ServingConfig:
             raise ValueError("serving.max_batch_requests must be >= 1")
         if self.winner_cache_size < 0:
             raise ValueError("serving.winner_cache_size must be >= 0")
+        if self.bank_shard not in ("auto", "single", "sharded"):
+            raise ValueError(
+                "serving.bank_shard must be auto|single|sharded, "
+                f"got {self.bank_shard!r}")
+        if self.prefetch_depth < 0:
+            raise ValueError("serving.prefetch_depth must be >= 0 "
+                             "(0 = off)")
+        if self.replicas < 1:
+            raise ValueError("serving.replicas must be >= 1")
 
 
 @dataclass

@@ -422,18 +422,39 @@ class OAHandler(SimpleHTTPRequestHandler):
         from onix.checkpoint import list_models
         from onix.utils.obs import counters
         service = self.server.bank_service(self.cfg)
+        front = getattr(service, "replicas", None)
         with service.lock:
-            stats = {
-                "tenants_registered": len(service.bank.tenants()),
+            if front is not None:
+                # Multi-replica front (r20): aggregate the per-replica
+                # banks; `tiers` carries each replica's HBM / host-RAM
+                # / disk occupancy + hit/prefetch accounting.
+                banks = [s.bank for s in front]
+                stats = {
+                    "tenants_registered": sum(len(b.tenants())
+                                              for b in banks),
+                    "dispatches": sum(b.dispatches for b in banks),
+                    "compiled_shapes": sum(len(b.compiled_shapes)
+                                           for b in banks),
+                    "tiers": service.tier_stats(),
+                }
+            else:
+                stats = {
+                    "tenants_registered": len(service.bank.tenants()),
+                    "dispatches": service.bank.dispatches,
+                    "compiled_shapes": len(service.bank.compiled_shapes),
+                    # r20 residency tiers: HBM (device-resident), host
+                    # RAM (registry / prefetcher), disk (loader) —
+                    # occupancy, hit/miss, and prefetch counters.
+                    "tiers": service.bank.tier_stats(),
+                }
+            stats.update({
                 "models_on_disk": len(list_models(
                     self.cfg.serving.models_dir)),
-                "dispatches": service.bank.dispatches,
-                "compiled_shapes": len(service.bank.compiled_shapes),
                 "cache": service.cache_stats(),
                 "admission": service.admission_stats(),
                 "counters": {**counters.snapshot("bank"),
                              **counters.snapshot("serve")},
-            }
+            })
         self._send_json(200, stats)
 
     def _metrics(self):
@@ -459,27 +480,61 @@ class OAHandler(SimpleHTTPRequestHandler):
             gauges["serve.queue_depth"] = adm["queue_depth"]
             gauges["serve.queue_depth_high_water"] = adm["queue_depth_peak"]
             gauges["serve.max_queue_depth"] = adm["max_queue_depth"]
-            got_lock = service.lock.acquire(timeout=0.25)
-            if got_lock:
+            # Multi-replica front (r20): walk each live replica's
+            # service; the single-service path is the same loop over
+            # one element. Gauges aggregate (sums; epoch max).
+            front = getattr(service, "replicas", None)
+            if front is not None:
+                services = [front[i] for i in service.alive_indices()]
+                gauges["serve.replicas_alive"] = len(services)
+                gauges["serve.replicas_down"] = \
+                    len(front) - len(services)
+            else:
+                services = [service]
+            agg: dict[str, float] = {}
+            epoch_max = 0
+            covered = 0
+            for svc in services:
+                # Each replica's bank internals live under ITS lock
+                # (one lock == the whole service pre-r20); a scrape
+                # landing mid-wave on one replica reports partial
+                # instead of stalling behind that replica's device
+                # work.
+                if not svc.lock.acquire(timeout=0.25):
+                    continue
                 try:
-                    bank = service.bank
+                    bank = svc.bank
                     epochs = list(bank._epochs.values())
-                    gauges.update({
+                    epoch_max = max([epoch_max] + epochs)
+                    tiers = bank.tier_stats()
+                    for k, v in {
                         "bank.tenants_registered": len(bank.tenants()),
                         "bank.tenants_resident": sum(
-                            len(sh.lru) for sh in bank._shards.values()),
+                            len(sh.lru)
+                            for sh in bank._shards.values()),
                         "bank.shape_classes": len(bank._shards),
                         "bank.compiled_shape_count":
                             len(bank.compiled_shapes),
                         "bank.dispatch_count": bank.dispatches,
                         "bank.tenants_with_filters": len(bank._filters),
-                        "bank.model_epoch_max":
-                            max(epochs) if epochs else 0,
-                        "bank.winner_cache_entries": len(service._cache),
-                    })
+                        "bank.winner_cache_entries": len(svc._cache),
+                        # r20 residency tiers: live occupancy per tier
+                        # (the counters carry hit/miss rates).
+                        "bank.tier_hbm_resident":
+                            tiers["hbm"]["resident"],
+                        "bank.tier_host_resident":
+                            tiers["host"]["resident"],
+                        "bank.prefetch_tracked_tenants":
+                            tiers["prefetch"]["tracked_tenants"],
+                    }.items():
+                        agg[k] = agg.get(k, 0) + v
+                    covered += 1
                 finally:
-                    service.lock.release()
-            else:
+                    svc.lock.release()
+            if covered:
+                gauges.update(agg)
+                gauges["bank.model_epoch_max"] = epoch_max
+            if covered < len(services):
                 gauges["metrics.partial"] = 1.0
         body = telemetry.render_prometheus(
             counters.snapshot(), telemetry.histograms, gauges,
@@ -748,22 +803,48 @@ class OAServer(ThreadingHTTPServer):
                     except ValueError:      # traversal-shaped name
                         return None
 
-                bank = ModelBank(capacity=cfg.serving.bank_capacity,
-                                 form=cfg.serving.bank_form,
-                                 loader=loader, bulk_loader=bulk_loader,
-                                 host_capacity=cfg.serving.host_model_cache,
-                                 filter_loader=filter_loader,
-                                 epoch_loader=epoch_loader,
-                                 serve_form=cfg.serving.serve_form,
-                                 degrade_form_fallback=(
-                                     cfg.serving.degrade_form_fallback))
-                self._bank_service = BankService(
-                    bank,
-                    max_batch_requests=cfg.serving.max_batch_requests,
-                    cache_size=cfg.serving.winner_cache_size,
-                    max_queue_depth=cfg.serving.max_queue_depth,
-                    request_deadline_s=(
-                        cfg.serving.request_deadline_ms / 1e3))
+                # r20 mesh placement: hand the bank the device list so
+                # select_shard_form can resolve against a real mesh
+                # (auto stays single-device until the queued TPU
+                # crossover fills _BANK_SHARD_MIN_TENANTS).
+                import jax
+
+                def _one_service() -> BankService:
+                    bank = ModelBank(
+                        capacity=cfg.serving.bank_capacity,
+                        form=cfg.serving.bank_form,
+                        loader=loader, bulk_loader=bulk_loader,
+                        host_capacity=cfg.serving.host_model_cache,
+                        filter_loader=filter_loader,
+                        epoch_loader=epoch_loader,
+                        serve_form=cfg.serving.serve_form,
+                        degrade_form_fallback=(
+                            cfg.serving.degrade_form_fallback),
+                        devices=jax.devices(),
+                        shard_form=cfg.serving.bank_shard,
+                        prefetch_depth=cfg.serving.prefetch_depth)
+                    return BankService(
+                        bank,
+                        max_batch_requests=cfg.serving.max_batch_requests,
+                        cache_size=cfg.serving.winner_cache_size,
+                        max_queue_depth=cfg.serving.max_queue_depth,
+                        request_deadline_s=(
+                            cfg.serving.request_deadline_ms / 1e3))
+
+                if cfg.serving.replicas > 1:
+                    # N replicas behind one front: each replica owns
+                    # its own bank + winner cache; the front routes by
+                    # tenant hash and propagates epoch bumps
+                    # (onix/serving/replicas.py). All replicas share
+                    # this process's model store, so the r13
+                    # refresh_from_disk probe works unchanged per
+                    # replica.
+                    from onix.serving.replicas import ReplicaFront
+                    self._bank_service = ReplicaFront(
+                        [_one_service()
+                         for _ in range(cfg.serving.replicas)])
+                else:
+                    self._bank_service = _one_service()
             return self._bank_service
 
     def server_close(self):

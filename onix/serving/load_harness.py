@@ -68,6 +68,16 @@ class HarnessSpec:
     # served-latency bound (depth+1)·service-time is provable.
     max_queue_depth: int = 0
     request_deadline_ms: float = 0.0
+    # r20 scale-out: `devices` > 1 builds the bank over that many mesh
+    # devices (jax.devices()[:n] — virtual on CPU) with `shard_form`
+    # routed through select_shard_form; `replicas` > 1 stands up N
+    # services behind a ReplicaFront; `prefetch_depth`/`host_capacity`
+    # exercise the host-RAM residency tier.
+    devices: int = 0
+    shard_form: str = "auto"
+    replicas: int = 1
+    prefetch_depth: int = 0
+    host_capacity: int = 0
 
 
 def make_tenants(spec: HarnessSpec) -> dict[str, TenantModel]:
@@ -119,15 +129,51 @@ def make_stream(spec: HarnessSpec) -> list[ScoreRequest]:
 
 
 def build_service(spec: HarnessSpec, models: dict[str, TenantModel],
-                  form: str = "auto", serve_form: str = "auto"
-                  ) -> BankService:
+                  form: str = "auto", serve_form: str = "auto"):
+    """One service (the pre-r20 shape), or the r20 scale-out fabric
+    when the spec asks for it: a mesh-sharded bank (spec.devices > 1),
+    the host-RAM tier (host_capacity / prefetch_depth — tenants arrive
+    loader-backed so the tier actually churns), and/or N replicas
+    behind a ReplicaFront (spec.replicas > 1)."""
     cap = spec.capacity or spec.n_tenants
-    bank = ModelBank(capacity=cap, form=form, serve_form=serve_form)
-    for name, m in models.items():
-        bank.add(name, m.theta, m.phi_wk)
-    return BankService(bank, max_batch_requests=spec.batch_requests,
-                       max_queue_depth=spec.max_queue_depth,
-                       request_deadline_s=spec.request_deadline_ms / 1e3)
+    devices = None
+    if spec.devices:
+        import jax
+        if spec.devices > len(jax.devices()):
+            raise ValueError(
+                f"spec.devices={spec.devices} > available "
+                f"{len(jax.devices())} (set "
+                "xla_force_host_platform_device_count)")
+        devices = jax.devices()[:spec.devices]
+    tiered = bool(spec.host_capacity or spec.prefetch_depth)
+
+    def bulk_loader(names: list[str]) -> dict[str, TenantModel]:
+        return {n: models[n] for n in names if n in models}
+
+    def _one():
+        bank = ModelBank(
+            capacity=cap, form=form, serve_form=serve_form,
+            devices=devices, shard_form=spec.shard_form,
+            prefetch_depth=spec.prefetch_depth,
+            host_capacity=spec.host_capacity,
+            loader=(lambda t: models.get(t)) if tiered else None,
+            bulk_loader=bulk_loader if tiered else None)
+        if not tiered:
+            # Pre-r20 shape: everything explicitly add()ed (pinned in
+            # the host registry). The tiered path leaves tenants to
+            # the loader so promote/demote across host RAM is real.
+            for name, m in models.items():
+                bank.add(name, m.theta, m.phi_wk)
+        return BankService(bank,
+                           max_batch_requests=spec.batch_requests,
+                           max_queue_depth=spec.max_queue_depth,
+                           request_deadline_s=(
+                               spec.request_deadline_ms / 1e3))
+
+    if spec.replicas > 1:
+        from onix.serving.replicas import ReplicaFront
+        return ReplicaFront([_one() for _ in range(spec.replicas)])
+    return _one()
 
 
 def _pctl(latencies: list[float]) -> dict:
@@ -174,7 +220,11 @@ def replay(service: BankService, stream: list[ScoreRequest], *,
     tallied separately under `shed_attempts_retried`."""
     base = {k: counters.get(f"bank.{k}")
             for k in ("admit", "evict", "dispatch", "cache_hit",
-                      "cache_miss", "h2d_bytes", "h2d_transfers")}
+                      "cache_miss", "h2d_bytes", "h2d_transfers",
+                      "tier_hbm_hit", "tier_host_hit", "tier_disk_load",
+                      "prefetch_promoted", "prefetch_hit",
+                      "prefetch_waste", "prefetch_failed",
+                      "fetch_wait_us")}
     # Serve-tier counters are process-global and cumulative; a replay's
     # artifact must report ITS OWN deltas (the bank-counter discipline
     # above) — warm passes and earlier arms in the same process would
@@ -186,11 +236,20 @@ def replay(service: BankService, stream: list[ScoreRequest], *,
     outcomes: dict[str, list[float]] = {
         "served": [], "degraded": [], "shed": [], "deadline": [],
         "refused": []}
+    # r20 per-tier latency: each SCORED batch classifies by the worst
+    # residency tier it touched (disk > host RAM > HBM, read off the
+    # per-batch bank.tier_* counter deltas) — "a request that had to
+    # go to disk cost THIS much" is the number the tier exists to
+    # improve, and the artifact's per-tier p50/p99 comes from here.
+    _tier_keys = ("tier_disk_load", "tier_host_hit", "tier_hbm_hit")
+    tier_lats: dict[str, list[float]] = {
+        "hbm": [], "host": [], "disk": []}
     n_events = 0
     retried = 0
     t0 = time.perf_counter()
     for lo in range(0, len(stream), service.max_batch_requests):
         batch = stream[lo:lo + service.max_batch_requests]
+        tier_base = {k: counters.get(f"bank.{k}") for k in _tier_keys}
         out, kind, lat = None, "shed", 0.0
         for attempt in range(shed_retries + 1):
             tb = time.perf_counter()
@@ -216,6 +275,11 @@ def replay(service: BankService, stream: list[ScoreRequest], *,
         results.extend(out if out is not None else [None] * len(batch))
         if out is not None:
             n_events += sum(int(r.doc_ids.size) for r in batch)
+            td = {k: counters.get(f"bank.{k}") - tier_base[k]
+                  for k in _tier_keys}
+            tier = ("disk" if td["tier_disk_load"] else
+                    "host" if td["tier_host_hit"] else "hbm")
+            tier_lats[tier].append(lat)
     wall = time.perf_counter() - t0
     delta = {k: counters.get(f"bank.{k}") - v for k, v in base.items()}
     cacheable = delta["cache_hit"] + delta["cache_miss"]
@@ -246,6 +310,28 @@ def replay(service: BankService, stream: list[ScoreRequest], *,
                             "evicts": delta["evict"]},
         "h2d": {"bytes": delta["h2d_bytes"],
                 "transfers": delta["h2d_transfers"]},
+        # r20: per-tier latency + tier/prefetch accounting (deltas, the
+        # same discipline as the bank counters above). `wave_dispatches`
+        # is per-home-device (bank.wave.d<i>) — process-cumulative, so
+        # it appears only when the sharded path ran at all.
+        "tier_latency": {t: _pctl(v) for t, v in tier_lats.items()
+                         if v},
+        "tiers": {"hbm_hits": delta["tier_hbm_hit"],
+                  "host_hits": delta["tier_host_hit"],
+                  "disk_loads": delta["tier_disk_load"]},
+        "prefetch": {
+            "promoted": delta["prefetch_promoted"],
+            "hits": delta["prefetch_hit"],
+            "waste": delta["prefetch_waste"],
+            "failed": delta["prefetch_failed"],
+            "hit_rate": (round(delta["prefetch_hit"]
+                               / delta["prefetch_promoted"], 4)
+                         if delta["prefetch_promoted"] else None)},
+        "fetch_wait_us": delta["fetch_wait_us"],
+        "wave_dispatches": {
+            k.split("bank.wave.", 1)[1]: v
+            for k, v in counters.snapshot("bank").items()
+            if k.startswith("bank.wave.d")},
     }
 
 

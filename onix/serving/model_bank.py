@@ -47,6 +47,35 @@ capped bank's winners are identical to an uncapped run (tested, and
 proven at harness scale in scripts/exp_model_bank.py). Admits, evicts,
 hits, H2D bytes/transfers, and dispatches are all counted in
 `onix.utils.obs.counters` under ``bank.*``.
+
+Sharding (r20): the bank optionally spreads its shape-class banks over
+a dp device mesh by TENANT HASH — each tenant's tables live wholly on
+its stable home device (crc32 placement), a mixed-tenant batch splits
+into per-device waves, and each wave dispatches as an INDEPENDENT
+device program. No array is ever partitioned across devices, so the
+compiled scoring HLO is psum-free BY CONSTRUCTION (asserted: the first
+compile of every sharded shape is scanned for collective ops), and
+per-tenant winners are bit-identical to the single-device bank — the
+same `_scan_bottom_k` runs over the same per-tenant tables, only the
+device it runs on changes (the AD-LDA locality argument, arxiv
+0909.4603, applied one level up: placement, not decomposition).
+`select_shard_form` gates single vs sharded through the shared
+`resolve_form_gate` chain; `_BANK_SHARD_MIN_TENANTS` starts EMPTY per
+the r15 discipline, so auto resolves single-device everywhere until
+the queued TPU crossover lands (docs/TPU_QUEUE.json
+`bank_sharded_tpu`).
+
+Residency tiers (r20): three explicit tiers — HBM (shard slots), host
+RAM (`_models`, bounded by `host_capacity`), disk (`bulk_loader` →
+`checkpoint.load_models`). A demand-tracked PREFETCHER sits between
+disk and the host tier: per-tenant request counts decay into a Zipf
+demand estimate, and at request-batch boundaries the hottest
+not-host-resident tenants are promoted in one bulk pass
+(`bank.prefetch_*` counters; chaos site `bank:prefetch` fires at
+entry, pre-mutation, so one bounded retry replays safely — and the
+prefetch is best-effort: exhaustion never fails scoring). Device
+admission is untouched: one `device_put` per table family per wave
+boundary, exactly as before.
 """
 
 from __future__ import annotations
@@ -55,6 +84,7 @@ import dataclasses
 import functools
 import threading
 import time
+import zlib
 from collections import OrderedDict
 
 import jax
@@ -133,6 +163,68 @@ def select_bank_form(form: str, n_requests: int, n_pad: int,
     return resolve_form_gate(gate="bank form", choices=("vmap", "gather"),
                              explicit=form, env_var="ONIX_BANK_FORM",
                              measured=measured, default="vmap")
+
+
+# Measured crossover for the r20 sharded placement: registered tenants
+# above which spreading the shape-class banks over the dp mesh beats
+# one device (per-device waves dispatch independently, so the win is
+# parallel occupancy minus the per-device compile + admission
+# duplication). Keyed by backend like `_BANK_GATHER_MIN_EVENTS`;
+# DELIBERATELY EMPTY for every backend — cpu included — until the
+# queued TPU rows land (docs/TPU_QUEUE.json `bank_sharded_tpu`): this
+# 2-core host's virtual devices share the same cores, so a CPU
+# "crossover" would be scheduler noise, never a chip decision. Auto
+# therefore resolves single-device everywhere today; the forms are
+# bit-identical, so pinning `sharded` (config or ONIX_BANK_SHARD) is
+# always safe.
+_BANK_SHARD_MIN_TENANTS: dict[str, int] = {}
+
+
+def select_shard_form(form: str, n_tenants: int, n_devices: int,
+                      backend: str | None = None) -> str:
+    """Resolve the bank placement form: "single" (every tenant on the
+    default device — the pre-r20 shape) vs "sharded" (tenant-hash
+    placement over the mesh). Same precedence chain as every measured
+    gate (config.resolve_form_gate): ONIX_BANK_SHARD env override >
+    explicit config form > the measured `_BANK_SHARD_MIN_TENANTS`
+    table > single. Resolved ONCE per bank (first score) and frozen —
+    placement keys device residency, so flipping mid-life would strand
+    resident tenants on devices the router no longer picks."""
+    def measured() -> str | None:
+        b = backend if backend is not None else jax.default_backend()
+        min_tenants = _BANK_SHARD_MIN_TENANTS.get(b)
+        if min_tenants is not None and n_devices >= 2 \
+                and n_tenants >= min_tenants:
+            return "sharded"
+        return None
+
+    return resolve_form_gate(gate="bank shard", choices=("single", "sharded"),
+                             explicit=form, env_var="ONIX_BANK_SHARD",
+                             measured=measured, default="single")
+
+
+#: Substrings that name a cross-device collective in optimized HLO.
+#: The sharded bank's psum-free-by-construction claim is machine-
+#: checked against these: every per-device wave is an independent
+#: single-device program, so NONE may appear in its compiled text.
+_COLLECTIVE_MARKERS = ("all-reduce", "all-gather", "all-to-all",
+                       "collective-permute", "reduce-scatter",
+                       "collective-broadcast")
+
+
+def assert_collective_free(kernel, args, *, max_results: int) -> None:
+    """Compile `kernel` for `args` and assert the optimized HLO names
+    no cross-device collective (`_COLLECTIVE_MARKERS`). Cheap where it
+    runs: lowering hits the same jit cache the scoring call populates,
+    so the text render is the only extra work — and it runs once per
+    compiled shape (the caller's `collective_checked` set)."""
+    txt = kernel.lower(*args, max_results=max_results).compile().as_text()
+    found = [m for m in _COLLECTIVE_MARKERS if m in txt]
+    if found:
+        raise AssertionError(
+            f"sharded bank program compiled a cross-device collective "
+            f"({', '.join(found)}) — per-device waves must be "
+            "independent single-device programs")
 
 
 class BankRefusal(ValueError):
@@ -285,14 +377,24 @@ def _bank_kernel_for(form: str, serve: str):
 
 
 class _Shard:
-    """One shape class's resident bank: [C, D_pad, K] / [C, V_pad, K]
-    device arrays plus the tenant→slot LRU bookkeeping."""
+    """One (shape class, home device)'s resident bank: [C, D_pad, K] /
+    [C, V_pad, K] device arrays plus the tenant→slot LRU bookkeeping.
+    `device` pins the arrays (sharded placement); None keeps jax's
+    default device — the pre-r20 single-device shape."""
 
-    def __init__(self, d_pad: int, v_pad: int, k: int, capacity: int):
+    def __init__(self, d_pad: int, v_pad: int, k: int, capacity: int,
+                 device=None, device_index: int = 0):
         self.d_pad, self.v_pad, self.k = d_pad, v_pad, k
         self.capacity = capacity
-        self.theta = jnp.zeros((capacity, d_pad, k), jnp.float32)
-        self.phi = jnp.zeros((capacity, v_pad, k), jnp.float32)
+        self.device = device
+        self.device_index = device_index
+        theta = jnp.zeros((capacity, d_pad, k), jnp.float32)
+        phi = jnp.zeros((capacity, v_pad, k), jnp.float32)
+        if device is not None:
+            theta = jax.device_put(theta, device)
+            phi = jax.device_put(phi, device)
+        self.theta = theta
+        self.phi = phi
         self.lru: OrderedDict[str, int] = OrderedDict()  # tenant -> slot
         self.free: list[int] = list(range(capacity - 1, -1, -1))
 
@@ -319,11 +421,15 @@ class ModelBank:
                  loader=None, bulk_loader=None, host_capacity: int = 0,
                  filter_loader=None, epoch_loader=None,
                  serve_form: str = "auto",
-                 degrade_form_fallback: bool = True):
+                 degrade_form_fallback: bool = True,
+                 devices=None, shard_form: str = "auto",
+                 prefetch_depth: int = 0):
         if capacity < 1:
             raise ValueError("bank capacity must be >= 1")
         if host_capacity < 0:
             raise ValueError("host_capacity must be >= 0 (0 = unbounded)")
+        if prefetch_depth < 0:
+            raise ValueError("prefetch_depth must be >= 0 (0 = off)")
         self.capacity = capacity
         self.form = form
         # r15 serving-scan form (serving.serve_form): "xla" | "fused" |
@@ -337,7 +443,31 @@ class ModelBank:
         self.host_capacity = host_capacity
         self._models: OrderedDict[str, TenantModel] = OrderedDict()
         self._loader_backed: set[str] = set()
-        self._shards: dict[tuple[int, int, int], _Shard] = {}
+        # Shard key = (D_pad, V_pad, K, home-device index): the r20
+        # mesh placement just widens the pre-r20 shape-class key with
+        # the tenant-hash device axis (index 0 everywhere when the
+        # resolved form is "single").
+        self._shards: dict[tuple[int, int, int, int], _Shard] = {}
+        # r20 sharded placement. `devices` is the candidate mesh (a
+        # jax.devices() subset, order-significant: the crc32 hash
+        # indexes into it); None = the default device only. The form
+        # resolves LAZILY at first score (select_shard_form — the gate
+        # sees the registered-tenant count) and FREEZES: placement
+        # keys residency, so it must never flip mid-life.
+        self.devices = list(devices) if devices else None
+        self.shard_form = shard_form
+        self._resolved_shard: str | None = None
+        #: Shape keys whose compiled HLO passed the collective-free
+        #: scan (sharded mode asserts it once per compiled shape).
+        self.collective_checked: set[tuple] = set()
+        # r20 host-tier prefetcher: decayed per-tenant request counts
+        # (the Zipf demand estimate), the promote budget per batch
+        # boundary, and the promoted-but-not-yet-referenced set the
+        # hit/waste accounting keys on.
+        self.prefetch_depth = prefetch_depth
+        self._demand: dict[str, float] = {}
+        self._prefetched: set[str] = set()
+        self._demand_batches = 0
         # r13 feedback loop: per-tenant compiled noise filter
         # (onix/feedback/filter.HostFilter) + MODEL EPOCH. The epoch
         # bumps on every event that can change a tenant's winners —
@@ -541,7 +671,132 @@ class ModelBank:
             del self._models[t]
             self._loader_backed.discard(t)
             counters.inc("bank.host_evict")
+            if t in self._prefetched:
+                # Promoted ahead of demand, evicted before any request
+                # referenced it: the prefetcher's false positive.
+                self._prefetched.discard(t)
+                counters.inc("bank.prefetch_waste")
             n_backed -= 1
+
+    # -- host-RAM residency tier: demand-tracked prefetch (r20) -----------
+
+    def _note_demand(self, requests) -> None:
+        """Fold one request batch into the decayed per-tenant demand
+        counts — the Zipf estimate the prefetcher ranks promotion
+        candidates by. Halving every 32 batches (and dropping cold
+        entries) keeps the table a bounded sliding window rather than
+        an all-time popularity census that could never forget a
+        formerly-hot tenant."""
+        for req in requests:
+            self._demand[req.tenant] = self._demand.get(req.tenant, 0.) + 1.
+        self._demand_batches += 1
+        if self._demand_batches % 32 == 0:
+            self._demand = {t: v / 2 for t, v in self._demand.items()
+                            if v >= 0.5}
+
+    def _note_tiers(self, requests) -> None:
+        """Per-request residency-tier accounting, BEFORE the batch
+        mutates anything: hbm (device-resident), host (registry copy,
+        needs admission only), disk (absent — the bulk/bulk-miss
+        loaders will fetch it). The /bank/stats per-tier hit/miss
+        picture and the harness's per-tier latency classes both read
+        these counters."""
+        for req in requests:
+            t = req.tenant
+            if t not in self._models:
+                counters.inc("bank.tier_disk_load")
+            elif self.resident(t):
+                counters.inc("bank.tier_hbm_hit")
+                self._touch_prefetched(t)
+            else:
+                counters.inc("bank.tier_host_hit")
+                self._touch_prefetched(t)
+
+    def _touch_prefetched(self, tenant: str) -> None:
+        if tenant in self._prefetched:
+            self._prefetched.discard(tenant)
+            counters.inc("bank.prefetch_hit")
+
+    def prefetch(self, tenants: list[str]) -> int:
+        """Promote `tenants` from disk into the host-RAM tier in ONE
+        bulk pass (`bulk_loader` → checkpoint.load_models), ahead of
+        the demand the Zipf tracker predicts. Chaos site
+        `bank:prefetch` fires at ENTRY — before any registry, filter,
+        or epoch mutation — so the caller's bounded retry replays the
+        whole promotion safely. Returns tenants actually promoted
+        (absent-on-disk names are simply skipped: a prefetch is a
+        prediction, not a demand)."""
+        want = [t for t in tenants if t not in self._models]
+        if not want or self._bulk_loader is None:
+            return 0
+        with telemetry.TRACER.span("bank.prefetch", tenants=len(want)):
+            faults.fire("bank", "prefetch")
+            loaded = self._load_retried(f"prefetch of {len(want)} tenants",
+                                        lambda: self._bulk_loader(want))
+            for t, m in loaded.items():
+                self.add(t, m.theta, m.phi_wk, epoch=m.epoch)
+                self._loader_backed.add(t)
+                self._load_filter(t)
+                self._prefetched.add(t)
+                counters.inc("bank.prefetch_promoted")
+            self._trim_host_registry(keep=set(loaded))
+        return len(loaded)
+
+    def _maybe_prefetch(self) -> None:
+        """One prefetch pass at a request-batch boundary: promote up to
+        `prefetch_depth` of the hottest demanded-but-not-host-resident
+        tenants. BEST-EFFORT by contract — an injected fault is
+        absorbed by one bounded replay, and exhaustion (a second
+        injected fault, a dead filesystem) is counted and dropped,
+        never surfaced to the scoring path: losing a prefetch costs
+        latency on a later miss, failing a scored batch costs answers."""
+        if not self.prefetch_depth or self._bulk_loader is None:
+            return
+        hot = sorted(self._demand.items(), key=lambda kv: -kv[1])
+        cands = [t for t, _ in hot if t not in self._models]
+        cands = cands[:self.prefetch_depth]
+        if not cands:
+            return
+        counters.inc("bank.prefetch")
+        try:
+            retry_call(lambda strict: self.prefetch(cands),
+                       policy=_SERVE_RETRY, counter_prefix="bank.prefetch",
+                       retry_on=faults.InjectedFault)
+        except (faults.InjectedFault, BankRefusal):
+            counters.inc("bank.prefetch_failed")
+
+    def tier_stats(self) -> dict:
+        """The per-tier residency picture `/bank/stats` exposes: HBM
+        (shard slots), host RAM (registry copies), disk (loads), plus
+        the prefetcher's hit/waste accounting and the resolved
+        placement form."""
+        hbm_resident = sum(len(sh.lru) for sh in self._shards.values())
+        per_device: dict[str, int] = {}
+        for sh in self._shards.values():
+            key = f"d{sh.device_index}"
+            per_device[key] = per_device.get(key, 0) + len(sh.lru)
+        return {
+            "hbm": {"resident": hbm_resident,
+                    "capacity_per_class": self.capacity,
+                    "shape_classes": len(self._shards),
+                    "per_device_resident": per_device,
+                    "hits": counters.get("bank.tier_hbm_hit")},
+            "host": {"resident": len(self._models),
+                     "loader_backed": len(self._loader_backed),
+                     "capacity": self.host_capacity,
+                     "hits": counters.get("bank.tier_host_hit"),
+                     "evictions": counters.get("bank.host_evict")},
+            "disk": {"loads": counters.get("bank.tier_disk_load")},
+            "prefetch": {"depth": self.prefetch_depth,
+                         "passes": counters.get("bank.prefetch"),
+                         "promoted": counters.get("bank.prefetch_promoted"),
+                         "hits": counters.get("bank.prefetch_hit"),
+                         "waste": counters.get("bank.prefetch_waste"),
+                         "failed": counters.get("bank.prefetch_failed"),
+                         "tracked_tenants": len(self._demand)},
+            "shard_form": self._resolved_shard or "unresolved",
+            "n_devices": self.n_devices(),
+        }
 
     def tenants(self) -> list[str]:
         return sorted(self._models)
@@ -550,13 +805,43 @@ class ModelBank:
         return (pow2_bucket(m.n_docs, BANK_DOC_FLOOR),
                 pow2_bucket(m.n_vocab, BANK_VOCAB_FLOOR), m.n_topics)
 
+    # -- sharded placement (r20) ------------------------------------------
+
+    def n_devices(self) -> int:
+        return len(self.devices) if self.devices else 1
+
+    def shard_form_resolved(self) -> str:
+        """The frozen placement form. First call resolves through the
+        gate (env > explicit > measured > single) against the tenant
+        count registered AT THAT POINT — placement keys device
+        residency, so later registrations must not flip it."""
+        if self._resolved_shard is None:
+            self._resolved_shard = select_shard_form(
+                self.shard_form, n_tenants=len(self._models),
+                n_devices=self.n_devices())
+            counters.inc(f"bank.shard_form_{self._resolved_shard}")
+        return self._resolved_shard
+
+    def _home_index(self, tenant: str) -> int:
+        """The tenant's stable home-device index: crc32 placement, so
+        every process (and every serve replica) agrees without any
+        coordination state. Single form / one device ⇒ always 0."""
+        n = self.n_devices()
+        if n < 2 or self.shard_form_resolved() != "sharded":
+            return 0
+        return zlib.crc32(tenant.encode()) % n
+
+    def _device_at(self, index: int):
+        return self.devices[index] if self.devices else None
+
     # -- residency --------------------------------------------------------
 
     def resident(self, tenant: str) -> bool:
         m = self._models.get(tenant)
         if m is None:
             return False
-        shard = self._shards.get(self._class_of(m))
+        shard = self._shards.get(self._class_of(m)
+                                 + (self._home_index(tenant),))
         return shard is not None and tenant in shard.lru
 
     def _ensure_resident(self, shard: _Shard, needed: list[str]) -> None:
@@ -608,8 +893,11 @@ class ModelBank:
             slots[i] = shard.free.pop()
             shard.lru[t] = int(slots[i])
             counters.inc("bank.admit")
-        th_d = jax.device_put(th)
-        ph_d = jax.device_put(ph)
+        # device=None (single form) keeps jax's default placement —
+        # the pre-r20 shape; a sharded shard stages straight onto the
+        # wave's home device, still ONE transfer per table family.
+        th_d = jax.device_put(th, shard.device)
+        ph_d = jax.device_put(ph, shard.device)
         counters.inc("bank.h2d_transfers", 2)
         counters.inc("bank.h2d_bytes", th.nbytes + ph.nbytes)
         idx = jnp.asarray(slots)
@@ -640,6 +928,11 @@ class ModelBank:
         and split into residency-capacity waves; each wave is ONE
         jitted dispatch (the N→1 collapse the bank exists for)."""
         out: list[TopK | None] = [None] * len(requests)
+        # Tier + demand accounting first, BEFORE the bulk load mutates
+        # the registry — "which tier answered this request" is a
+        # property of the bank's state at receipt.
+        self._note_tiers(requests)
+        self._note_demand(requests)
         if self._bulk_loader is not None:
             # Fetch the batch's unknown tenants in ONE host-side pass
             # (checkpoint.load_models) instead of per-tenant loader
@@ -659,21 +952,55 @@ class ModelBank:
                     self._load_filter(t)
                 self._trim_host_registry(
                     keep={req.tenant for req in requests})
-        by_class: dict[tuple, list[int]] = {}
+        # Group by (shape class, home device): the r20 placement axis
+        # rides the same grouping the shape ladder always used. With
+        # the single form every home index is 0 — the pre-r20 shape.
+        by_group: dict[tuple, list[int]] = {}
         for i, req in enumerate(requests):
             m = self.model(req.tenant)
             self._validate(req, m)
-            by_class.setdefault(self._class_of(m), []).append(i)
-        for key, idxs in by_class.items():
+            key = self._class_of(m) + (self._home_index(req.tenant),)
+            by_group.setdefault(key, []).append(i)
+        sharded = self.shard_form_resolved() == "sharded" \
+            and self.n_devices() > 1
+        pending: list[tuple[TopK, list[int]]] = []
+        for key, idxs in by_group.items():
             shard = self._shards.get(key)
             if shard is None:
-                shard = self._shards[key] = _Shard(*key, self.capacity)
+                shard = self._shards[key] = _Shard(
+                    *key[:3], self.capacity,
+                    device=self._device_at(key[3]), device_index=key[3])
             for wave in self._waves(requests, idxs, shard.capacity):
-                self._score_wave(shard, requests, wave, out, tol=tol,
-                                 max_results=max_results)
+                if sharded:
+                    # Dispatch phase: launch the wave's independent
+                    # device program and move on — jax dispatch is
+                    # async, so waves routed to different devices
+                    # overlap; the winner fetches drain afterwards.
+                    with telemetry.TRACER.span("bank.wave",
+                                               device=key[3],
+                                               requests=len(wave)):
+                        res = self._dispatch_wave(shard, requests, wave,
+                                                  tol=tol,
+                                                  max_results=max_results)
+                    counters.inc(f"bank.wave.d{key[3]}")
+                    pending.append((res, wave))
+                else:
+                    self._score_wave(shard, requests, wave, out, tol=tol,
+                                     max_results=max_results)
+        for res, wave in pending:
+            # Fetch phase (sharded): drain in dispatch order; the wall
+            # spent blocked here is the cross-device stall the
+            # artifact's accounting reports.
+            t_fetch = time.perf_counter()
+            self._fetch_wave(res, wave, out)
+            counters.inc("bank.fetch_wait_us",
+                         int((time.perf_counter() - t_fetch) * 1e6))
         # Device eviction above may have freed host copies for trimming
         # (request-batch boundary — same place residency may change).
         self._trim_host_registry()
+        # Prefetch at the batch boundary: promote predicted-hot tenants
+        # into the host tier so the NEXT batch's misses start warm.
+        self._maybe_prefetch()
         return out  # type: ignore[return-value]
 
     @staticmethod
@@ -729,8 +1056,14 @@ class ModelBank:
                             pair_boost=fam_rows("pair_boost"),
                             boost_scale=jnp.asarray(scale))
 
-    def _score_wave(self, shard: _Shard, requests, wave: list[int],
-                    out: list, *, tol: float, max_results: int) -> None:
+    def _prepare_wave(self, shard: _Shard, requests, wave: list[int], *,
+                      tol: float, max_results: int):
+        """Admission + host-side staging for one wave: returns the
+        kernel args plus the resolved (form, serve) pair and the shape
+        key. Shared verbatim by the single-device path (_score_wave)
+        and the sharded dispatch phase (_dispatch_wave) — the
+        bit-identity argument between the two is that everything
+        except the device the program runs on comes from here."""
         needed: list[str] = []
         for i in wave:
             if requests[i].tenant not in needed:
@@ -785,37 +1118,83 @@ class ModelBank:
         args = (shard.theta, shard.phi, jnp.asarray(slots), jnp.asarray(d),
                 jnp.asarray(w), jnp.asarray(m), jnp.float32(tol),
                 filt_rows)
-        # The dispatch span: one wave = one batched program + ONE
-        # winner fetch — the latency building block every serve-side
-        # quantile decomposes into. Attrs carry the resolved forms so
-        # a slow trace names the arm that compiled, not the request.
+        return args, form, serve, shape_key, r, sum(n_events)
+
+    def _launch(self, args, form: str, serve: str, shape_key: tuple, *,
+                max_results: int) -> TopK:
+        """One wave's kernel call (device-side result — the caller
+        fetches) behind the r16 degradation ladder."""
+        try:
+            res = _bank_kernel_for(form, serve)(
+                *args, max_results=max_results)
+        except Exception:                   # noqa: BLE001 — the
+            # degradation ladder's first rung: a fused-kernel
+            # failure (Mosaic lowering, VMEM overflow, injected
+            # chaos) falls back to the bit-identical xla kernels —
+            # same winners by the r15 identity contract — instead
+            # of failing the wave. Counted + stamped degraded
+            # upstream; never silent.
+            if serve != "fused" or not self.degrade_form_fallback:
+                raise
+            counters.inc("serve.form_fallback")
+            self.fallback_dispatches += 1
+            self.compiled_shapes.add(shape_key[:1] + ("xla",)
+                                     + shape_key[2:])
+            res = _bank_kernel_for(form, "xla")(
+                *args, max_results=max_results)
+        self.dispatches += 1
+        counters.inc("bank.dispatch")
+        return res
+
+    def _score_wave(self, shard: _Shard, requests, wave: list[int],
+                    out: list, *, tol: float, max_results: int) -> None:
+        """The single-device wave: prepare + launch + fetch, all under
+        the pre-r20 `bank.score_wave` span (one batched program + ONE
+        winner fetch — the latency building block every serve-side
+        quantile decomposes into; attrs carry the resolved forms so a
+        slow trace names the arm that compiled, not the request)."""
+        args, form, serve, shape_key, r, events = self._prepare_wave(
+            shard, requests, wave, tol=tol, max_results=max_results)
         with telemetry.TRACER.span("bank.score_wave", form=form,
                                    serve=serve, requests=r,
-                                   events=sum(n_events)):
-            try:
-                res = _bank_kernel_for(form, serve)(
-                    *args, max_results=max_results)
-            except Exception:                   # noqa: BLE001 — the
-                # degradation ladder's first rung: a fused-kernel
-                # failure (Mosaic lowering, VMEM overflow, injected
-                # chaos) falls back to the bit-identical xla kernels —
-                # same winners by the r15 identity contract — instead
-                # of failing the wave. Counted + stamped degraded
-                # upstream; never silent.
-                if serve != "fused" or not self.degrade_form_fallback:
-                    raise
-                counters.inc("serve.form_fallback")
-                self.fallback_dispatches += 1
-                self.compiled_shapes.add(shape_key[:1] + ("xla",)
-                                         + shape_key[2:])
-                res = _bank_kernel_for(form, "xla")(
-                    *args, max_results=max_results)
-            self.dispatches += 1
-            counters.inc("bank.dispatch")
+                                   events=events):
+            res = self._launch(args, form, serve, shape_key,
+                               max_results=max_results)
             counters.inc("bank.requests", r)
-            counters.inc("bank.events", sum(n_events))
-            scores = np.asarray(res.scores)    # ONE fetch per dispatch
-            indices = np.asarray(res.indices)
+            counters.inc("bank.events", events)
+            self._fetch_wave(res, wave, out)
+
+    def _dispatch_wave(self, shard: _Shard, requests, wave: list[int], *,
+                       tol: float, max_results: int) -> TopK:
+        """The sharded dispatch phase: prepare + launch WITHOUT the
+        fetch — jax's async dispatch returns as soon as the program is
+        enqueued on the wave's home device, so the caller can launch
+        the next device's wave before this one drains. The first
+        launch of every shape also proves the psum-free claim: the
+        compiled HLO is scanned for cross-device collectives
+        (`assert_collective_free`), once per shape key."""
+        args, form, serve, shape_key, r, events = self._prepare_wave(
+            shard, requests, wave, tol=tol, max_results=max_results)
+        if shape_key not in self.collective_checked:
+            kernel = _bank_kernel_for(form, serve)
+            # The fused arm is a pallas partial without .lower(); its
+            # collective-freedom follows from the xla twin it falls
+            # back to (same args, same single-device placement).
+            if hasattr(kernel, "lower"):
+                assert_collective_free(kernel, args,
+                                       max_results=max_results)
+                counters.inc("bank.collective_checks")
+            self.collective_checked.add(shape_key)
+        res = self._launch(args, form, serve, shape_key,
+                           max_results=max_results)
+        counters.inc("bank.requests", r)
+        counters.inc("bank.events", events)
+        return res
+
+    @staticmethod
+    def _fetch_wave(res: TopK, wave: list[int], out: list) -> None:
+        scores = np.asarray(res.scores)        # ONE fetch per dispatch
+        indices = np.asarray(res.indices)
         for row, i in enumerate(wave):
             out[i] = TopK(scores=scores[row], indices=indices[row])
 

@@ -92,7 +92,9 @@ from onix.utils.obs import counters
 #: (generated section `span-registry`).
 SPAN_REGISTRY: dict[str, str] = {
     "bank.admit": "ModelBank._ensure_resident: one wave's residency admission (LRU + H2D staging)",
-    "bank.score_wave": "one batched bank dispatch: kernel call + winner fetch for one wave",
+    "bank.prefetch": "ModelBank.prefetch: one bulk host-tier promotion pass (Zipf-predicted tenants, disk -> host RAM)",
+    "bank.score_wave": "one batched bank dispatch: kernel call + winner fetch for one wave (single-device path)",
+    "bank.wave": "sharded bank: one per-device wave's admission + async program launch (fetch drains later)",
     "campaign.fit": "campaign orchestrator: one datatype's device fit (retries included)",
     "campaign.oa": "campaign orchestrator: one datatype's OA stage",
     "campaign.prepare": "campaign orchestrator: one datatype's host prepare (synth -> words -> corpus)",

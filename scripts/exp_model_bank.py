@@ -34,9 +34,27 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import json
+import os
 import pathlib
 import sys
 import time
+
+import jax
+
+# Force CPU via BOTH the env and the live config, with an 8-device
+# virtual mesh so the r20 shard ladder (dp=1/2/4) is a real multi-
+# device placement on this host (same trap + same fix as
+# tests/conftest.py and exp_campaign.py: the ambient sitecustomize may
+# import jax before this script runs). ONIX_BANK_TPU=1 keeps the
+# ambient backend — the TPU-queue spelling (docs/TPU_QUEUE.json
+# `bank_sharded_tpu`).
+if os.environ.get("ONIX_BANK_TPU") != "1":
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
@@ -72,6 +90,16 @@ def main(argv: list[str] | None = None) -> int:
                     help="run the r16 overload SLO cell (shed + bounded "
                          "p99 proof, docs/ROBUSTNESS.md 'serving "
                          "resilience') and embed its artifact")
+    ap.add_argument("--shard-cell", default="1,2,4",
+                    help="comma list of mesh sizes for the r20 shard "
+                         "ladder — single vs dp virtual devices, parity "
+                         "asserted ('' skips)")
+    ap.add_argument("--replicas", type=int, default=2,
+                    help="replica count for the r20 multi-replica "
+                         "replay (<=1 skips)")
+    ap.add_argument("--prefetch-depth", type=int, default=4,
+                    help="host-tier prefetcher budget for the r20 "
+                         "tier replay (0 skips the tier section)")
     ap.add_argument("--out", default=None)
     args = ap.parse_args(argv)
 
@@ -199,6 +227,128 @@ def main(argv: list[str] | None = None) -> int:
         rows.append(row)
     if rows:
         doc["bank_size_ladder"] = rows
+
+    # -- r20 shard ladder: single device vs dp=2/4 virtual meshes ---------
+    # Same stream, same kernels; the ONLY change is tenant-hash
+    # placement across the mesh and the per-device wave split. Parity
+    # is asserted bit-identical across every mesh size (against dp=1,
+    # itself parity-checked against the sequential oracle above), and
+    # the compiled HLO collective-free check runs inside the bank on
+    # every sharded shape.
+    shard_sizes = [int(x) for x in args.shard_cell.split(",")
+                   if x.strip()]
+    if shard_sizes:
+        n_dev = len(jax.devices())
+        usable = [d for d in shard_sizes if d <= n_dev]
+        dropped = [d for d in shard_sizes if d > n_dev]
+        if dropped:
+            # No silent caps: a 2-device TPU host drops the dp=4 rung
+            # and the artifact says so.
+            print(f"shard ladder: dropping mesh sizes {dropped} "
+                  f"(host exposes {n_dev} devices)", file=sys.stderr)
+        sserv = {}
+        for dp in usable:
+            sspec = dataclasses.replace(
+                spec, devices=dp,
+                shard_form="sharded" if dp > 1 else "single")
+            sserv[dp] = lh.build_service(sspec, models, form=best_form)
+        sbest = {dp: float("inf") for dp in usable}
+        sruns: dict[int, dict] = {}
+        for rep in range(max(args.reps, 1) + 1):
+            for dp in usable:                   # interleaved best-of
+                # Wave counters are process-global: the per-pass delta
+                # must bracket THIS replay (the rungs share devices).
+                wb = dict(counters.snapshot("bank"))
+                r = lh.replay(sserv[dp], stream, tol=spec.tol,
+                              max_results=spec.max_results)
+                r["wave_dispatches_pass"] = {
+                    k: v - wb.get(k, 0)
+                    for k, v in counters.snapshot("bank").items()
+                    if k.startswith("bank.wave.d")
+                    and v - wb.get(k, 0)}
+                sruns[dp] = r
+                if rep > 0:
+                    sbest[dp] = min(sbest[dp], r["wall_s"])
+        ref = sruns[usable[0]]
+        rows = []
+        for dp in usable:
+            r = sruns[dp]
+            for i, (a, b) in enumerate(zip(ref["results"],
+                                           r["results"])):
+                if not (np.array_equal(a.topk.scores, b.topk.scores)
+                        and np.array_equal(a.topk.indices,
+                                           b.topk.indices)):
+                    raise AssertionError(
+                        f"dp={dp} request {i}: sharded winners "
+                        "diverged from the single-device bank")
+            bank = sserv[dp].bank
+            rows.append({
+                "devices": dp,
+                "shard_form": bank.shard_form_resolved(),
+                "events_per_sec": round(n_events / sbest[dp], 1),
+                "wall_s_best": round(sbest[dp], 4),
+                "dispatches_per_pass": r["dispatches"],
+                "wave_dispatches": r["wave_dispatches_pass"],
+                "fetch_wait_us_last_pass": r["fetch_wait_us"],
+                "collective_free_shapes_checked":
+                    len(bank.collective_checked),
+            })
+        doc["shard_ladder"] = {
+            "rows": rows,
+            "parity_bit_identical_across_meshes": True,
+            "collective_free_asserted": any(
+                row["devices"] > 1
+                and row["collective_free_shapes_checked"] > 0
+                for row in rows),
+            "dropped_mesh_sizes": dropped,
+            "note": ("virtual CPU devices share this host's cores — "
+                     "wall-clock ranks placement overhead only; the "
+                     "chip decision is docs/TPU_QUEUE.json "
+                     "bank_sharded_tpu"),
+        }
+
+    # -- r20 residency-tier replay: disk -> host RAM -> HBM ---------------
+    # Loader-backed tenants under a tight device cap and a bounded host
+    # registry, cold pass then warm pass: the per-tier p50/p99 and the
+    # Zipf prefetch hit-rate the tier exists to buy.
+    if args.prefetch_depth > 0:
+        tier_spec = dataclasses.replace(
+            spec, n_windows=0, capacity=max(2, args.tenants // 8),
+            devices=min(2, len(jax.devices())),
+            shard_form="sharded" if len(jax.devices()) > 1 else "auto",
+            host_capacity=max(4, args.tenants // 2),
+            prefetch_depth=args.prefetch_depth)
+        tserv = lh.build_service(tier_spec, models, form=best_form)
+        strip = lambda r: {k: v for k, v in r.items()  # noqa: E731
+                           if k not in ("results", "raw_latencies")}
+        cold = lh.replay(tserv, stream, tol=spec.tol,
+                         max_results=spec.max_results)
+        warm = lh.replay(tserv, stream, tol=spec.tol,
+                         max_results=spec.max_results)
+        doc["tier_replay"] = {
+            "capacity": tier_spec.capacity,
+            "host_capacity": tier_spec.host_capacity,
+            "prefetch_depth": tier_spec.prefetch_depth,
+            "devices": tier_spec.devices,
+            "cold": strip(cold), "warm": strip(warm),
+            "tier_stats": tserv.bank.tier_stats(),
+        }
+
+    # -- r20 multi-replica replay: N services behind one front -----------
+    if args.replicas > 1:
+        rep_spec = dataclasses.replace(spec, replicas=args.replicas)
+        rserv = lh.build_service(rep_spec, models, form=best_form)
+        rrun = lh.replay(rserv, stream, tol=spec.tol,
+                         max_results=spec.max_results)
+        lh.assert_parity(rrun, seq_res)     # routing changes nothing
+        doc["replica_replay"] = {
+            "replicas": args.replicas,
+            "parity_bit_identical": True,
+            "events_per_sec": rrun["events_per_sec"],
+            "latency_p50_ms": rrun["latency_p50_ms"],
+            "latency_p99_ms": rrun["latency_p99_ms"],
+            "admission": rrun["admission"],
+        }
 
     # -- overload SLO cell: shed + bounded-p99 proof (r16) ----------------
     if args.overload_cell:

@@ -1,0 +1,299 @@
+"""Mesh-sharded model bank + host-RAM residency tier (r20, ISSUE 17).
+
+The contract: tenant-hash placement over a dp mesh changes WHERE a
+tenant's tables live and WHICH device its wave dispatches on — never
+what it answers. Winners are bit-identical to the single-device bank
+at every mesh size (conftest exposes 8 virtual CPU devices), every
+sharded wave's compiled HLO is collective-free by machine check, the
+shard gate rides the one resolve_form_gate precedence chain, and the
+disk → host-RAM → HBM tier ladder (bounded host registry + Zipf
+prefetcher) preserves the capped==uncapped winner identity the r12
+LRU proof established one tier down.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+
+from onix.serving import load_harness as lh
+from onix.serving.model_bank import (ModelBank, ScoreRequest, TenantModel,
+                                     assert_collective_free,
+                                     select_shard_form)
+from onix.utils import faults
+from onix.utils.obs import counters
+
+TOL, M = 1.0, 16
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    monkeypatch.delenv("ONIX_BANK_SHARD", raising=False)
+    monkeypatch.delenv("ONIX_FAULT_PLAN", raising=False)
+    faults.reset()
+    counters.reset()
+    yield
+    faults.reset()
+    counters.reset()
+
+
+def _spec(**kw):
+    base = dict(n_tenants=12, n_docs=96, n_vocab=64, n_topics=6,
+                n_requests=30, events_per_request=64, n_windows=2,
+                batch_requests=6, seed=3)
+    base.update(kw)
+    return lh.HarnessSpec(**base)
+
+
+def _winners(run):
+    return [(np.asarray(r.topk.scores), np.asarray(r.topk.indices))
+            for r in run["results"]]
+
+
+def _assert_same_winners(a, b, label):
+    for i, ((sa, ia), (sb, ib)) in enumerate(zip(a, b)):
+        np.testing.assert_array_equal(sa, sb, err_msg=f"{label} req {i}")
+        np.testing.assert_array_equal(ia, ib, err_msg=f"{label} req {i}")
+
+
+# -- placement: dp ladder bit-identity ----------------------------------
+
+
+def test_sharded_winners_bit_identical_dp_ladder():
+    """The acceptance bar: dp=1 / dp=2 / dp=4 meshes over the same
+    stream produce bit-identical winners (scores AND indices), with
+    the sharded rungs actually dispatching per-device waves."""
+    assert len(jax.devices()) >= 4, "conftest should expose 8 devices"
+    spec = _spec()
+    models = lh.make_tenants(spec)
+    stream = lh.make_stream(spec)
+    ref = lh.replay(lh.build_service(spec, models), stream,
+                    tol=TOL, max_results=M)
+    ref_w = _winners(ref)
+    for dp in (1, 2, 4):
+        sspec = dataclasses.replace(
+            spec, devices=dp,
+            shard_form="sharded" if dp > 1 else "single")
+        svc = lh.build_service(sspec, models)
+        run = lh.replay(svc, stream, tol=TOL, max_results=M)
+        _assert_same_winners(ref_w, _winners(run), f"dp={dp}")
+        form = svc.bank.shard_form_resolved()
+        assert form == ("sharded" if dp > 1 else "single")
+        if dp > 1:
+            # Per-device waves really ran, across >1 home device...
+            waves = {k: v for k, v in counters.snapshot("bank").items()
+                     if k.startswith("bank.wave.d")}
+            assert sum(waves.values()) > 0
+            # ...and every compiled sharded shape passed the
+            # collective-free HLO scan.
+            assert len(svc.bank.collective_checked) > 0
+            assert counters.get("bank.collective_checks") > 0
+
+
+def test_sharded_tenants_spread_across_devices():
+    spec = _spec(devices=4, shard_form="sharded", n_tenants=16)
+    models = lh.make_tenants(spec)
+    stream = lh.make_stream(spec)
+    svc = lh.build_service(spec, models)
+    lh.replay(svc, stream, tol=TOL, max_results=M)
+    per_dev = svc.bank.tier_stats()["hbm"]["per_device_resident"]
+    assert len(per_dev) >= 2, f"all tenants landed on one device: {per_dev}"
+    assert sum(per_dev.values()) == sum(
+        len(sh.lru) for sh in svc.bank._shards.values())
+
+
+def test_home_index_stable_across_banks():
+    """crc32 placement is a pure function of the tenant name — two
+    banks (two replicas, two processes) agree with no coordination."""
+    spec = _spec(devices=4, shard_form="sharded")
+    models = lh.make_tenants(spec)
+    a = lh.build_service(spec, models).bank
+    b = lh.build_service(spec, models).bank
+    for t in models:
+        assert a._home_index(t) == b._home_index(t)
+
+
+# -- the gate -----------------------------------------------------------
+
+
+def test_shard_gate_default_single_table_empty():
+    """The r15 discipline: the measured table ships EMPTY, so auto
+    resolves single-device everywhere until the queued TPU crossover
+    lands — even with a mesh and many tenants."""
+    assert select_shard_form("auto", n_tenants=10_000, n_devices=8) \
+        == "single"
+    assert select_shard_form("", n_tenants=10_000, n_devices=8) \
+        == "single"
+
+
+def test_shard_gate_explicit_and_env(monkeypatch):
+    assert select_shard_form("sharded", 4, 2) == "sharded"
+    assert select_shard_form("single", 4, 2) == "single"
+    monkeypatch.setenv("ONIX_BANK_SHARD", "sharded")
+    assert select_shard_form("single", 4, 2) == "sharded"   # env wins
+    monkeypatch.setenv("ONIX_BANK_SHARD", "bogus")
+    with pytest.raises(ValueError, match="env override"):
+        select_shard_form("auto", 4, 2)
+
+
+def test_shard_gate_typo_raises():
+    with pytest.raises(ValueError, match="bank shard"):
+        select_shard_form("shardedd", 4, 2)
+
+
+def test_shard_form_freezes_at_first_score():
+    """Placement keys device residency: the resolved form must never
+    flip mid-life, however many tenants register later."""
+    spec = _spec(devices=2, shard_form="sharded")
+    models = lh.make_tenants(spec)
+    svc = lh.build_service(spec, models)
+    lh.replay(svc, lh.make_stream(spec), tol=TOL, max_results=M)
+    assert svc.bank.shard_form_resolved() == "sharded"
+    svc.bank.shard_form = "single"          # config flip after the fact
+    assert svc.bank.shard_form_resolved() == "sharded"  # frozen
+
+
+# -- collective-free HLO check ------------------------------------------
+
+
+def test_assert_collective_free_catches_collectives():
+    """The scanner itself: a compiled text naming a collective fails
+    the assert with the marker in the message."""
+    class _Lowered:
+        def compile(self):
+            return self
+
+        def as_text(self):
+            return "fusion ... all-reduce(f32[8]{0} %x) ..."
+
+    class _Kernel:
+        def lower(self, *a, **k):
+            return _Lowered()
+
+    with pytest.raises(AssertionError, match="all-reduce"):
+        assert_collective_free(_Kernel(), (), max_results=M)
+
+
+def test_sharded_dispatch_hlo_is_collective_free():
+    """The in-path check: every sharded shape compiled during a real
+    replay passed (score_batch would have raised otherwise), and the
+    check ran once per shape, not per wave."""
+    spec = _spec(devices=2, shard_form="sharded")
+    svc = lh.build_service(spec, lh.make_tenants(spec))
+    stream = lh.make_stream(spec)
+    lh.replay(svc, stream, tol=TOL, max_results=M)
+    checks = counters.get("bank.collective_checks")
+    assert checks == len(svc.bank.collective_checked) > 0
+    lh.replay(svc, stream, tol=TOL, max_results=M)   # same shapes
+    assert counters.get("bank.collective_checks") == checks
+
+
+# -- host-RAM residency tier --------------------------------------------
+
+
+def test_three_tier_lru_preserves_winner_identity():
+    """The satellite bar: promote/demote across disk → host RAM → HBM
+    (tight device cap + bounded host registry + prefetcher) preserves
+    winners bit-identical to the all-resident uncapped run — the r12
+    residency-identity assert, one tier up."""
+    spec = _spec(n_tenants=10, n_requests=40)
+    models = lh.make_tenants(spec)
+    stream = lh.make_stream(spec)
+    uncapped = lh.replay(lh.build_service(spec, models), stream,
+                         tol=TOL, max_results=M)
+    tiered_spec = dataclasses.replace(
+        spec, capacity=3, host_capacity=5,
+        prefetch_depth=2, devices=2, shard_form="sharded")
+    tiered_svc = lh.build_service(tiered_spec, models)
+    tiered = lh.replay(tiered_svc, stream, tol=TOL, max_results=M)
+    lh.assert_residency_identity(tiered, uncapped)
+    # The ladder actually exercised every tier.
+    assert counters.get("bank.tier_disk_load") > 0
+    assert counters.get("bank.evict") > 0            # device demotions
+    stats = tiered_svc.bank.tier_stats()
+    assert stats["host"]["capacity"] == 5
+    assert stats["hbm"]["capacity_per_class"] == 3
+
+
+def test_prefetch_promotes_predicted_hot_tenants():
+    """Zipf demand tracking: after enough batches, hot non-resident
+    tenants get promoted into the host tier at batch boundaries, and
+    a promoted tenant's next reference counts a prefetch hit."""
+    rng = np.random.default_rng(0)
+    n_docs, n_vocab, k = 64, 48, 4
+    models = {f"t{i}": (
+        rng.dirichlet(np.full(k, 0.5), n_docs).astype(np.float32),
+        rng.dirichlet(np.full(k, 0.5), n_vocab).astype(np.float32))
+        for i in range(6)}
+    bank = ModelBank(
+        capacity=2, host_capacity=3, prefetch_depth=2,
+        loader=lambda t: None if t not in models
+        else TenantModel(*models[t]),
+        bulk_loader=lambda names: {t: TenantModel(*models[t])
+                                   for t in names if t in models})
+
+    def req(t):
+        return ScoreRequest(
+            tenant=t, doc_ids=rng.integers(0, n_docs, 32).astype(np.int32),
+            word_ids=rng.integers(0, n_vocab, 32).astype(np.int32))
+
+    # Hot tenants t0/t1 recur; the host tier only fits 3 so cold ones
+    # churn through. Each score_batch ends with a prefetch pass.
+    for _ in range(4):
+        bank.score_batch([req("t0"), req("t1")], tol=TOL, max_results=M)
+        bank.score_batch([req("t4"), req("t5")], tol=TOL, max_results=M)
+    assert counters.get("bank.prefetch_promoted") > 0
+    assert counters.get("bank.prefetch") > 0
+    stats = bank.tier_stats()
+    assert stats["prefetch"]["depth"] == 2
+    assert stats["prefetch"]["passes"] > 0
+
+
+def test_prefetch_fault_absorbed_and_best_effort():
+    """Chaos site `bank:prefetch` fires at entry (pre-mutation): one
+    injected fault is absorbed by the bounded retry; a fault that
+    exhausts the retry only costs the promotion (`bank.prefetch_failed`)
+    — winners identical to the fault-free run either way."""
+    spec = _spec(n_tenants=8, n_requests=32, capacity=2,
+                 host_capacity=4, prefetch_depth=2)
+    models = lh.make_tenants(spec)
+    stream = lh.make_stream(spec)
+    clean = lh.replay(lh.build_service(spec, models), stream,
+                      tol=TOL, max_results=M)
+    clean_w = _winners(clean)
+
+    # One-shot fault: absorbed by the retry, promotion still lands.
+    faults.install_plan("bank:prefetch@1=raise")
+    one = lh.replay(lh.build_service(spec, models), stream,
+                    tol=TOL, max_results=M)
+    _assert_same_winners(clean_w, _winners(one), "one-shot fault")
+    assert counters.get("bank.prefetch.retries") >= 1 \
+        or counters.get("bank.prefetch_failed") == 0
+    faults.reset()
+
+    # Every prefetch call faults (each rule has its own counter, so a
+    # stack of @1 rules fires on consecutive calls): the bounded retry
+    # exhausts, the promotion is lost, scoring never notices.
+    faults.install_plan(",".join(
+        "bank:prefetch@1=raise" for _ in range(40)))
+    dead = lh.replay(lh.build_service(spec, models), stream,
+                     tol=TOL, max_results=M)
+    _assert_same_winners(clean_w, _winners(dead), "dead prefetcher")
+    assert counters.get("bank.prefetch_failed") > 0
+
+
+def test_prefetch_api_direct():
+    """ModelBank.prefetch: one bulk promotion pass — loads through the
+    bulk loader into the host tier without touching device residency."""
+    spec = _spec(n_tenants=6, host_capacity=6, prefetch_depth=2)
+    models = lh.make_tenants(spec)
+    svc = lh.build_service(spec, models)
+    bank = svc.bank
+    n = bank.prefetch(["t0000", "t0001"])
+    assert n == 2
+    assert counters.get("bank.prefetch_promoted") == 2
+    assert "t0000" in bank._models and not bank.resident("t0000")
+    # Unknown names are skipped, not fatal (best-effort tier).
+    assert bank.prefetch(["nope"]) == 0

@@ -25,6 +25,17 @@ def test_invalid_values_rejected():
         from_dict({"serving": {"max_queue_depth": -1}})
     with pytest.raises(ValueError):
         from_dict({"serving": {"request_deadline_ms": -5}})
+    # r20 scale-out knobs: shard form, host-tier prefetch, replicas.
+    with pytest.raises(ValueError):
+        from_dict({"serving": {"bank_shard": "shardedd"}})
+    with pytest.raises(ValueError):
+        from_dict({"serving": {"prefetch_depth": -1}})
+    with pytest.raises(ValueError):
+        from_dict({"serving": {"replicas": 0}})
+    cfg = from_dict({"serving": {"bank_shard": "sharded",
+                                 "prefetch_depth": 4, "replicas": 2}})
+    assert cfg.serving.bank_shard == "sharded"
+    assert cfg.serving.prefetch_depth == 4 and cfg.serving.replicas == 2
 
 
 def test_daily_knobs_validate():

@@ -1,0 +1,399 @@
+"""Multi-replica serving front (r20, ISSUE 17): routing parity,
+epoch-bulletin propagation, failover, and the chaos cell.
+
+The contract under test: N replicas behind one `ReplicaFront` change
+WHERE a tenant's requests land — never what they answer, and never
+whether an out-of-band epoch bump reaches the tenant's next score.
+Propagation is structural, not best-effort: the bulletin replay in
+`submit` applies pending installs BEFORE dispatch, so even a replica
+that missed the eager install (racing publish, failover re-route)
+can't serve pre-bump winners.
+"""
+
+import http.client
+import json
+import zlib
+
+import numpy as np
+import pytest
+
+from onix.checkpoint import load_model, model_meta_epoch, save_model
+from onix.feedback.filter import HostFilter
+from onix.serving import load_harness as lh
+from onix.serving import replicas as rp
+from onix.serving.model_bank import (BankService, ModelBank, ScoreRequest,
+                                     TenantModel)
+from onix.utils import faults
+from onix.utils.obs import counters
+
+TOL, M = 1.0, 16
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    monkeypatch.delenv("ONIX_FAULT_PLAN", raising=False)
+    faults.reset()
+    counters.reset()
+    yield
+    faults.reset()
+    counters.reset()
+
+
+def _spec(**kw):
+    base = dict(n_tenants=12, n_docs=96, n_vocab=64, n_topics=6,
+                n_requests=30, events_per_request=64, n_windows=2,
+                batch_requests=6, seed=7)
+    base.update(kw)
+    return lh.HarnessSpec(**base)
+
+
+def _winners(run):
+    return [(np.asarray(r.topk.scores), np.asarray(r.topk.indices))
+            for r in run["results"]]
+
+
+def _assert_same_winners(a, b, label):
+    assert len(a) == len(b)
+    for i, ((sa, ia), (sb, ib)) in enumerate(zip(a, b)):
+        np.testing.assert_array_equal(sa, sb, err_msg=f"{label} req {i}")
+        np.testing.assert_array_equal(ia, ib, err_msg=f"{label} req {i}")
+
+
+def _filt(key: int) -> HostFilter:
+    return HostFilter.empty().merged(
+        word_suppress=np.array([key], np.uint64))
+
+
+# -- routing ------------------------------------------------------------
+
+
+def test_front_parity_and_order_vs_single_service():
+    """Replicated replay returns winners bit-identical to the single
+    service, in request order, with both replicas actually scoring."""
+    spec = _spec()
+    models = lh.make_tenants(spec)
+    stream = lh.make_stream(spec)
+    single = lh.replay(lh.build_service(spec, models), stream,
+                       tol=TOL, max_results=M)
+    front = lh.build_service(_spec(replicas=2), models)
+    assert isinstance(front, rp.ReplicaFront)
+    run = lh.replay(front, stream, tol=TOL, max_results=M)
+    _assert_same_winners(_winners(single), _winners(run), "replicas=2")
+    # The hash really spreads tenants: each replica scored something.
+    assert all(s.bank.dispatches > 0 for s in front.replicas)
+    # Duck-typed stats surface the serve layer reads.
+    astats = front.admission_stats()
+    assert astats["replicas"] == 2 and astats["replicas_alive"] == 2
+    assert front.cache_stats()["entries"] == sum(
+        len(s._cache) for s in front.replicas)
+    tstats = front.tier_stats()
+    assert set(tstats["per_replica"]) == {"r0", "r1"}
+
+
+def test_home_is_pure_and_walks_past_down_replicas():
+    spec = _spec(replicas=3)
+    models = lh.make_tenants(spec)
+    a = lh.build_service(spec, models)
+    b = lh.build_service(spec, models)
+    homes = {t: a.home(t) for t in models}
+    assert homes == {t: b.home(t) for t in models}   # coordination-free
+    assert len(set(homes.values())) > 1              # actually spreads
+    victim = next(iter(homes.values()))
+    a.mark_down(victim)
+    assert counters.get("serve.replica_down") == 1
+    assert a.n_alive() == 2 and victim not in a.alive_indices()
+    for t in models:
+        assert a.home(t) != victim
+        if homes[t] != victim:                       # survivors keep homes
+            assert a.home(t) == homes[t]
+
+
+def test_no_alive_replica_raises():
+    spec = _spec(replicas=2, n_tenants=4, n_requests=4)
+    front = lh.build_service(spec, lh.make_tenants(spec))
+    front.mark_down(0)
+    front.mark_down(1)
+    with pytest.raises(rp.ReplicaDown):
+        front.home("t0000")
+
+
+# -- epoch propagation --------------------------------------------------
+
+
+def test_publish_feedback_installs_on_every_replica():
+    """POST /feedback's install path: one publish bumps the epoch and
+    installs the filter on EVERY live replica, whichever one the
+    tenant's next request lands on."""
+    spec = _spec(replicas=3)
+    models = lh.make_tenants(spec)
+    front = lh.build_service(spec, models)
+    base = "t0003"
+    before = [s.bank.epoch(base) for s in front.replicas]
+    filt = _filt(11)
+    epoch = front.apply_feedback_filter(base, filt)
+    assert epoch > 0
+    for s, b in zip(front.replicas, before):
+        assert s.bank.epoch(base) > b
+        assert s.bank.get_filter(base) is filt
+    assert counters.get("serve.replica_publish") == 1
+
+
+def test_sync_epochs_applies_missed_bulletin_before_scoring():
+    """The structural half of the contract: a bulletin entry a replica
+    never saw (simulating the publish/failover race) is applied by
+    `submit`'s pre-dispatch replay — the tenant's next score is
+    post-bump (re-scored, not served from the pre-bump cache)."""
+    spec = _spec(replicas=2)
+    models = lh.make_tenants(spec)
+    front = lh.build_service(spec, models)
+    t = "t0005"
+    rng = np.random.default_rng(2)
+    req = ScoreRequest(t, rng.integers(0, 96, 64).astype(np.int32),
+                       rng.integers(0, 64, 64).astype(np.int32),
+                       window="w0")
+    (r1,) = front.submit([req], tol=TOL, max_results=M)
+    (r2,) = front.submit([req], tol=TOL, max_results=M)
+    assert not r1.cached and r2.cached
+    home = front.replicas[front.home(t)]
+    before = home.bank.epoch(t)
+    # Record the entry on the bulletin WITHOUT the eager install — the
+    # state a replica is in when it missed a racing publish.
+    filt = _filt(23)
+    with front.lock:
+        front._seq += 1
+        front._bulletin[t] = (front._seq, filt)
+    (r3,) = front.submit([req], tol=TOL, max_results=M)
+    assert not r3.cached                       # bump evicted the entry
+    assert home.bank.epoch(t) > before
+    assert home.bank.get_filter(t) is filt
+    assert counters.get("serve.replica_sync_installs") >= 1
+    # Replay is idempotent: the cursor stops a second install.
+    syncs = counters.get("serve.replica_sync_installs")
+    (r4,) = front.submit([req], tol=TOL, max_results=M)
+    assert r4.cached
+    assert counters.get("serve.replica_sync_installs") == syncs
+
+
+def test_disk_resave_reaches_every_replica(tmp_path):
+    """Out-of-band re-save (daily refit by another process): each
+    replica's per-call `refresh_from_disk` probe adopts the bumped
+    epoch stamp before the tenant's next score — for tenants homed to
+    DIFFERENT replicas, so the probe provably runs on both."""
+    rng = np.random.default_rng(4)
+
+    def _arrays():
+        return (rng.dirichlet(np.full(6, 0.5), 96).astype(np.float32),
+                rng.dirichlet(np.full(6, 0.5), 64).astype(np.float32))
+
+    def _service():
+        def loader(t):
+            m = load_model(tmp_path, t)
+            return None if m is None else TenantModel(
+                m.arrays["theta"], m.arrays["phi_wk"],
+                epoch=int(m.meta.get("model_epoch", 0)))
+        bank = ModelBank(capacity=4, loader=loader,
+                         epoch_loader=lambda t: model_meta_epoch(
+                             tmp_path, t))
+        return BankService(bank, max_batch_requests=8)
+
+    front = rp.ReplicaFront([_service(), _service()])
+    by_home: dict[int, str] = {}
+    for i in range(16):
+        name = f"flow/201607{i:02d}"
+        by_home.setdefault(zlib.crc32(name.encode()) % 2, name)
+    assert set(by_home) == {0, 1}
+    tenants = list(by_home.values())
+    arrays = {t: _arrays() for t in tenants}
+    for t in tenants:
+        save_model(tmp_path, t, *arrays[t])
+    reqs = [ScoreRequest(t, rng.integers(0, 96, 80).astype(np.int32),
+                         rng.integers(0, 64, 80).astype(np.int32),
+                         window="w") for t in tenants]
+    front.submit(reqs, tol=TOL, max_results=M)
+    again = front.submit(reqs, tol=TOL, max_results=M)
+    assert all(r.cached for r in again)
+    # "Another process" re-fits both tenants and re-saves durably.
+    for t in tenants:
+        save_model(tmp_path, t, *arrays[t], epoch=5)
+    bumped = front.submit(reqs, tol=TOL, max_results=M)
+    assert all(not r.cached for r in bumped)   # never pre-bump winners
+    for t in tenants:
+        assert front.replicas[front.home(t)].bank.epoch(t) >= 5
+    assert counters.get("bank.disk_epoch_refresh") >= 2
+
+
+# -- failover -----------------------------------------------------------
+
+
+def test_failover_rehomes_wave_and_preserves_winners():
+    """A replica torn down mid-replay: its wave re-routes to the
+    survivor, winners stay bit-identical to the single service, and
+    the dead replica never gets routed to again."""
+    spec = _spec()
+    models = lh.make_tenants(spec)
+    stream = lh.make_stream(spec)
+    single = lh.replay(lh.build_service(spec, models), stream,
+                       tol=TOL, max_results=M)
+    front = lh.build_service(_spec(replicas=2), models)
+    orig = front.replicas[0].submit
+    state = {"calls": 0}
+
+    def dying(wave, **kw):
+        state["calls"] += 1
+        if state["calls"] > 2:
+            raise rp.ReplicaDown("connection torn down")
+        return orig(wave, **kw)
+
+    front.replicas[0].submit = dying
+    run = lh.replay(front, stream, tol=TOL, max_results=M)
+    _assert_same_winners(_winners(single), _winners(run), "failover")
+    assert counters.get("serve.replica_failover") == 1
+    assert counters.get("serve.replica_failover_requests") >= 1
+    assert counters.get("serve.replica_down") == 1
+    assert front.n_alive() == 1 and front.alive_indices() == [1]
+    assert state["calls"] == 3                 # never re-routed to r0
+
+
+# -- the chaos cell -----------------------------------------------------
+
+
+def _merged_cache(front):
+    merged = {}
+    for i in front.alive_indices():
+        merged.update(front.replicas[i]._cache)
+    return merged
+
+
+def test_chaos_prefetch_fault_plus_teardown_is_invisible():
+    """The r20 chaos bar: a fault plan firing at `bank:prefetch` PLUS
+    a replica torn down mid-replay leave winners, the merged winner
+    cache (keys, epochs, TopK bits), and per-tenant epochs identical
+    to the fault-free run. A second full pass lets the survivor
+    re-score entries stranded on the dead replica's cache — the same
+    replay traffic a dashboard re-opening the day generates."""
+    spec = _spec(capacity=3, host_capacity=6, prefetch_depth=2,
+                 replicas=2)
+    models = lh.make_tenants(spec)
+    stream = lh.make_stream(spec)
+
+    control = lh.build_service(spec, models)
+    lh.replay(control, stream, tol=TOL, max_results=M)
+    control_run = lh.replay(control, stream, tol=TOL, max_results=M)
+
+    chaos = lh.build_service(spec, models)
+    faults.install_plan("bank:prefetch@1=raise")
+    orig = chaos.replicas[0].submit
+    state = {"calls": 0}
+
+    def dying(wave, **kw):
+        state["calls"] += 1
+        if state["calls"] > 1:
+            raise rp.ReplicaDown("torn down mid-batch")
+        return orig(wave, **kw)
+
+    chaos.replicas[0].submit = dying
+    lh.replay(chaos, stream, tol=TOL, max_results=M)
+    chaos_run = lh.replay(chaos, stream, tol=TOL, max_results=M)
+
+    # Winners: bit-identical, both passes' worth compared via pass 2.
+    _assert_same_winners(_winners(control_run), _winners(chaos_run),
+                         "chaos")
+    # Merged winner-cache across ALIVE replicas: same keys, same
+    # (n_events, epoch), same TopK bits.
+    cc, kc = _merged_cache(control), _merged_cache(chaos)
+    assert set(cc) == set(kc)
+    for key in cc:
+        (n_a, e_a, top_a), (n_b, e_b, top_b) = cc[key], kc[key]
+        assert n_a == n_b and e_a == e_b
+        np.testing.assert_array_equal(np.asarray(top_a.scores),
+                                      np.asarray(top_b.scores))
+        np.testing.assert_array_equal(np.asarray(top_a.indices),
+                                      np.asarray(top_b.indices))
+    # Per-tenant epochs on each tenant's (current) home replica.
+    for t in models:
+        assert (chaos.replicas[chaos.home(t)].bank.epoch(t)
+                == control.replicas[control.home(t)].bank.epoch(t))
+    assert counters.get("serve.replica_down") == 1
+    assert chaos.n_alive() == 1
+
+
+# -- the serve layer end-to-end -----------------------------------------
+
+
+def _post_json(port, path, obj):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    conn.request("POST", path, body=json.dumps(obj),
+                 headers={"Content-Type": "application/json"})
+    r = conn.getresponse()
+    return r.status, json.loads(r.read() or b"{}")
+
+
+def _get(port, path):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    conn.request("GET", path)
+    r = conn.getresponse()
+    return r.status, r.read().decode()
+
+
+def test_http_replicated_serve_feedback_and_stats(tmp_path):
+    """serving.replicas=2 over HTTP: /score serves through the front,
+    POST /feedback installs on EVERY replica (the tenant's next /score
+    on any of them is post-bump), /bank/stats reports per-replica
+    tiers, and /metrics carries the replica-liveness gauges."""
+    from onix.config import OnixConfig
+    from onix.oa.serve import serve_background
+
+    cfg = OnixConfig()
+    cfg.store.root = str(tmp_path / "store")
+    cfg.serving.replicas = 2
+    cfg.validate()
+    rng = np.random.default_rng(9)
+    theta = rng.dirichlet(np.full(8, 0.5), 120).astype(np.float32)
+    phi = rng.dirichlet(np.full(8, 0.5), 90).astype(np.float32)
+    save_model(cfg.serving.models_dir, "flow/20160708", theta, phi)
+    server, port = serve_background(cfg)
+    try:
+        d = rng.integers(0, 120, 200).astype(np.int32)
+        w = rng.integers(0, 90, 200).astype(np.int32)
+        body = {"requests": [{"tenant": "flow/20160708", "window": "d0",
+                              "doc_ids": d.tolist(),
+                              "word_ids": w.tolist()}],
+                "tol": TOL, "max_results": M}
+        status, out = _post_json(port, "/score", body)
+        assert status == 200 and out["ok"]
+        assert out["results"][0]["cached"] is False
+        front = server.peek_bank_service()
+        assert isinstance(front, rp.ReplicaFront)
+        assert len(front.replicas) == 2
+        status, out2 = _post_json(port, "/score", body)
+        assert out2["results"][0]["cached"] is True
+
+        top = out["results"][0]["indices"][0]
+        status, fb = _post_json(port, "/feedback", {
+            "datatype": "flow", "date": "2016-07-08",
+            "rows": [{"ip": "10.0.0.1", "word": "x", "label": 3,
+                      "doc_id": int(d[top]), "word_id": int(w[top])}]})
+        assert status == 200 and fb["ok"]
+        assert fb["model_epoch"] is not None
+        # The install reached EVERY replica, not just the home.
+        for svc in front.replicas:
+            assert svc.bank.epoch("flow/20160708") > 0
+            assert svc.bank.get_filter("flow/20160708") is not None
+        status, out3 = _post_json(port, "/score", body)
+        assert out3["results"][0]["cached"] is False   # post-bump
+
+        status, raw = _get(port, "/bank/stats")
+        stats = json.loads(raw)
+        assert status == 200
+        tiers = stats["tiers"]
+        assert tiers["replicas"] == 2 and tiers["replicas_alive"] == 2
+        assert set(tiers["per_replica"]) == {"r0", "r1"}
+        for per in tiers["per_replica"].values():
+            assert {"hbm", "host", "disk", "prefetch"} <= set(per)
+
+        status, text = _get(port, "/metrics")
+        assert status == 200
+        assert "serve.replicas_alive" in text
+        assert "serve.replicas_down" in text
+    finally:
+        server.server_close()
