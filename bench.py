@@ -1274,6 +1274,106 @@ def bench_gibbs_merge_async(jax, jnp, small=False):
     }
 
 
+def bench_fit_multihost(jax, jnp, small=False):
+    """fit_multihost: the r21 process-spanning fit fabric vs the same
+    global dp=2 mesh held by ONE process. Arm A runs the fabric with
+    n_hosts=1, local_devices=2 (single worker process, virtual dp=2);
+    arm B runs n_hosts=2, local_devices=1 (two real OS processes under
+    a jax.distributed coordinator, one device each). Same corpus, same
+    config, sync fold — theta/phi bit-identity between the two
+    topologies is asserted every run, which is the fabric's core
+    claim: splitting the mesh across process boundaries changes
+    NOTHING about the math. A third arm re-runs the 2-process topology
+    with the async τ=1 merge and must land in the ll parity band;
+    its wall vs the 2-process sync wall is the merge-stall number.
+
+    Walls here INCLUDE worker spawn + per-process jax init + compile —
+    that is the honest cost of the process boundary on this host
+    (gloo collectives over loopback, one CPU core). The regime where
+    per-host ICI/DCN latency dominates and τ=1 stops stalling is
+    queued in docs/TPU_QUEUE.json (`fit_multihost_tpu`);
+    `n_host_processes` records which regime this artifact measured."""
+    import shutil
+    import tempfile
+
+    from onix.config import LDAConfig
+    from onix.corpus import Corpus
+    from onix.models.lda_gibbs import LL_PARITY_BAND
+    from onix.parallel import hostfabric
+
+    n_vocab, k = 128, 8
+    n_tokens = 1 << 15 if small else 1 << 17
+    n_docs = 500 if small else 2_000
+    n_sweeps = 6
+
+    rng = np.random.default_rng(11)
+    corpus = Corpus(
+        doc_ids=rng.integers(0, n_docs, n_tokens).astype(np.int32),
+        word_ids=rng.integers(0, n_vocab, n_tokens).astype(np.int32),
+        n_docs=n_docs, n_vocab=n_vocab)
+
+    def make_cfg(merge_form, tau):
+        return LDAConfig(n_topics=k, n_sweeps=n_sweeps,
+                         burn_in=n_sweeps // 2, block_size=1 << 13,
+                         seed=0, superstep=2, checkpoint_every=2,
+                         merge_form=merge_form, merge_staleness=tau)
+
+    # Loopback workers on a shared core need a lease generous enough to
+    # ride out GIL starvation during each worker's XLA compile — a
+    # false-positive death here would measure the restart path, not
+    # the fit (the chaos tests pin the same floor).
+    fabric_kw = dict(lease_s=6.0, beat_s=0.4, collective_deadline_s=120.0,
+                     timeout_s=600.0)
+
+    def fabric_run(cfg, n_hosts, local_devices):
+        workdir = tempfile.mkdtemp(prefix="onix-bench-fabric-")
+        try:
+            t0 = time.perf_counter()
+            out = hostfabric.run_fit(corpus, cfg, workdir, n_hosts=n_hosts,
+                                     local_devices=local_devices,
+                                     **fabric_kw)
+            wall = time.perf_counter() - t0
+        finally:
+            shutil.rmtree(workdir, ignore_errors=True)
+        return out, wall
+
+    sync = make_cfg("sync", 0)
+    one, wall_1p = fabric_run(sync, n_hosts=1, local_devices=2)
+    two, wall_2p = fabric_run(sync, n_hosts=2, local_devices=1)
+    for name in ("theta", "phi_wk"):
+        assert np.array_equal(np.asarray(one[name]),
+                              np.asarray(two[name])), (
+            f"2-process fabric {name} diverged from the 1-process "
+            "dp=2 fit — the process boundary changed the math")
+    ll_sync = float(two["ll_history"][-1][1])
+
+    tau1, wall_2p_tau1 = fabric_run(make_cfg("async", 1),
+                                    n_hosts=2, local_devices=1)
+    ll_tau1 = float(tau1["ll_history"][-1][1])
+    assert abs(ll_tau1 - ll_sync) < LL_PARITY_BAND * abs(ll_sync), (
+        f"2-process async tau=1 out of the ll band: {ll_tau1} "
+        f"vs {ll_sync}")
+
+    return {
+        "tokens_per_sec_2proc_sync": round(
+            n_sweeps * n_tokens / wall_2p, 1),
+        "wall_seconds": round(wall_2p, 3),
+        "wall_seconds_1proc": round(wall_1p, 3),
+        "wall_seconds_2proc_async_tau1": round(wall_2p_tau1, 3),
+        "process_boundary_overhead": round(wall_2p / wall_1p, 3),
+        "async_speedup_vs_sync_2proc": round(wall_2p / wall_2p_tau1, 3),
+        "topology_bit_identical": True,
+        "ll_parity_band_ok": True,
+        "ll_sync": round(ll_sync, 4), "ll_async_tau1": round(ll_tau1, 4),
+        "n_host_processes": 2, "local_devices_per_host": 1,
+        "mesh": {"dp": 2, "mp": 1},
+        "generations_s_2proc": (two.get("manifest") or {}).get(
+            "walls", {}).get("generations_s"),
+        "n_tokens": n_tokens, "n_sweeps": n_sweeps,
+        "n_docs": n_docs, "n_vocab": n_vocab, "n_topics": k,
+    }
+
+
 def _roofline_detail(detail: dict) -> dict | None:
     """detail.roofline: achieved bytes/s + fraction-of-peak for the two
     judged hot loops, from each component's modeled per-item traffic
@@ -1758,6 +1858,14 @@ def _measure() -> None:
     # docs/TPU_QUEUE.json `gibbs_merge_async_tpu`).
     run("gibbs_merge_async",
         lambda: bench_gibbs_merge_async(jax, jnp, small=fallback))
+    # The r21 process-spanning fit fabric: 1-process dp=2 vs 2 real OS
+    # worker processes over the same corpus, theta/phi bit-identity
+    # across the process boundary asserted per run, plus a 2-process
+    # async τ=1 arm for the merge-stall wall (docs/ROBUSTNESS.md
+    # "multi-host fit fault domain"; the real-pod regime is queued in
+    # docs/TPU_QUEUE.json `fit_multihost_tpu`).
+    run("fit_multihost",
+        lambda: bench_fit_multihost(jax, jnp, small=fallback))
     # The r19 continuous-operation loop: warm (φ̂-as-prior) vs cold
     # day-2 refit over the same feed, plant-winner parity asserted,
     # walls + drift tracked (docs/ROBUSTNESS.md "continuous

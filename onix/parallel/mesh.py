@@ -92,6 +92,30 @@ def data_axes_of(mesh: Mesh) -> tuple[str, ...]:
     return tuple(a for a in DATA_AXES if a in mesh.shape)
 
 
+def enable_cpu_collectives() -> bool:
+    """Route cross-process CPU collectives over gloo. Returns True when
+    the gloo implementation was selected.
+
+    jax 0.4.x's CPU backend refuses multi-process computations outright
+    ("Multiprocess computations aren't implemented on the CPU backend")
+    unless `jax_cpu_collectives_implementation` is set BEFORE the CPU
+    client is created — env vars alone don't reach the flag in time, so
+    every process of a CPU fabric (hostfabric workers, the 2-process
+    suite) must call this before its first jax computation. Gated on
+    JAX_PLATFORMS naming cpu: a TPU pod's collectives ride ICI/DCN and
+    must not be redirected. Older jaxlibs without gloo degrade to False
+    (the caller's distributed init then fails loudly, never silently
+    single-process)."""
+    import os
+    if "cpu" not in os.environ.get("JAX_PLATFORMS", ""):
+        return False
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except (AttributeError, ValueError):
+        return False
+    return True
+
+
 def _distributed_is_initialized() -> bool:
     """Backend-safe "is jax.distributed up" probe. jax >= 0.5 exposes
     `jax.distributed.is_initialized`; 0.4.x (this container's 0.4.37)
@@ -135,6 +159,10 @@ def multihost_init(coordinator: str | None = None,
         return jax.process_count() > 1
     explicit = coordinator is not None
     if explicit:
+        # Explicit init is how CPU fabrics launch (hostfabric workers,
+        # the 2-process suite) — those need gloo collectives selected
+        # before the backend exists; on TPU the gate inside is a no-op.
+        enable_cpu_collectives()
         kw = ({"initialization_timeout": init_timeout_s}
               if init_timeout_s else {})
         jax.distributed.initialize(coordinator_address=coordinator,

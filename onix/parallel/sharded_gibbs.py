@@ -200,6 +200,25 @@ def chunked_to_global_nwk(nwk_chunks: np.ndarray, n_vocab: int) -> np.ndarray:
     return out[:n_vocab]
 
 
+def put_global(a, mesh, spec) -> jax.Array:
+    """Host array (identical on every process) -> device array under
+    `spec` on `mesh`.
+
+    Single-process this is a plain sharded device_put. On a process-
+    spanning mesh (hostfabric) jax.device_put refuses arrays with
+    non-addressable shards, so the global array is assembled from a
+    callback that materializes only this process's addressable blocks —
+    every process holds the same full host array (state init and corpus
+    sharding are deterministic in cfg.seed), so the per-block slices
+    agree across hosts by construction."""
+    sharding = NamedSharding(mesh, spec)
+    if jax.process_count() == 1:
+        return jax.device_put(jnp.asarray(a), sharding)
+    host = np.asarray(a)
+    return jax.make_array_from_callback(host.shape, sharding,
+                                        lambda idx: host[idx])
+
+
 class ShardedGibbsState(NamedTuple):
     """Device-sharded sampler state with an UNSHARDED chain axis C.
 
@@ -896,17 +915,18 @@ class ShardedGibbsLDA:
                                 p * m * C).reshape(p, m, C, -1)
 
         specs = self._specs()
-        shard = lambda spec: NamedSharding(self.mesh, spec)
         arrays = {
-            "z": jnp.asarray(z), "n_dk": jnp.asarray(n_dk),
-            "n_wk": jnp.asarray(n_wk), "n_k": jnp.asarray(n_k),
-            "keys": jnp.asarray(keys),
-            "acc_ndk": jnp.zeros((p, C, sc.n_docs_local, k), jnp.float32),
-            "acc_nwk": jnp.zeros((m, C, sc.n_vocab_local, k), jnp.float32),
-            "n_acc": jnp.zeros((), jnp.int32),
+            "z": z, "n_dk": n_dk, "n_wk": n_wk, "n_k": n_k, "keys": keys,
+            "acc_ndk": np.zeros((p, C, sc.n_docs_local, k), np.float32),
+            "acc_nwk": np.zeros((m, C, sc.n_vocab_local, k), np.float32),
+            "n_acc": np.zeros((), np.int32),
         }
-        put = {name: (a if specs[name] is None
-                      else jax.device_put(a, shard(specs[name])))
+        # n_acc's None spec means "leave uncommitted" single-process; a
+        # process-spanning mesh needs every jit input globally placed,
+        # so it rides an explicitly replicated P() there.
+        put = {name: (jnp.asarray(a)
+                      if specs[name] is None and jax.process_count() == 1
+                      else put_global(a, self.mesh, specs[name] or P()))
                for name, a in arrays.items()}
         return ShardedGibbsState(**put)
 
@@ -914,12 +934,12 @@ class ShardedGibbsLDA:
         """Rebuild a device-sharded state from checkpointed host arrays,
         re-applying the same shardings init_state lays down."""
         specs = self._specs()
-        shard = lambda spec: NamedSharding(self.mesh, spec)
         put = {}
         for name, spec in specs.items():
-            a = jnp.asarray(arrays[name])
-            put[name] = (a if spec is None
-                         else jax.device_put(a, shard(spec)))
+            a = arrays[name]
+            put[name] = (jnp.asarray(a)
+                         if spec is None and jax.process_count() == 1
+                         else put_global(a, self.mesh, spec or P()))
         return ShardedGibbsState(**put)
 
     def prepare(self, corpus: Corpus) -> ShardedCorpus:
@@ -930,10 +950,10 @@ class ShardedGibbsLDA:
     def device_corpus(self, sc: ShardedCorpus):
         D = self.data_axes
         mp = (self._mp_axis,) if self._mp_axis else ()
-        shard = NamedSharding(self.mesh, P(D, *mp))
-        return (jax.device_put(jnp.asarray(sc.doc_blocks), shard),
-                jax.device_put(jnp.asarray(sc.word_blocks), shard),
-                jax.device_put(jnp.asarray(sc.mask_blocks), shard))
+        spec = P(D, *mp)
+        return (put_global(sc.doc_blocks, self.mesh, spec),
+                put_global(sc.word_blocks, self.mesh, spec),
+                put_global(sc.mask_blocks, self.mesh, spec))
 
     # -- fit --------------------------------------------------------------
 

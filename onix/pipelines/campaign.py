@@ -45,6 +45,7 @@ import dataclasses
 import json
 import pathlib
 import queue
+import tempfile
 import threading
 import time
 
@@ -224,6 +225,7 @@ def run_campaign(n_events: int, datatypes=DATATYPES, n_hosts: int | None = None,
                  n_chains: int = 1, overlap: bool = True,
                  overlap_depth: int = 1, merge_form: str = "sync",
                  merge_staleness: int = 1, dp: int = 0,
+                 fit_hosts: int = 1, rebalance: bool = False,
                  generator: str = "mixture",
                  resume_dir: str | pathlib.Path | None = None,
                  out_path: str | pathlib.Path | None = None,
@@ -277,6 +279,13 @@ def run_campaign(n_events: int, datatypes=DATATYPES, n_hosts: int | None = None,
             "the daily model carry (warm_start/model_sink) is "
             "single-estimate by contract: combine chains upstream "
             "(the model-bank rule) or fit with n_chains=1")
+    if fit_hosts > 1 and warm_start:
+        # The fabric workers have no init_phi surface (a warm prior
+        # would have to be sharded per host and fingerprinted); refuse
+        # loudly instead of silently fitting cold.
+        raise ValueError(
+            "the multi-host fit fabric (fit_hosts > 1) is cold-fit "
+            "only: drop warm_start or fit with fit_hosts=1")
 
     if generator == "sessions":
         from onix.pipelines.synth2 import SYNTH2_ARRAYS as gen_arrays
@@ -474,8 +483,27 @@ def run_campaign(n_events: int, datatypes=DATATYPES, n_hosts: int | None = None,
             else:
                 if warm_start is not None:
                     counters.inc("daily.cold_fits")
-                model = ShardedGibbsLDA(cfg, corpus.n_vocab, mesh=mesh)
-                fit = fit_with_resume(model, corpus, ckpt_dir)
+                if fit_hosts > 1:
+                    # r21 multi-host fabric: this datatype's fit runs
+                    # in fit_hosts worker processes; the fabric dir
+                    # rides resume_dir so a killed run resumes at the
+                    # last common superstep-boundary shard.
+                    from onix.parallel import hostfabric
+                    fabric_dir = (pathlib.Path(resume_dir) / dt
+                                  / "fit_fabric"
+                                  if resume_dir is not None
+                                  else tempfile.mkdtemp(
+                                      prefix=f"onix-fabric-{dt}-"))
+                    model = None
+                    fit = hostfabric.run_fit(
+                        corpus, cfg, fabric_dir, n_hosts=fit_hosts,
+                        on_death=("rebalance" if rebalance
+                                  else "restart"),
+                        rebalance=rebalance)
+                else:
+                    model = ShardedGibbsLDA(cfg, corpus.n_vocab,
+                                            mesh=mesh)
+                    fit = fit_with_resume(model, corpus, ckpt_dir)
         dp1_fast = bool(getattr(model, "dp1_fast", False))
         theta, phi_wk = fit["theta"], fit["phi_wk"]
         if model_sink is not None:
@@ -565,6 +593,7 @@ def run_campaign(n_events: int, datatypes=DATATYPES, n_hosts: int | None = None,
             "lda_superstep": cfg.superstep or SUPERSTEP_DEFAULT,
             "dp1_fast_path": dp1_fast,
             "mesh": dict(mesh.shape),
+            "fit_hosts": fit_hosts,
             "n_sweeps": n_sweeps, "n_topics": n_topics,
             "n_chains": n_chains, "seed": seed,
             "generator": generator,

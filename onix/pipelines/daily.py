@@ -320,7 +320,8 @@ def run_daily(n_days: int, root: str | pathlib.Path, *,
               plants: dict | None = None, n_sweeps: int = 8,
               n_topics: int = 20, max_results: int = 500, seed: int = 0,
               generator: str = "mixture", merge_form: str = "sync",
-              merge_staleness: int = 1, dp: int = 1, overlap: bool = True,
+              merge_staleness: int = 1, dp: int = 1, fit_hosts: int = 1,
+              overlap: bool = True,
               feedback: dict | None = None, dupfactor: int = 1000,
               daily: DailyConfig | None = None,
               collect_winner_pairs: bool = False,
@@ -352,6 +353,13 @@ def run_daily(n_days: int, root: str | pathlib.Path, *,
     models_dir = root / "models"
     force_cold = daily.force_cold \
         or os.environ.get("ONIX_DAILY_FORCE_COLD") == "1"
+    if fit_hosts > 1 and not force_cold:
+        # The multi-host fabric is cold-fit only (run_campaign refuses
+        # warm_start); a multi-host chain must opt out of the warm
+        # carry explicitly rather than die on day 2.
+        raise ValueError("fit_hosts > 1 needs force_cold: the fit "
+                         "fabric has no warm-start surface (pass "
+                         "--force-cold / DailyConfig(force_cold=True))")
     edges = _load_edges(root, datatypes)
 
     def feedback_upto(day: int):
@@ -445,7 +453,7 @@ def run_daily(n_days: int, root: str | pathlib.Path, *,
                     max_results=max_results, seed=day_seed,
                     overlap=overlap, merge_form=merge_form,
                     merge_staleness=merge_staleness, dp=dp,
-                    generator=generator,
+                    fit_hosts=fit_hosts, generator=generator,
                     resume_dir=_day_dir(root, day),
                     feedback=feedback_upto(day), dupfactor=dupfactor,
                     edges=edges or None, edges_sink=edges_sink,
@@ -572,7 +580,10 @@ def run_daily(n_days: int, root: str | pathlib.Path, *,
             "n_events": int(n_events), "n_sweeps": n_sweeps,
             "n_topics": n_topics, "max_results": max_results,
             "seed": seed, "generator": generator,
-            "merge_form": merge_form, "dp": dp,
+            "merge_form": merge_form,
+            "merge_staleness": (int(merge_staleness)
+                                if merge_form == "async" else 0),
+            "dp": dp, "fit_hosts": fit_hosts,
             "plants": {str(k): v for k, v in sorted(plants.items())},
             "base_anomalies": n_anomalies,
             "daily": dataclasses.asdict(daily),
@@ -652,6 +663,12 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--dp", type=int, default=1)
     ap.add_argument("--merge-form", default="sync")
+    ap.add_argument("--merge-staleness", type=int, default=1,
+                    help="merge windows a peer delta may lag in the "
+                         "async arm (0 = the bit-identity arm)")
+    ap.add_argument("--fit-hosts", type=int, default=1,
+                    help="fit worker processes in the r21 multi-host "
+                         "fabric (cold-fit only: requires --force-cold)")
     ap.add_argument("--generator", default="mixture")
     ap.add_argument("--drift-max", type=float, default=None)
     ap.add_argument("--warm-sweeps", type=int, default=None)
@@ -682,7 +699,9 @@ def main(argv: list[str] | None = None) -> int:
         plants=_parse_plants(args.plants), n_sweeps=args.sweeps,
         n_topics=args.topics, max_results=args.max_results,
         seed=args.seed, generator=args.generator,
-        merge_form=args.merge_form, dp=args.dp, daily=dcfg,
+        merge_form=args.merge_form,
+        merge_staleness=args.merge_staleness, dp=args.dp,
+        fit_hosts=args.fit_hosts, daily=dcfg,
         out_path=args.out)
     agg = manifest["aggregate"]
     print(json.dumps({"ok_days": agg["ok_days"],

@@ -65,7 +65,8 @@ def run_scale(n_events: int, n_hosts: int | None = None,
               train_events: int | None = None, datatype: str = "flow",
               n_chains: int = 1, resume_dir: str | None = None,
               generator: str = "mixture", merge_form: str = "sync",
-              merge_staleness: int = 1,
+              merge_staleness: int = 1, fit_hosts: int = 1,
+              rebalance: bool = False,
               out_path: str | pathlib.Path | None = None) -> dict:
     """End-to-end scale run; returns (and optionally writes) the manifest.
 
@@ -116,6 +117,10 @@ def run_scale(n_events: int, n_hosts: int | None = None,
             "datatype": datatype, "n_chains": n_chains,
             "max_results": max_results, "generator": generator,
             "words_mode": "host" if host_words_forced() else "device",
+            # r21: a single-process fit and a multi-host fabric fit are
+            # different models for τ>0 (and a different checkpoint
+            # topology for any τ), so crossing fit_hosts starts clean.
+            "fit_hosts": fit_hosts,
             # r14: the merge arm changes the fitted model for τ>0 (and
             # the spec refuses crossing even the bit-identical τ=0), so
             # a resume across a merge-form/τ change starts clean — the
@@ -189,6 +194,7 @@ def run_scale(n_events: int, n_hosts: int | None = None,
     mesh = make_mesh(dp=n_dev, mp=1)
     model = ShardedGibbsLDA(cfg, corpus.n_vocab, mesh=mesh)
     saved_model = ckpt.load("model") if ckpt is not None else None
+    fabric_manifest = None
     if saved_model is not None:
         # A prior session already paid for the fit — the single
         # longest atomic device stage. walls carry ITS cost, not this
@@ -196,6 +202,31 @@ def run_scale(n_events: int, n_hosts: int | None = None,
         theta = saved_model["theta"]
         phi_wk = saved_model["phi_wk"]
         walls["gibbs_fit"] = float(saved_model["wall"])
+    elif fit_hosts > 1:
+        # r21 multi-host fabric: the fit runs in fit_hosts worker
+        # processes under a jax.distributed coordinator, each owning a
+        # dp shard of the corpus and its own checkpoint shard. The
+        # fabric workdir rides resume_dir so a killed session (or a
+        # killed HOST — the fabric absorbs that itself) resumes from
+        # the last superstep boundary common to all shards.
+        from onix.parallel import hostfabric
+        fabric_dir = (pathlib.Path(resume_dir) / "fit_fabric"
+                      if resume_dir is not None
+                      else tempfile.mkdtemp(prefix="onix-fabric-"))
+        fab = hostfabric.run_fit(
+            corpus, cfg, fabric_dir, n_hosts=fit_hosts,
+            on_death="rebalance" if rebalance else "restart",
+            rebalance=rebalance)
+        theta, phi_wk = fab["theta"], fab["phi_wk"]
+        fabric_manifest = fab["manifest"]
+        walls["gibbs_fit"] = time.monotonic() - t
+        if ckpt is not None:
+            ckpt.save("model", theta=np.asarray(theta),
+                      phi_wk=np.asarray(phi_wk),
+                      wall=np.float64(walls["gibbs_fit"]))
+            ckpt.save("meta", elapsed=np.float64(
+                prior_elapsed + time.monotonic() - t_all),
+                sessions=np.int64(resumed_sessions + 1))
     else:
         fit = model.fit(corpus, checkpoint_dir=fit_ckpt_dir)
         theta, phi_wk = fit["theta"], fit["phi_wk"]  # host np: synced
@@ -297,6 +328,14 @@ def run_scale(n_events: int, n_hosts: int | None = None,
             "lda_superstep": cfg.superstep or SUPERSTEP_DEFAULT,
             "dp1_fast_path": bool(getattr(model, "dp1_fast", False)),
             "mesh": dict(mesh.shape),
+            # r21 multi-host fabric stamp: how many worker processes
+            # fitted the model, and (when the fabric ran this session)
+            # its full manifest — deaths, restarts, rebalance, resume
+            # sweeps, host.* counters. Absent fields mean the fit was
+            # in-process or resumed from a prior session's model.
+            "fit_hosts": fit_hosts,
+            **({"fit_fabric": fabric_manifest}
+               if fabric_manifest is not None else {}),
             "per_datatype_stage_walls_s": {
                 datatype: {k: round(v, 2) for k, v in walls.items()}},
         },
@@ -731,6 +770,17 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--merge-staleness", type=int, default=1,
                     help="merge windows a peer delta may lag in the "
                          "async arm (0 = the bit-identity arm)")
+    ap.add_argument("--fit-hosts", type=int, default=1,
+                    help="fit worker PROCESSES in the r21 multi-host "
+                         "fabric (parallel/hostfabric.py); 1 = the "
+                         "in-process sharded engine. Distinct from "
+                         "--hosts, which is the SYNTHETIC telemetry "
+                         "host population")
+    ap.add_argument("--rebalance", action="store_true",
+                    help="multi-host fabric only: when a fit host dies, "
+                         "re-shard its corpus onto the survivors behind "
+                         "a deliberate fingerprint bump instead of "
+                         "restarting the same topology")
     args = ap.parse_args(argv)
     m = run_scale(int(args.events), n_hosts=args.hosts,
                   n_sweeps=args.sweeps, seed=args.seed,
@@ -740,6 +790,7 @@ def main(argv: list[str] | None = None) -> int:
                   resume_dir=args.resume_dir, generator=args.generator,
                   merge_form=args.merge_form,
                   merge_staleness=args.merge_staleness,
+                  fit_hosts=args.fit_hosts, rebalance=args.rebalance,
                   out_path=args.out)
     print(json.dumps(m, indent=2))
     return 0

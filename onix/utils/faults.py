@@ -26,6 +26,15 @@ Grammar: comma-separated rules `stage:point@N=action`.
                   feedback:install— BankService.apply_feedback_filter
                                     entry (before the filter/epoch
                                     install mutates anything)
+                  host:death      — hostfabric worker superstep entry
+                                    (indexed by sweep; the worker dies
+                                    abruptly, coordinator absorbs)
+                  host:merge      — hostfabric worker collective
+                                    dispatch (indexed by sweep; inside
+                                    the bounded retry, pre-mutation)
+                  host:ckpt       — hostfabric worker shard save entry
+                                    (indexed by sweep; torn leaves the
+                                    npz without its json)
   @N            for counted points (decode, batch, save): the Nth call
                 to that point. For indexed points (fit:sweep, which
                 passes the sweep number): the first boundary at or

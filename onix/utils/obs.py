@@ -40,6 +40,7 @@ COUNTER_NAMESPACES: dict[str, str] = {
     "ckpt": "checkpoint/model integrity events (digest mismatches)",
     "daily": "continuous-operation supervisor events (warm/cold refits, drift fallbacks, ledger refusals, poison-day rollbacks; pipelines/daily.py)",
     "faults": "injected chaos-plan firings, as faults.<stage>.<point>",
+    "host": "multi-host fit fabric events (heartbeats, death detection, shard quarantine, restart/rebalance; parallel/hostfabric.py)",
     "feedback": "analyst feedback loop events (rescored events, skipped nudges)",
     "ingest": "watcher/mpingest retry + quarantine events",
     "pallas": "Pallas kernel probe/fallback events",

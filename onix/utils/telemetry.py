@@ -101,6 +101,8 @@ SPAN_REGISTRY: dict[str, str] = {
     "campaign.score": "campaign orchestrator: one datatype's scoring stage",
     "daily.day": "daily supervisor: one simulated day end-to-end (campaign + model save + ledger write)",
     "daily.refit": "daily supervisor: one datatype's warm/cold refit decision — warm fit, drift check, and any drift-forced cold refit",
+    "host.fit": "hostfabric coordinator: one multi-host fit end-to-end (spawn, monitor, deaths + restarts, result assembly)",
+    "host.superstep": "hostfabric worker: one fused superstep segment dispatch, collective deadline + retry wrapper included",
     "serve.queue_wait": "BankService.submit: admitted-to-scoring-start wall (the admission queue wait)",
     "serve.request": "oa/serve.py /score: one HTTP request, receipt to response",
     "serve.score": "BankService.score body: cache lookups + bank dispatch for one batch",
