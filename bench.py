@@ -1182,6 +1182,79 @@ def bench_daily_loop(jax, jnp, small=False):
     }
 
 
+def bench_daily_fleet(jax, jnp, small=False):
+    """daily_fleet: the r20 fleet-batched refit — the SAME tenant
+    roster driven through the sequential per-tenant supervisor arm
+    (batched=False: one program dispatch per tenant, the r19 shape)
+    and the fused fleet arm (ONE vmapped Gibbs program per pow2 shape
+    class, pipelines/fleet.py), one representative all-cold day.
+    Per-tenant winner parity is asserted BIT-EXACT every run — the
+    perf form must change nothing downstream (vmap lane independence)
+    — then the fit walls compare interleaved best-of-2 after the
+    parity pass (the exp_fit_gap weather discipline). Roofline charges
+    the PADDED token stream via obs.fleet_refit_bytes_per_token (the
+    price the shape-class padding actually pays; the waste fraction
+    rides in detail). The N-scaling sublinearity curve lives in
+    docs/FLEET_r20_cpu.json; the on-chip row is queued as
+    `daily_fleet_tpu`. On CPU both arms re-jit per run symmetrically
+    (one program per shape class each), so the wall RATIO includes
+    per-run compile — still comparable run over run."""
+    import shutil
+    import tempfile
+
+    from onix.pipelines.fleet import run_fleet
+    from onix.utils.obs import (device_peak_bytes_per_s,
+                                fleet_refit_bytes_per_token, roofline)
+
+    n_tenants = 8 if small else 24
+    kw = dict(n_events=400 if small else 1000, n_sweeps=6, n_topics=10,
+              max_results=60, seed=13)
+
+    def arm(batched):
+        td = tempfile.mkdtemp(prefix="onix-bench-fleet-")
+        try:
+            m = run_fleet(1, n_tenants, td, batched=batched, **kw)
+        finally:
+            shutil.rmtree(td, ignore_errors=True)
+        assert m["aggregate"]["failed_tenant_days"] == 0, (
+            "fleet bench day had failed tenant-days")
+        return m
+
+    def identity(m):
+        # winners + lineage digests per tenant, run-variant fields
+        # stripped — must be bit-identical across the two arms.
+        return {t: {k: v for k, v in b.items() if k != "timing"}
+                for t, b in m["days"][0]["tenants"].items()}
+
+    fleet = arm(True)
+    seq = arm(False)
+    assert identity(fleet) == identity(seq), (
+        "fleet arm diverged from the sequential supervisor arm")
+
+    best_fleet = fleet["aggregate"]["fit_wall_s"]
+    best_seq = seq["aggregate"]["fit_wall_s"]
+    best_fleet = min(best_fleet, arm(True)["aggregate"]["fit_wall_s"])
+    best_seq = min(best_seq, arm(False)["aggregate"]["fit_wall_s"])
+
+    peak, peak_src = device_peak_bytes_per_s()
+    pad = fleet["padding"]
+    rl = roofline(pad["tokens_padded"], best_fleet,
+                  fleet_refit_bytes_per_token(kw["n_topics"],
+                                              kw["n_sweeps"]), peak)
+    rl["peak_source"] = peak_src
+    return {
+        "n_tenants": n_tenants,
+        "n_events_per_tenant": kw["n_events"],
+        "fit_wall_seq_s": round(best_seq, 3),
+        "fit_wall_fleet_s": round(best_fleet, 3),
+        "fleet_speedup": round(best_seq / max(best_fleet, 1e-9), 3),
+        "per_tenant_winner_parity": True,
+        "padding": pad,
+        "fleet_refit_roofline_modeled": rl,
+        "wall_seconds": round(best_fleet, 3),
+    }
+
+
 def bench_gibbs_merge_async(jax, jnp, small=False):
     """gibbs_merge_async: the r14 bounded-staleness merge arm vs the
     r7 synchronous psum fold on the sharded engine's wrapped
@@ -1872,6 +1945,13 @@ def _measure() -> None:
     # operation"; the on-chip ratio row is queued in
     # docs/TPU_QUEUE.json `daily_loop_tpu`).
     run("daily_loop", lambda: bench_daily_loop(jax, jnp, small=fallback))
+    # The r20 fleet-batched refit: sequential per-tenant supervisor vs
+    # ONE vmapped Gibbs program per shape class over the same roster,
+    # per-tenant winner bit-identity asserted, padded-stream roofline
+    # tracked (docs/PERF.md "fleet refit"; the on-chip row is queued
+    # in docs/TPU_QUEUE.json `daily_fleet_tpu`).
+    run("daily_fleet",
+        lambda: bench_daily_fleet(jax, jnp, small=fallback))
     # Roofline accounting over whatever components completed — bytes/s
     # and fraction-of-peak become tracked numbers (docs/PERF.md), so a
     # throughput regression is a falling fraction, not a prose claim.

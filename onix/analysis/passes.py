@@ -424,7 +424,7 @@ def check_gates(ctx: AnalysisContext) -> list[Finding]:
 #: LDAConfig. Matched on rel-path basename so fixture trees can mirror
 #: the layout.
 ENGINE_BASENAMES = {"lda_gibbs.py", "lda_svi.py", "sharded_gibbs.py",
-                    "streaming.py", "model_bank.py"}
+                    "streaming.py", "model_bank.py", "fleet_gibbs.py"}
 
 #: Receivers whose attribute reads count as LDAConfig-field reads:
 #: bare names bound to an LDAConfig, and attribute tails reaching one.

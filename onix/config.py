@@ -117,6 +117,9 @@ ENV_REGISTRY: dict[str, tuple[str, str]] = {
     "ONIX_FAULT_PLAN": (
         "plan: stage:point@N=action,...",
         "declarative chaos plan (utils/faults.py; docs/ROBUSTNESS.md)"),
+    "ONIX_FLEET_TPU": (
+        "flag: 1=keep ambient backend",
+        "exp_fleet.py: opt into the real TPU instead of pinning CPU"),
     "ONIX_HOSTFABRIC_COORD": (
         "addr: host:port",
         "hostfabric worker: jax.distributed coordinator address (set by "

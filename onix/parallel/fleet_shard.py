@@ -1,0 +1,73 @@
+"""Tenant-axis sharding for the fleet refit (r20; models/fleet_gibbs).
+
+A shape class's stacked arrays carry the tenant lane on axis 0 and the
+lanes are mathematically independent, so sharding the fleet over the dp
+mesh is pure data parallelism: lane t's sweeps touch only lane t's
+counts, the compiled program is collective-free (the bank-shard
+discipline — serving/model_bank asserts the same property for scoring),
+and a dp=1 mesh degrades to a plain device_put.
+
+The lane count pads to a multiple of the dp extent with DEAD lanes
+(mask 0, z at the padding sentinel K, zero keys): a dead lane's counts
+stay empty and its outputs are discarded by lane index, so padding for
+the mesh can never perturb a live tenant's bits — the same contract
+the pow2 token padding already carries inside each lane.
+
+Multi-host fleets compose exactly like the r21 fit fabric: each host
+runs the classes whose lanes the dp mesh places on its local devices;
+there is no cross-host traffic to schedule because there are no
+collectives to stall (parallel/hostfabric.py owns process lifecycle,
+not this module).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from onix.parallel.mesh import DP_AXIS
+
+#: The stacked arrays a fleet program consumes, in call order.
+LANE_ARRAYS = ("z0", "docs", "words", "mask", "fb_docs", "fb_words",
+               "fb_weights", "keys")
+
+
+def lane_pad(n_lanes: int, n_shards: int) -> int:
+    """Dead lanes needed to make the tenant axis divide the dp extent."""
+    return (-int(n_lanes)) % max(int(n_shards), 1)
+
+
+def pad_class_lanes(sc, *, k_topics: int, n_shards: int) -> dict:
+    """The class's stacked arrays with the lane axis padded to a
+    multiple of `n_shards` (host-side np views; zero-copy when no
+    padding is needed)."""
+    arrays = {name: getattr(sc, name) for name in LANE_ARRAYS}
+    pad = lane_pad(sc.n_lanes, n_shards)
+    if pad == 0:
+        return arrays
+    out = {}
+    for name, a in arrays.items():
+        dead = np.zeros((pad,) + a.shape[1:], a.dtype)
+        if name == "z0":
+            dead[:] = k_topics          # padding sentinel: zero one-hot row
+        out[name] = np.concatenate([a, dead], axis=0)
+    return out
+
+
+def shard_class(sc, mesh, *, k_topics: int) -> dict:
+    """Device-place one shape class's stacked arrays for the fleet
+    program: lane axis padded to the mesh's dp extent and sharded over
+    DP_AXIS (every other axis replicated-by-slicing, i.e. unsharded).
+    With no mesh or a single-device mesh this is the identity — the
+    host arrays feed jit directly."""
+    if mesh is None or np.prod(list(mesh.shape.values())) <= 1:
+        return {name: getattr(sc, name) for name in LANE_ARRAYS}
+    dp = mesh.shape[DP_AXIS]
+    arrays = pad_class_lanes(sc, k_topics=k_topics, n_shards=dp)
+    out = {}
+    for name, a in arrays.items():
+        sharding = jax.sharding.NamedSharding(
+            mesh, jax.sharding.PartitionSpec(
+                *([DP_AXIS] + [None] * (a.ndim - 1))))
+        out[name] = jax.device_put(a, sharding)
+    return out

@@ -1528,3 +1528,25 @@ class BankService:
                 "conflicts": counters.get("bank.cache_conflict"),
                 "epoch_evictions":
                     counters.get("bank.cache_epoch_evictions")}
+
+
+# ---------------------------------------------------------------------------
+# Refit -> bank epoch propagation (r20, pipelines/fleet.py).
+# ---------------------------------------------------------------------------
+
+
+def publish_refit(bank: ModelBank, tenant: str, theta, phi_wk, *,
+                  epoch: int) -> int:
+    """Propagate one accepted refit into a live serving bank.
+
+    The fleet supervisor calls this per accepted tenant-day with the
+    tenant's LINEAGE epoch (the per-tenant ok-day counter that also
+    stamps the persisted model), which rides `add`'s explicit-epoch
+    path: the in-memory epoch moves past the previous stamp, the
+    tenant's cached winners invalidate, and its device residency
+    evicts — for exactly this tenant, no other (the same surgical
+    radius the per-tenant quarantine gives the fit side). Returns the
+    bank's resulting epoch for the tenant."""
+    bank.add(tenant, theta, phi_wk, epoch=int(epoch))
+    counters.inc("bank.refit_published")
+    return bank.epoch(tenant)

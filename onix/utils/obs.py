@@ -40,6 +40,7 @@ COUNTER_NAMESPACES: dict[str, str] = {
     "ckpt": "checkpoint/model integrity events (digest mismatches)",
     "daily": "continuous-operation supervisor events (warm/cold refits, drift fallbacks, ledger refusals, poison-day rollbacks; pipelines/daily.py)",
     "faults": "injected chaos-plan firings, as faults.<stage>.<point>",
+    "fleet": "fleet-batched refit supervisor events (warm/cold tenant-days, drift cold refits, per-tenant quarantines, nudge applications; pipelines/fleet.py)",
     "host": "multi-host fit fabric events (heartbeats, death detection, shard quarantine, restart/rebalance; parallel/hostfabric.py)",
     "feedback": "analyst feedback loop events (rescored events, skipped nudges)",
     "ingest": "watcher/mpingest retry + quarantine events",
@@ -428,6 +429,25 @@ def gibbs_sparse_bytes_per_token(k_topics: int, n_active: int,
                  + 3 * n_vocab * k_topics * 4)    # phi read + cdf r/w
         per_token += build / sweep_tokens
     return per_token
+
+
+def fleet_refit_bytes_per_token(k_topics: int, n_sweeps: int) -> float:
+    """Modeled memory traffic per stacked PADDED token across one
+    tenant's fleet refit (onix/models/fleet_gibbs.py; bench.py
+    `daily_fleet` roofline): the count build (one n_dk/n_wk row
+    scatter + the token stream: 4·K·4 + 12 B), then `n_sweeps` Gibbs
+    sweeps at the sweep kernel's per-token traffic
+    (gibbs_sweep_bytes_per_token), then the burn-in accumulator adds
+    (2·K·4 B per sweep per token's rows, charged per token) and the
+    two boundary ll evaluations (2·(2·K·4 + 12) B). Padded tokens move
+    the same bytes as real ones — that is what `padding_stats`'
+    token_pad_waste_frac prices — so the model charges the PADDED
+    stream and the bench divides by padded tokens·tenants."""
+    build = 4 * k_topics * 4 + 12
+    sweeps = n_sweeps * (gibbs_sweep_bytes_per_token(k_topics)
+                         + 2 * k_topics * 4)
+    ll = 2 * (2 * k_topics * 4 + 12)
+    return build + sweeps + ll
 
 
 def bank_score_bytes_per_event(k_topics: int, dtype_bytes: int = 4) -> float:

@@ -101,6 +101,8 @@ SPAN_REGISTRY: dict[str, str] = {
     "campaign.score": "campaign orchestrator: one datatype's scoring stage",
     "daily.day": "daily supervisor: one simulated day end-to-end (campaign + model save + ledger write)",
     "daily.refit": "daily supervisor: one datatype's warm/cold refit decision — warm fit, drift check, and any drift-forced cold refit",
+    "fleet.day": "fleet supervisor: one simulated day across every executing tenant (prepare, fleet refit, per-tenant accepts)",
+    "fleet.refit": "fleet supervisor: the day's fused fleet refit — stacked warm/cold class dispatches plus the drift-gated cold second pass",
     "host.fit": "hostfabric coordinator: one multi-host fit end-to-end (spawn, monitor, deaths + restarts, result assembly)",
     "host.superstep": "hostfabric worker: one fused superstep segment dispatch, collective deadline + retry wrapper included",
     "serve.queue_wait": "BankService.submit: admitted-to-scoring-start wall (the admission queue wait)",

@@ -1,0 +1,444 @@
+"""The r20 fleet-batched warm refit (onix/models/fleet_gibbs.py +
+onix/pipelines/fleet.py): thousands of tenant chains as bank-style
+pow2 shape classes through ONE vmapped Gibbs program per class, with
+per-tenant lifecycle (drift gates, ledger shards, quarantine) and the
+×DUPFACTOR dismissal rebuild replaced by a collapsed-Gibbs count
+nudge.
+
+The load-bearing contracts:
+
+- the batched fleet arm is BIT-IDENTICAL to the sequential
+  per-tenant arm (vmap lane independence — the perf form changes
+  nothing downstream);
+- a poisoned tenant-day quarantines that tenant ALONE: every other
+  tenant's week is bit-identical to the unpoisoned control, and the
+  victim's chain degrades (skips the day, reparents on its last ok
+  model) without corrupting;
+- the count nudge reproduces the ×DUPFACTOR suppression (lag <= one
+  refit, the r13 replay bar) while staying INSIDE the ll parity band
+  the corpus-rebuild arm falls out of.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from onix import checkpoint
+from onix.config import DailyConfig, LDAConfig
+from onix.models import fleet_gibbs
+from onix.models.compaction import pow2_bucket
+from onix.models.lda_gibbs import LL_PARITY_BAND
+from onix.parallel import fleet_shard
+from onix.pipelines.fleet import (PoisonedFeed, run_fleet,
+                                  tenant_lineage, tenant_name)
+from onix.utils import faults
+from onix.utils.obs import counters
+
+#: One tiny-but-real fleet week shared by the control and every chaos
+#: arm: 3 tenant chains, 3 days, fresh traffic daily, plants on day 1.
+FLEET = dict(n_events=300, n_sweeps=4, n_topics=8, max_results=40,
+             seed=5, plants={1: 6})
+N_TENANTS, N_DAYS = 3, 3
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    faults.reset()
+    for ns in ("fleet", "campaign", "daily", "faults", "ckpt", "bank"):
+        counters.reset(ns)
+    yield
+    faults.reset()
+
+
+def _identity(manifest: dict) -> list[dict]:
+    """The deterministic view of a fleet run: per-day per-tenant ledger
+    bodies with the run-variant fields (walls, resume flags) stripped.
+    Everything left — winners, scores, refit forms, drift, nudge
+    digests, model lineage — must be bit-identical between a
+    fault-riddled run and the fault-free control."""
+    return [{"day": rec["day"],
+             "tenants": {t: {k: v for k, v in b.items()
+                             if k not in ("timing", "resumed")}
+                         for t, b in rec["tenants"].items()}}
+            for rec in manifest["days"]]
+
+
+def _tenant_bodies(manifest: dict, tenant: str) -> list[dict]:
+    return [d["tenants"][tenant] for d in _identity(manifest)]
+
+
+@pytest.fixture(scope="module")
+def control_fleet(tmp_path_factory):
+    """The fault-free 3-tenant week every chaos arm compares against."""
+    root = tmp_path_factory.mktemp("fleet-control")
+    faults.reset()
+    m = run_fleet(N_DAYS, N_TENANTS, root, **FLEET)
+    assert m["aggregate"]["ok_tenant_days"] == N_DAYS * N_TENANTS
+    assert m["aggregate"]["failed_tenant_days"] == 0
+    return m
+
+
+# ---------------------------------------------------------------------------
+# Shape-class stacking: pow2 keys, arrival-order invariance, padding
+# accounting.
+# ---------------------------------------------------------------------------
+
+def _toy_tenant(uid, n_docs, n_vocab, n_tokens, rng):
+    return fleet_gibbs.TenantDay(
+        name=tenant_name(uid), uid=uid,
+        docs=rng.integers(0, n_docs, n_tokens).astype(np.int32),
+        words=rng.integers(0, n_vocab, n_tokens).astype(np.int32),
+        n_docs=n_docs, n_vocab=n_vocab)
+
+
+def test_class_key_is_pow2_bucketed():
+    rng = np.random.default_rng(0)
+    t = _toy_tenant(0, 37, 101, 517, rng)
+    d, v, n = fleet_gibbs.class_key(t)
+    assert (d, v, n) == (pow2_bucket(37, 8), pow2_bucket(101, 8),
+                         pow2_bucket(517, 64))
+    # pow2 semantics: the bucket covers the size and is a power of two
+    # at/above the floor.
+    assert d >= 37 and v >= 101 and n >= 517
+    for val, floor in ((d, 8), (v, 8), (n, 64)):
+        assert val >= floor and (val & (val - 1)) == 0
+
+
+def test_stacking_is_arrival_order_invariant():
+    """Same tenants, shuffled arrival — identical stacked classes
+    (classes sorted by key, lanes by uid), so the fleet program sees a
+    canonical batch no matter who reported first."""
+    rng = np.random.default_rng(1)
+    tenants = [_toy_tenant(u, 30, 90, 400 + 10 * u, rng)
+               for u in range(4)]
+    tenants.append(_toy_tenant(7, 500, 900, 4000, rng))  # its own class
+    a = fleet_gibbs.stack_tenants(tenants, k_topics=8, seed=3, day=2)
+    b = fleet_gibbs.stack_tenants(tenants[::-1], k_topics=8, seed=3,
+                                  day=2)
+    assert [sc.key for sc in a] == [sc.key for sc in b]
+    assert len(a) == 2  # small quartet + the big loner
+    for sa, sb in zip(a, b):
+        assert ([t.name for t in sa.tenants]
+                == [t.name for t in sb.tenants])
+        for arr in fleet_shard.LANE_ARRAYS:
+            np.testing.assert_array_equal(getattr(sa, arr),
+                                          getattr(sb, arr))
+
+
+def test_padding_stats_accounting():
+    rng = np.random.default_rng(2)
+    tenants = [_toy_tenant(u, 30, 90, 300 + 50 * u, rng)
+               for u in range(3)]
+    classes = fleet_gibbs.stack_tenants(tenants, k_topics=8, seed=0,
+                                        day=1)
+    stats = fleet_gibbs.padding_stats(classes)
+    assert stats["n_tenants"] == 3
+    assert stats["n_classes"] == len(classes)
+    assert stats["tokens_real"] == sum(t.n_tokens for t in tenants)
+    assert stats["tokens_padded"] >= stats["tokens_real"]
+    assert 0.0 <= stats["token_pad_waste_frac"] < 1.0
+
+
+# ---------------------------------------------------------------------------
+# dp-mesh lane sharding: identity passthrough and dead-lane padding.
+# ---------------------------------------------------------------------------
+
+def test_fleet_shard_passthrough_and_dead_lanes():
+    rng = np.random.default_rng(3)
+    tenants = [_toy_tenant(u, 30, 90, 300, rng) for u in range(3)]
+    sc = fleet_gibbs.stack_tenants(tenants, k_topics=8, seed=0,
+                                   day=1)[0]
+
+    # No mesh: identity passthrough, the exact same arrays.
+    out = fleet_shard.shard_class(sc, None, k_topics=8)
+    for arr in fleet_shard.LANE_ARRAYS:
+        assert out[arr] is getattr(sc, arr)
+
+    # Dead-lane padding to the shard extent: live lanes untouched,
+    # dead lanes masked out (all-zero mask; z0 at the K sentinel).
+    assert fleet_shard.lane_pad(3, 4) == 1
+    assert fleet_shard.lane_pad(4, 4) == 0
+    assert fleet_shard.lane_pad(5, 4) == 3
+    padded = fleet_shard.pad_class_lanes(sc, k_topics=8, n_shards=4)
+    for arr in fleet_shard.LANE_ARRAYS:
+        assert padded[arr].shape[0] == 4
+        np.testing.assert_array_equal(padded[arr][:3],
+                                      np.asarray(getattr(sc, arr)))
+    assert not padded["mask"][3].any()
+    assert (np.asarray(padded["z0"][3]) == 8).all()
+
+
+# ---------------------------------------------------------------------------
+# The perf contract: the fused fleet arm changes NOTHING downstream.
+# ---------------------------------------------------------------------------
+
+def test_fleet_arm_bit_identical_to_sequential(control_fleet, tmp_path):
+    """batched=False runs the same per-lane program one tenant at a
+    time (the r19-style sequential supervisor arm). Winners, lineage
+    digests, drift, nudge digests — all bit-identical."""
+    seq = run_fleet(N_DAYS, N_TENANTS, tmp_path, batched=False, **FLEET)
+    assert _identity(seq) == _identity(control_fleet)
+    for t in (tenant_name(u) for u in range(N_TENANTS)):
+        assert tenant_lineage(seq, t) == tenant_lineage(control_fleet, t)
+
+
+# ---------------------------------------------------------------------------
+# Per-tenant quarantine: one bad day poisons one tenant, never the
+# fleet.
+# ---------------------------------------------------------------------------
+
+def test_poisoned_tenant_quarantined_alone(control_fleet, tmp_path):
+    victim = tenant_name(1)
+    m = run_fleet(N_DAYS, N_TENANTS, tmp_path,
+                  poison_feed={(victim, 2)}, **FLEET)
+
+    # The victim's day 2 failed and was dead-lettered...
+    bodies = _tenant_bodies(m, victim)
+    assert bodies[1]["status"] == "failed"
+    assert "PoisonedFeed" in bodies[1]["error"]
+    sidecar = (tmp_path / "quarantine" / victim
+               / "day-002.quarantine.json")
+    assert sidecar.exists()
+    assert json.loads(sidecar.read_text())["day"] == 2
+    assert counters.get("fleet.quarantined_tenant_days") == 1
+
+    # ...while every OTHER tenant's week is bit-identical to the
+    # unpoisoned control (vmap lane independence, end to end).
+    for u in range(N_TENANTS):
+        t = tenant_name(u)
+        if t == victim:
+            continue
+        assert _tenant_bodies(m, t) == _tenant_bodies(control_fleet, t)
+
+    # The victim's chain degrades, never corrupts: day 3 reparents on
+    # day 1 (the last ok model), skipping the quarantined day.
+    lin = tenant_lineage(m, victim)
+    assert [r["day"] for r in lin] == [1, 3]
+    assert lin[1]["parent_digest"] == lin[0]["content_sha256"]
+    assert lin[1]["parent_epoch"] == lin[0]["epoch"]
+    assert m["aggregate"]["failed_tenant_days"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Count-nudge == ×DUPFACTOR contract (arXiv:1601.01142 frozen
+# pseudo-mass, replacing the r13 corpus rebuild).
+# ---------------------------------------------------------------------------
+
+def test_nudge_matches_dupfactor_engine_contract():
+    """Both arms suppress the dismissed (doc, word) pair by a large
+    factor; the nudge does it INSIDE the ll parity band on real
+    tokens, deviating no more than the ×DUPFACTOR corpus rebuild it
+    replaces (the rebuild injects its pseudo-tokens into the sampled
+    stream, distorting every other doc's mixture; the nudge freezes
+    them in the count tables only)."""
+    from onix.pipelines.campaign import _prepare
+    from onix.pipelines.synth import SYNTH_ARRAYS
+
+    prep = _prepare("flow", 300, 120, 0, 11, SYNTH_ARRAYS)
+    c = prep.bundle.corpus
+    cfg = LDAConfig(n_topics=8, n_sweeps=6, burn_in=2, seed=3)
+    weight = 100  # production-proportionate pseudo-mass (~17% here)
+
+    def fit(docs, words, fb=None):
+        td = fleet_gibbs.TenantDay(
+            name="t", uid=0, docs=np.asarray(docs, np.int32),
+            words=np.asarray(words, np.int32),
+            n_docs=c.n_docs, n_vocab=c.n_vocab,
+            fb_docs=None if fb is None else fb[0],
+            fb_words=None if fb is None else fb[1],
+            fb_weights=None if fb is None else fb[2])
+        sc = fleet_gibbs.stack_tenants([td], k_topics=8, seed=3,
+                                       day=1)[0]
+        d_pad, v_pad, _ = sc.key
+        prog = fleet_gibbs.make_tenant_refit(cfg, n_docs=d_pad,
+                                             n_vocab=v_pad)
+        th, ph, _, _ = prog(sc.z0[0], sc.docs[0], sc.words[0],
+                            sc.mask[0], sc.fb_docs[0], sc.fb_words[0],
+                            sc.fb_weights[0], sc.keys[0])
+        return (np.asarray(th)[:c.n_docs], np.asarray(ph)[:c.n_vocab])
+
+    def mean_ll(th, ph):
+        p = (th[c.doc_ids] * ph[c.word_ids]).sum(axis=1)
+        return float(np.log(np.maximum(p, 1e-30)).mean())
+
+    th0, ph0 = fit(c.doc_ids, c.word_ids)
+    p_tok = (th0[c.doc_ids] * ph0[c.word_ids]).sum(axis=1)
+    i = int(np.argmin(p_tok))  # the most anomalous token = a dismissal
+    dstar, wstar = int(c.doc_ids[i]), int(c.word_ids[i])
+    base_p = float(p_tok[i])
+    ll_base = mean_ll(th0, ph0)
+
+    # Arm A: the r13 mechanism — append the pair ×weight as real
+    # tokens and refit the rebuilt corpus.
+    dup_docs = np.concatenate([c.doc_ids,
+                               np.full(weight, dstar, np.int32)])
+    dup_words = np.concatenate([c.word_ids,
+                                np.full(weight, wstar, np.int32)])
+    th_dup, ph_dup = fit(dup_docs, dup_words)
+
+    # Arm B: the count nudge — same mass, frozen in the tables.
+    fb = (np.array([dstar], np.int32), np.array([wstar], np.int32),
+          np.array([weight], np.int32))
+    th_n, ph_n = fit(c.doc_ids, c.word_ids, fb=fb)
+
+    lift_dup = float(th_dup[dstar] @ ph_dup[wstar]) / base_p
+    lift_nudge = float(th_n[dstar] @ ph_n[wstar]) / base_p
+    assert lift_dup > 50 and lift_nudge > 50
+
+    band = LL_PARITY_BAND * abs(ll_base)
+    dev_nudge = abs(mean_ll(th_n, ph_n) - ll_base)
+    dev_dup = abs(mean_ll(th_dup, ph_dup) - ll_base)
+    assert dev_nudge <= band
+    assert dev_nudge <= dev_dup + 1e-9
+
+
+def test_nudge_weight_zero_is_noop():
+    """A weight-0 feedback row changes nothing — the masked-lane /
+    cleared-dismissal fast path."""
+    rng = np.random.default_rng(4)
+    t = _toy_tenant(0, 30, 90, 400, rng)
+    t0 = fleet_gibbs.TenantDay(
+        name=t.name, uid=t.uid, docs=t.docs, words=t.words,
+        n_docs=t.n_docs, n_vocab=t.n_vocab,
+        fb_docs=np.array([5], np.int32),
+        fb_words=np.array([7], np.int32),
+        fb_weights=np.array([0], np.int32))
+    cfg = LDAConfig(n_topics=8, n_sweeps=3, burn_in=1, seed=2)
+    outs = []
+    for td in (t, t0):
+        sc = fleet_gibbs.stack_tenants([td], k_topics=8, seed=2,
+                                       day=1)[0]
+        d_pad, v_pad, _ = sc.key
+        prog = fleet_gibbs.make_tenant_refit(cfg, n_docs=d_pad,
+                                             n_vocab=v_pad)
+        outs.append(prog(sc.z0[0], sc.docs[0], sc.words[0], sc.mask[0],
+                         sc.fb_docs[0], sc.fb_words[0],
+                         sc.fb_weights[0], sc.keys[0]))
+    for a, b in zip(outs[0], outs[1]):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_fleet_dismissal_suppressed_within_one_refit(tmp_path):
+    """The r13 replay bar at fleet scope: a recurring event dismissed
+    after day 1 vanishes from that tenant's winners on EVERY
+    post-dismissal day (suppression lag <= 1 refit), and the other
+    tenant's week is untouched. Stationary feeds (stride 0) + forced
+    cold fits make recurrence deterministic."""
+    week = dict(n_events=300, n_sweeps=4, n_topics=8, max_results=40,
+                seed=9,
+                daily=DailyConfig(day_seed_stride=0, force_cold=True),
+                collect_winner_pairs=True)
+    control = run_fleet(3, 2, tmp_path / "control", **week)
+
+    # Pick the highest-ranked t0000 winner that recurs on every day
+    # and carries an (ip, word) handle — the thing an analyst
+    # dismisses.
+    days = [d["tenants"]["t0000"] for d in _identity(control)]
+    recurring = set(days[0]["winners"]["indices"])
+    for d in days[1:]:
+        recurring &= set(d["winners"]["indices"])
+    assert recurring, "stationary week must have recurring winners"
+    pick = next(w for w in days[0]["winners"]["winner_pairs"]
+                if w["event"] in recurring)
+    event, pair = pick["event"], tuple(pick["pairs"][0])
+    for d in days[1:]:
+        assert event in d["winners"]["indices"]  # it RECURS unfed
+
+    nudged = run_fleet(3, 2, tmp_path / "nudged",
+                       feedback={2: {"t0000": [pair]}}, **week)
+    ndays = [d["tenants"]["t0000"] for d in _identity(nudged)]
+    assert event in ndays[0]["winners"]["indices"]  # pre-dismissal
+    for d in ndays[1:]:  # gone from day 2 ON: lag <= one refit
+        assert d["nudge"] is not None
+        assert event not in d["winners"]["indices"]
+    assert counters.get("fleet.nudged_tenant_days") == 2
+
+    # The OTHER tenant never sees the dismissal: bit-identical week.
+    assert (_tenant_bodies(nudged, "t0001")
+            == _tenant_bodies(control, "t0001"))
+
+
+# ---------------------------------------------------------------------------
+# Chaos: the fleet:refit / fleet:tenant fault sites.
+# ---------------------------------------------------------------------------
+
+@pytest.mark.faults
+def test_refit_fault_retried_lineage_identical(control_fleet, tmp_path):
+    """fleet:refit fires PRE-mutation with one bounded retry; the
+    refit is deterministic, so the retried day reproduces identical
+    per-tenant lineage digests and winners."""
+    plan = faults.install_plan("fleet:refit@1=raise")
+    m = run_fleet(N_DAYS, N_TENANTS, tmp_path, **FLEET)
+    assert plan.pending() == []
+    assert counters.get("fleet.refit_retry") == 1
+    assert _identity(m) == _identity(control_fleet)
+
+
+@pytest.mark.faults
+def test_tenant_fault_exhaustion_quarantines_that_tenant_alone(
+        control_fleet, tmp_path):
+    """Both retries of ONE tenant's accept burned (the stacked
+    one-shot rules exhaust on the second tenant of day 1): that tenant
+    is quarantined for the day; every other tenant-day is bit-identical
+    to the fault-free control, and the victim recovers next day."""
+    faults.install_plan("fleet:tenant@2=raise,fleet:tenant@2=raise")
+    m = run_fleet(N_DAYS, N_TENANTS, tmp_path, **FLEET)
+    victim = tenant_name(1)
+
+    bodies = _tenant_bodies(m, victim)
+    assert bodies[0]["status"] == "failed"
+    assert "InjectedFault" in bodies[0]["error"]
+    # Two increments: the fire that was retried AND the exhausting one.
+    assert counters.get("fleet.tenant_retry") == 2
+    assert counters.get("fleet.quarantined_tenant_days") == 1
+    assert (tmp_path / "quarantine" / victim
+            / "day-001.quarantine.json").exists()
+
+    for u in range(N_TENANTS):
+        t = tenant_name(u)
+        if t == victim:
+            continue
+        assert _tenant_bodies(m, t) == _tenant_bodies(control_fleet, t)
+
+    # Recovery: the victim's chain restarts cold on day 2 (no parent)
+    # and is warm again by day 3.
+    lin = tenant_lineage(m, victim)
+    assert [r["day"] for r in lin] == [2, 3]
+    assert lin[0]["parent_digest"] is None
+    assert lin[1]["parent_digest"] == lin[0]["content_sha256"]
+
+
+# ---------------------------------------------------------------------------
+# Resume and the serving handoff.
+# ---------------------------------------------------------------------------
+
+def test_resume_skips_verified_days_and_refuses_mismatch(tmp_path):
+    week = dict(FLEET)
+    first = run_fleet(2, 2, tmp_path, **week)
+    assert first["aggregate"]["resumed_tenant_days"] == 0
+
+    again = run_fleet(2, 2, tmp_path, **week)
+    assert again["aggregate"]["resumed_tenant_days"] == 4
+    assert all(d["executed"] == 0 for d in again["days"])
+    assert _identity(again) == _identity(first)
+
+    # A different invocation against the same root is REFUSED, never
+    # spliced into the existing chains.
+    with pytest.raises(ValueError, match="different invocation"):
+        run_fleet(2, 2, tmp_path, **dict(week, seed=week["seed"] + 1))
+
+
+def test_accepted_refits_publish_into_serving_bank(tmp_path):
+    """Every accepted tenant-day lands in the live ModelBank with its
+    LINEAGE epoch — the bank's per-tenant invalidation radius matches
+    the fit side's quarantine radius."""
+    from onix.serving.model_bank import ModelBank
+
+    bank = ModelBank(capacity=4)
+    m = run_fleet(2, 2, tmp_path, bank=bank, **FLEET)
+    assert m["aggregate"]["ok_tenant_days"] == 4
+    assert counters.get("bank.refit_published") == 4
+    for u in range(2):
+        t = tenant_name(u)
+        assert bank.epoch(t) == tenant_lineage(m, t)[-1]["epoch"]
